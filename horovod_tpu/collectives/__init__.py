@@ -1,0 +1,16 @@
+from . import eager
+from .adasum import adasum_allreduce, hierarchical_adasum
+from .compression import Compression
+from .dynamic import allgather_v, alltoall_v, compact_gathered
+from .ops import (Adasum, Average, Max, Min, Product, Sum, allgather,
+                  allreduce, alltoall, barrier, broadcast, grouped_allgather,
+                  grouped_allreduce, grouped_broadcast, grouped_reducescatter,
+                  reducescatter)
+
+__all__ = [
+    "eager", "adasum_allreduce", "hierarchical_adasum", "Compression",
+    "allgather_v", "alltoall_v", "compact_gathered", "Adasum", "Average",
+    "Max", "Min", "Product", "Sum", "allgather", "allreduce", "alltoall",
+    "barrier", "broadcast", "grouped_allgather", "grouped_allreduce",
+    "grouped_broadcast", "grouped_reducescatter", "reducescatter",
+]

@@ -1,0 +1,146 @@
+"""Adasum: scale-invariant gradient combination as an ICI butterfly.
+
+Reference parity: ``horovod/common/ops/adasum/adasum.h`` +
+``adasum_mpi_operations.cc`` / ``adasum_gpu_operations.cc`` (SURVEY.md §2.2).
+The reference combines gradient *pairs* with the projection formula
+
+    g = (1 - g1·g2 / (2·‖g1‖²)) · g1  +  (1 - g1·g2 / (2·‖g2‖²)) · g2
+
+over a recursive-halving binary tree (MPI point-to-point), with the GPU
+variant sandwiching it between intra-node NCCL reducescatter/allgather.
+
+TPU-native redesign (SURVEY.md §7 step 6): the pairwise tree becomes a
+log₂(n) **butterfly over the ICI ring** — at step *d*, rank *r* exchanges its
+full working vector with partner ``r XOR d`` via ``lax.ppermute`` and both
+sides apply the (symmetric) combine. All leaves fuse into one flat working
+vector (the grouped-fusion trick in ops.py), accumulation runs in fp32 (or
+fp64 under ``HOROVOD_ADASUM_ACCUMULATE_FP64``, matching the reference's
+option), and XLA fuses the dot/norm reductions with the elementwise combine.
+
+The hierarchical variant mirrors the reference's GPU path on a 2-axis mesh:
+reducescatter(sum) over the intra-slice ICI axis → Adasum butterfly over the
+cross-slice DCN axis → allgather back over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.process_sets import ProcessSet
+from .compression import Compression, Compressor
+
+
+def _combine(a, b, eps=0.0):
+    """The Adasum pairwise operator; symmetric, so both partners compute the
+    identical result. Zero-norm inputs degrade gracefully to plain sum."""
+    dot = jnp.vdot(a, b)
+    na = jnp.vdot(a, a)
+    nb = jnp.vdot(b, b)
+    ca = jnp.where(na > eps, 1.0 - dot / (2.0 * jnp.where(na > eps, na, 1.0)),
+                   1.0)
+    cb = jnp.where(nb > eps, 1.0 - dot / (2.0 * jnp.where(nb > eps, nb, 1.0)),
+                   1.0)
+    return ca * a + cb * b
+
+
+def _butterfly(x, axis: str, ranks=None, compression: Compressor = Compression.none):
+    """log₂(n) XOR-partner exchange/combine over `ranks` (default: all).
+
+    When a compressor is given, the WIRE payload of each ppermute exchange is
+    the compressed tensor (the reference compresses the NCCL payload the same
+    way); the local working copy stays in the accumulate dtype.
+    """
+    n_axis = lax.axis_size(axis)
+    ranks = list(range(n_axis)) if ranks is None else list(ranks)
+    n = len(ranks)
+    if n & (n - 1):
+        raise ValueError(
+            f"Adasum butterfly needs a power-of-2 participant count, got {n} "
+            "(the reference's recursive-halving tree has the same shape "
+            "constraint); use hierarchical_adasum or pad the process set")
+    pos = {r: i for i, r in enumerate(ranks)}
+    d = 1
+    while d < n:
+        # Permutation: set members swap with their XOR partner; everyone
+        # else (ranks outside the set) sends to itself.
+        perm = []
+        for r in range(n_axis):
+            if r in pos:
+                perm.append((r, ranks[pos[r] ^ d]))
+            else:
+                perm.append((r, r))
+        send, cctx = compression.compress(x)
+        recv = lax.ppermute(send, axis, perm)
+        recv = compression.decompress(recv, cctx).astype(x.dtype)
+        x = _combine(x, recv)
+        d *= 2
+    return x
+
+
+def adasum_allreduce(tensor: Any, *, process_set: Optional[ProcessSet] = None,
+                     axis_name: Optional[str] = None,
+                     compression: Compressor = Compression.none,
+                     accumulate_dtype=None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> Any:
+    """``hvd.allreduce(op=hvd.Adasum)`` equivalent over the rank axis."""
+    from . import ops as _ops
+    from horovod_tpu.core import context_api as _ctx
+    axis = _ops._axis(axis_name)
+    if accumulate_dtype is None:
+        accumulate_dtype = jnp.float32
+        if _ctx.is_initialized() and \
+                _ctx.context().config.adasum_accumulate_dtype == "float64":
+            accumulate_dtype = jnp.float64
+    ranks = None
+    if process_set is not None and process_set.process_set_id != 0:
+        ranks = process_set.ranks
+
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    if not leaves:
+        return tensor
+    orig = [(x.shape, x.dtype, x.size) for x in leaves]
+    flat = jnp.concatenate(
+        [x.ravel().astype(accumulate_dtype) for x in leaves])
+    scaled = flat * prescale_factor if prescale_factor != 1.0 else flat
+    combined = _butterfly(scaled, axis, ranks, compression=compression)
+    if postscale_factor != 1.0:
+        combined = combined * postscale_factor
+    member = _ops._member_mask(process_set, axis)
+    if member is not None:
+        # Non-members must get their input back unscaled.
+        combined = jnp.where(member, combined, flat)
+    out, off = [], 0
+    for shape, dtype, sz in orig:
+        out.append(combined[off:off + sz].reshape(shape).astype(dtype))
+        off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def hierarchical_adasum(tensor: Any, *, intra_axis: str, cross_axis: str,
+                        accumulate_dtype=jnp.float32) -> Any:
+    """Reference GPU-Adasum shape on a 2-axis (ici, dcn) mesh:
+    reducescatter(sum) within the slice → Adasum across slices → allgather.
+
+    Must be called inside code traced with both axes in scope (e.g. a
+    ``shard_map`` over a 2-D mesh). Each leaf's flattened length must be
+    divisible by the intra-axis size (pad upstream if needed).
+    """
+    def leaf(x):
+        shape, dtype, sz = x.shape, x.dtype, x.size
+        n_intra = lax.axis_size(intra_axis)
+        flat = x.ravel().astype(accumulate_dtype)
+        pad = (-sz) % n_intra
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        shard = lax.psum_scatter(flat, intra_axis, scatter_dimension=0,
+                                 tiled=True)
+        shard = _butterfly(shard, cross_axis)
+        full = lax.all_gather(shard, intra_axis, axis=0, tiled=True)
+        return full[:sz].reshape(shape).astype(dtype)
+
+    return jax.tree_util.tree_map(leaf, tensor)

@@ -1,0 +1,68 @@
+"""Gradient compression, parity with ``horovod/torch/compression.py`` /
+``horovod/tensorflow/compression.py`` (SURVEY.md §2.4).
+
+The reference compresses a tensor to fp16 before the wire and decompresses
+after. On TPU the natural wire dtype is **bfloat16** (MXU/ICI-native, no
+scaling needed); we keep the reference's ``Compression.fp16`` name and add
+``Compression.bf16``. Because compression happens inside the compiled graph,
+XLA fuses the casts into the surrounding collective — there is no extra
+memcpy as in the reference's CUDA scale-and-cast kernels
+(``cuda/cuda_kernels.cu``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: ``compress(tensor) -> (compressed, ctx)``;
+    ``decompress(compressed, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype = jnp.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

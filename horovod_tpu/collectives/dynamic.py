@@ -1,0 +1,108 @@
+"""Dynamic-shape collectives: uneven allgather / alltoallv.
+
+Reference parity: the reference negotiates per-rank first-dim sizes on the
+host (allgather shape bookkeeping in ``ops/collective_operations.cc``,
+``MPI_Allgatherv`` / ``ncclAllToAllv``-style splits; SURVEY.md §2.2). XLA
+programs have static shapes, so the TPU-native design (SURVEY.md §7 "hard
+parts") is **pad-to-max with a size side channel**: callers provide a static
+upper bound, data rides a regular collective, and true sizes travel as a tiny
+companion collective. Helpers to compact the padded result on the host are
+provided for parity with the reference's exact return shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.process_sets import ProcessSet
+from . import ops as _ops
+
+
+def allgather_v(tensor, valid_size, *, axis_name: Optional[str] = None,
+                process_set: Optional[ProcessSet] = None):
+    """Uneven allgather. ``tensor`` is padded to a common static ``max`` first
+    dim; ``valid_size`` (traced scalar) is this rank's true first-dim size.
+
+    Returns ``(gathered, sizes)`` where ``gathered`` has shape
+    ``[n * max, ...]`` (rank-major, each rank's slot padded) and ``sizes`` is
+    an ``[n]`` int32 vector of true sizes. Use :func:`compact_gathered` on the
+    host to obtain the reference's densely-concatenated result.
+    """
+    axis = _ops._axis(axis_name)
+    groups = _ops._groups(process_set, axis, require_equal=True)
+    max_rows = tensor.shape[0]
+    # Zero out the padding so downstream reductions over the padded layout
+    # are safe regardless of caller garbage.
+    mask_shape = (max_rows,) + (1,) * (tensor.ndim - 1)
+    row_ids = jnp.arange(max_rows).reshape(mask_shape)
+    tensor = jnp.where(row_ids < valid_size, tensor, jnp.zeros_like(tensor))
+    gathered = lax.all_gather(tensor, axis, axis=0, tiled=True,
+                              axis_index_groups=groups)
+    sizes = lax.all_gather(jnp.asarray(valid_size, jnp.int32)[None], axis,
+                           axis=0, tiled=True, axis_index_groups=groups)
+    return gathered, sizes
+
+
+def compact_gathered(gathered: np.ndarray, sizes: np.ndarray) -> np.ndarray:
+    """Host-side: densify a padded ``allgather_v`` result into the
+    reference's concatenated-by-rank layout."""
+    gathered = np.asarray(gathered)
+    sizes = np.asarray(sizes)
+    n = sizes.shape[0]
+    max_rows = gathered.shape[0] // n
+    parts = [gathered[i * max_rows: i * max_rows + int(sizes[i])]
+             for i in range(n)]
+    return np.concatenate(parts, axis=0)
+
+
+def alltoall_v(tensor, splits, *, max_split: Optional[int] = None,
+               axis_name: Optional[str] = None,
+               process_set: Optional[ProcessSet] = None):
+    """Uneven all-to-all (parity: ``hvd.alltoall(tensor, splits)``).
+
+    ``splits`` is an ``[n]`` vector: this rank sends ``splits[i]`` leading
+    rows to rank *i* (rows laid out consecutively, as in the reference's
+    MPI_Alltoallv). ``max_split`` is the static per-destination bound
+    (defaults to ``tensor.shape[0]``, always safe).
+
+    Returns ``(received, recv_splits)``: ``received`` has static shape
+    ``[n * max_split, ...]`` with rank-*i*'s contribution padded into slot
+    *i*; ``recv_splits[i]`` is the true row count from rank *i*. Compact on
+    host with :func:`compact_gathered`.
+    """
+    axis = _ops._axis(axis_name)
+    groups = _ops._groups(process_set, axis, require_equal=True)
+    n = _ops._set_size(process_set, axis)
+    splits = jnp.asarray(splits, jnp.int32)
+    if max_split is None:
+        max_split = tensor.shape[0]
+    # Clamp so a too-small max_split degrades to consistent truncation on
+    # both the data and the size side channel (compact_gathered stays in
+    # bounds) instead of silently corrupting neighbouring slots.
+    splits = jnp.minimum(splits, max_split)
+    # Pad the source so dynamic_slice never clamps into valid data.
+    pad = jnp.zeros((max_split,) + tensor.shape[1:], tensor.dtype)
+    src = jnp.concatenate([tensor, pad], axis=0)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(splits)[:-1]])
+
+    def take_chunk(off, count):
+        start = (off,) + (0,) * (tensor.ndim - 1)
+        sizes = (max_split,) + tensor.shape[1:]
+        chunk = lax.dynamic_slice(src, start, sizes)
+        row_ids = jnp.arange(max_split).reshape(
+            (max_split,) + (1,) * (tensor.ndim - 1))
+        return jnp.where(row_ids < count, chunk, jnp.zeros_like(chunk))
+
+    chunks = jax.vmap(take_chunk)(offsets, splits)  # [n, max_split, ...]
+    received = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
+                              axis_index_groups=groups)
+    recv_splits = lax.all_to_all(splits[:, None], axis, split_axis=0,
+                                 concat_axis=0, axis_index_groups=groups)
+    return received.reshape((n * max_split,) + tensor.shape[1:]), \
+        recv_splits.reshape((n,))

@@ -1,0 +1,323 @@
+"""In-graph collective primitives — the data plane.
+
+Reference parity: the op layer of ``horovod/common/ops/`` (SURVEY.md §2.2)
+plus the per-framework op surface (``hvd.allreduce/allgather/broadcast/
+alltoall/reducescatter/grouped_*``). Where the reference routes an enqueued
+tensor through negotiation → fusion buffer → NCCL (§3.2 call stack), here
+every op is a jit-compatible function over a named mesh axis that lowers to a
+single ``xla::AllReduce``-family HLO **inside** the compiled graph — the
+thing the reference's ``tensorflow/xla_mpi_ops.cc`` CustomCall explicitly
+could not do (it had to escape the graph via host callback; SURVEY.md §3.5).
+
+Fusion: the reference's fusion buffer + cycle-time batching is replaced by
+(a) XLA's collective combiner (configured from ``HOROVOD_FUSION_THRESHOLD``,
+see core/config.py) and (b) ``grouped_*`` ops which concatenate flat buffers
+explicitly — a compile-time fusion buffer with zero host involvement.
+
+Process sets lower to ``axis_index_groups`` — a partitioned ICI collective
+instead of the reference's per-set NCCL communicator (§2.1 process_set.cc).
+
+All ops accept pytrees and operate leaf-wise (grouped ops fuse across the
+tree). Every op works inside ``shard_map``/``pjit`` over a mesh axis; the
+eager per-rank wrappers live in ``collectives/eager.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.core import context_api as _ctx
+from ..core.process_sets import ProcessSet
+from .compression import Compression, Compressor
+
+# --- Reduce-op constants, parity with hvd.Sum/Average/Min/Max/Product/Adasum
+Sum = "sum"
+Average = "average"
+Min = "min"
+Max = "max"
+Product = "product"
+Adasum = "adasum"
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    if axis_name is not None:
+        return axis_name
+    if _ctx.is_initialized():
+        return _ctx.context().axis_name
+    return _ctx.RANK_AXIS
+
+
+def _groups(process_set: Optional[ProcessSet], axis: str,
+            require_equal: bool = False) -> Optional[List[List[int]]]:
+    if process_set is None or process_set.process_set_id == 0:
+        return None
+    world = lax.axis_size(axis)
+    members = list(process_set.ranks)
+    rest = [r for r in range(world) if r not in process_set.ranks]
+    if not require_equal:
+        return [members] + [[r] for r in rest]
+    k = len(members)
+    if len(rest) % k != 0:
+        raise ValueError(
+            f"process set of size {k} cannot partition axis size {world} "
+            "into equal groups (required for shape-changing collectives)")
+    return [members] + [rest[i:i + k] for i in range(0, len(rest), k)]
+
+
+def _set_size(process_set: Optional[ProcessSet], axis: str) -> int:
+    if process_set is None or process_set.process_set_id == 0:
+        return lax.axis_size(axis)
+    return process_set.size()
+
+
+def _member_mask(process_set: Optional[ProcessSet], axis: str):
+    """Traced boolean: is this device a member of the process set?
+    None for the global set (everyone is)."""
+    if process_set is None or process_set.process_set_id == 0:
+        return None
+    idx = lax.axis_index(axis)
+    member = jnp.zeros((), jnp.bool_)
+    for r in process_set.ranks:
+        member = member | (idx == r)
+    return member
+
+
+def _reduce_leaf(x, op: str, axis: str, groups, nparticipants: int,
+                 prescale_factor: float, postscale_factor: float):
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    if op in (Sum, Average):
+        y = lax.psum(x, axis, axis_index_groups=groups)
+        if op == Average:
+            y = y / nparticipants
+    elif op == Min:
+        y = lax.pmin(x, axis, axis_index_groups=groups)
+    elif op == Max:
+        y = lax.pmax(x, axis, axis_index_groups=groups)
+    elif op == Product:
+        # No product collective in XLA: gather then reduce. O(N) memory on a
+        # rarely-used op; reference does the same via MPI_PROD on host.
+        g = lax.all_gather(x, axis, axis=0, axis_index_groups=groups)
+        y = jnp.prod(g, axis=0)
+    else:
+        raise ValueError(f"unsupported reduce op: {op}")
+    if postscale_factor != 1.0:
+        y = y * postscale_factor
+    return y
+
+
+def allreduce(tensor: Any, op: str = Average, *,
+              process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None,
+              compression: Compressor = Compression.none,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> Any:
+    """Allreduce a pytree across the rank axis.
+
+    Parity: ``hvd.allreduce`` (torch/mpi_ops.py, tensorflow/mpi_ops.py).
+    ``op=Adasum`` routes to the scale-invariant butterfly in
+    ``collectives/adasum.py`` (reference: ops/adasum/adasum.h).
+    """
+    if op == Adasum:
+        from .adasum import adasum_allreduce
+        return adasum_allreduce(tensor, process_set=process_set,
+                                axis_name=axis_name, compression=compression,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+    axis = _axis(axis_name)
+    groups = _groups(process_set, axis)
+    n = _set_size(process_set, axis)
+    member = _member_mask(process_set, axis)
+
+    def leaf(x):
+        cx, cctx = compression.compress(x)
+        cy = _reduce_leaf(cx, op, axis, groups, n,
+                          prescale_factor, postscale_factor)
+        y = compression.decompress(cy, cctx)
+        if member is not None:
+            # Non-members of a process set must see their input unchanged
+            # (reference semantics: they never called the op) — undo the
+            # averaging/scaling their singleton-group passthrough received.
+            y = jnp.where(member, y, x)
+        return y
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def grouped_allreduce(tensors: Any, op: str = Average, *,
+                      process_set: Optional[ProcessSet] = None,
+                      axis_name: Optional[str] = None,
+                      compression: Compressor = Compression.none,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> Any:
+    """Allreduce fusing every leaf into ONE flat buffer → ONE collective.
+
+    This is the reference's fusion buffer (fusion_buffer_manager.cc +
+    group_table.cc) reborn at compile time: leaves are flattened, concatenated
+    into a single contiguous vector, reduced by a single ``xla::AllReduce``,
+    and split back — no memcpy-in/out on the host, no cycle-time wait.
+    Non-sum ops and mixed dtypes fall back to per-dtype buckets.
+    """
+    if op == Adasum:
+        from .adasum import adasum_allreduce
+        return adasum_allreduce(tensors, process_set=process_set,
+                                axis_name=axis_name, compression=compression,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor)
+    axis = _axis(axis_name)
+    groups = _groups(process_set, axis)
+    n = _set_size(process_set, axis)
+    member = _member_mask(process_set, axis)
+
+    leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    if not leaves:
+        return tensors
+    compressed = [compression.compress(x) for x in leaves]
+    # Bucket by wire dtype so concatenation is valid.
+    buckets: dict = {}
+    for i, (cx, _) in enumerate(compressed):
+        buckets.setdefault(cx.dtype, []).append(i)
+    out: List[Any] = [None] * len(leaves)
+    for dtype, idxs in buckets.items():
+        flat = jnp.concatenate([compressed[i][0].ravel() for i in idxs])
+        red = _reduce_leaf(flat, op, axis, groups, n,
+                           prescale_factor, postscale_factor)
+        off = 0
+        for i in idxs:
+            cx, cctx = compressed[i]
+            sz = cx.size
+            piece = red[off:off + sz].reshape(cx.shape)
+            y = compression.decompress(piece, cctx)
+            if member is not None:
+                y = jnp.where(member, y, leaves[i])
+            out[i] = y
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None) -> Any:
+    """Gather along dim 0 from every rank, concatenated in rank order.
+
+    Parity: ``hvd.allgather``. Under SPMD every device contributes the same
+    static shape; for per-rank varying first dims use
+    ``collectives.dynamic.allgather_v`` (pad-to-max + size side channel,
+    SURVEY.md §7 "hard parts").
+    """
+    axis = _axis(axis_name)
+    groups = _groups(process_set, axis, require_equal=True)
+
+    def leaf(x):
+        return lax.all_gather(x, axis, axis=0, tiled=True,
+                              axis_index_groups=groups)
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def grouped_allgather(tensors: Any, **kw) -> Any:
+    return allgather(tensors, **kw)
+
+
+def broadcast(tensor: Any, root_rank: int = 0, *,
+              process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None) -> Any:
+    """Broadcast from ``root_rank`` to all ranks (in the process set).
+
+    Parity: ``hvd.broadcast``. Lowered as a masked ``psum`` — XLA pattern-
+    matches `select+all-reduce` onto an efficient collective; ranks outside
+    the process set keep their own value (singleton groups).
+    """
+    axis = _axis(axis_name)
+    idx = lax.axis_index(axis)
+    if process_set is not None and process_set.process_set_id != 0:
+        if root_rank not in process_set.ranks:
+            raise ValueError(
+                f"root rank {root_rank} not in process set {process_set.ranks}")
+        groups = _groups(process_set, axis)
+        member = jnp.zeros((), jnp.bool_)
+        for r in process_set.ranks:
+            member = member | (idx == r)
+        keep = (idx == root_rank) | ~member
+    else:
+        groups = None
+        keep = idx == root_rank
+
+    def leaf(x):
+        contrib = jnp.where(keep, x, jnp.zeros_like(x))
+        return lax.psum(contrib, axis, axis_index_groups=groups).astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def grouped_broadcast(tensors: Any, root_rank: int = 0, **kw) -> Any:
+    return broadcast(tensors, root_rank, **kw)
+
+
+def alltoall(tensor: Any, splits: Optional[Sequence[int]] = None, *,
+             process_set: Optional[ProcessSet] = None,
+             axis_name: Optional[str] = None) -> Any:
+    """All-to-all exchange: dim 0 is split across ranks, chunk *i* goes to
+    rank *i*; output is the concatenation of received chunks.
+
+    Parity: ``hvd.alltoall`` (nccl ncclAllToAll / MPI_Alltoallv). Equal
+    splits lower to a single ``xla::AllToAll`` over ICI. Uneven ``splits``
+    need the padded variant in ``collectives.dynamic.alltoall_v``.
+    """
+    if splits is not None:
+        from .dynamic import alltoall_v
+        return alltoall_v(tensor, splits, process_set=process_set,
+                          axis_name=axis_name)
+    axis = _axis(axis_name)
+    groups = _groups(process_set, axis, require_equal=True)
+
+    def leaf(x):
+        n = _set_size(process_set, axis)
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"alltoall dim0 ({x.shape[0]}) must be divisible by the "
+                f"participant count ({n}); pass explicit splits for uneven "
+                "exchange")
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True, axis_index_groups=groups)
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def reducescatter(tensor: Any, op: str = Sum, *,
+                  process_set: Optional[ProcessSet] = None,
+                  axis_name: Optional[str] = None) -> Any:
+    """Reduce across ranks then scatter dim-0 chunks: rank *i* keeps chunk *i*.
+
+    Parity: ``hvd.reducescatter`` (ncclReduceScatter). This is also the ZeRO
+    building block the reference exposes but never uses (SURVEY.md §2.6).
+    """
+    if op not in (Sum, Average):
+        raise ValueError("reducescatter supports Sum and Average")
+    axis = _axis(axis_name)
+    groups = _groups(process_set, axis, require_equal=True)
+    n = _set_size(process_set, axis)
+
+    def leaf(x):
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"reducescatter dim0 ({x.shape[0]}) must be divisible by {n}")
+        y = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True,
+                             axis_index_groups=groups)
+        return y / n if op == Average else y
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def grouped_reducescatter(tensors: Any, op: str = Sum, **kw) -> Any:
+    return reducescatter(tensors, op, **kw)
+
+
+def barrier(*, axis_name: Optional[str] = None) -> None:
+    """Synchronisation barrier (parity: ``hvd.barrier``). Inside a compiled
+    SPMD program this is a tiny psum; program-order already serialises."""
+    axis = _axis(axis_name)
+    lax.psum(jnp.zeros((), jnp.float32), axis)

@@ -1,0 +1,220 @@
+"""Global context: device mesh, rank/size queries, init/shutdown.
+
+Reference parity: ``horovod/common/operations.cc`` (``horovod_init``,
+``horovod_rank/size/local_rank/...``) + ``horovod/common/basics.py``
+(SURVEY.md §3.1). The reference's init spawns a background coordination
+thread and negotiates communicators over MPI/Gloo; under SPMD/XLA there is no
+negotiation to do — ``init()`` here (a) optionally joins the multi-host
+coordination service (``jax.distributed.initialize`` over DCN — the analog of
+the reference's Gloo HTTP rendezvous), (b) builds a 1-D ``jax.sharding.Mesh``
+over all devices whose axis is the Horovod "rank" axis, and (c) loads the
+``HOROVOD_*`` config.
+
+Rank model: the reference runs one process per GPU, so rank == device. JAX is
+single-controller (one process drives many devices), so "rank" is a
+*device-level* concept:
+
+- ``size()``       → total devices in the mesh (== reference world size)
+- ``local_size()`` → devices addressable by this process
+- ``rank()``       → inside ``shard_map``/``pmap`` tracing: the per-device
+                     axis index (a traced value). On the host: the global
+                     index of this process's first device.
+- ``local_rank()`` → inside tracing: ``rank() % local_size``; host: 0.
+- ``cross_size()/cross_rank()`` → process (host) count / index, matching the
+  reference's cross-communicator used for hierarchical ops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .config import Config
+from .exceptions import NotInitializedError
+from .logging import get_logger
+from .process_sets import ProcessSet, ProcessSetTable
+
+#: Name of the mesh axis that plays the role of the Horovod rank axis.
+RANK_AXIS = "hvd"
+
+
+class Context:
+    """Singleton holding the mesh, config and process-set table."""
+
+    def __init__(self, mesh: Mesh, config: Config, axis_name: str = RANK_AXIS):
+        self.mesh = mesh
+        self.config = config
+        self.axis_name = axis_name
+        self.process_sets = ProcessSetTable(mesh.devices.size)
+        self.timeline = None  # attached by tools.timeline when enabled
+
+    @property
+    def size(self) -> int:
+        return self.mesh.devices.size
+
+
+_context: Optional[Context] = None
+_lock = threading.Lock()
+
+
+def init(devices: Optional[Sequence[jax.Device]] = None,
+         axis_name: str = RANK_AXIS,
+         coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         config: Optional[Config] = None) -> Context:
+    """Initialise the global context. Idempotent, like the reference's
+    ``InitializeHorovodOnce`` (operations.cc).
+
+    Multi-host: if ``coordinator_address`` is given (or the launcher exported
+    ``HOROVOD_COORDINATOR_ADDR``), joins the JAX coordination service first —
+    the TPU analog of the reference's rendezvous (SURVEY.md §2.7).
+    """
+    global _context
+    with _lock:
+        if _context is not None:
+            return _context
+        coord = coordinator_address or os.environ.get("HOROVOD_COORDINATOR_ADDR")
+        # NOTE: jax.distributed.initialize must run before ANY call that
+        # initialises the XLA backend (incl. jax.process_count/jax.devices),
+        # so the guard must not touch the backend.
+        if coord and not jax.distributed.is_initialized():
+            nproc = num_processes or int(os.environ.get("HOROVOD_NUM_PROCESSES", "0")) or None
+            pid = process_id if process_id is not None else (
+                int(os.environ["HOROVOD_PROCESS_ID"])
+                if "HOROVOD_PROCESS_ID" in os.environ else None)
+            get_logger().info("joining coordination service at %s", coord)
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc, process_id=pid)
+        cfg = config or Config.from_env()
+        if "HOROVOD_FUSION_THRESHOLD" in os.environ:
+            # Best-effort: forward the fusion threshold to XLA's collective
+            # combiner. XLA_FLAGS is read at backend init, so this only takes
+            # effect if the backend is not yet up (e.g. init() before first
+            # computation, or a launcher exporting it pre-spawn).
+            flags = os.environ.get("XLA_FLAGS", "")
+            add = [f for f in cfg.xla_combiner_flags() if f not in flags]
+            if add:
+                os.environ["XLA_FLAGS"] = (flags + " " + " ".join(add)).strip()
+                get_logger().info(
+                    "forwarded HOROVOD_FUSION_THRESHOLD=%d to XLA combiner "
+                    "flags (effective only if the XLA backend was not yet "
+                    "initialized)", cfg.fusion_threshold_bytes)
+        timeline = None
+        if cfg.timeline_path:
+            from ..tools.timeline import Timeline
+            timeline = Timeline(cfg.timeline_path,
+                                mark_cycles=cfg.timeline_mark_cycles)
+        devs = list(devices) if devices is not None else jax.devices()
+        mesh = Mesh(np.asarray(devs), (axis_name,))
+        ctx = Context(mesh, cfg, axis_name)
+        ctx.timeline = timeline
+        get_logger().info(
+            "initialized: %d device(s), %d process(es), platform=%s",
+            len(devs), jax.process_count(), devs[0].platform)
+        _context = ctx
+        return _context
+
+
+def shutdown() -> None:
+    """Tear down the context (reference: ``horovod_shutdown``)."""
+    global _context
+    with _lock:
+        if _context is not None and _context.timeline is not None:
+            _context.timeline.close()
+        _context = None
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def context() -> Context:
+    if _context is None:
+        raise NotInitializedError()
+    return _context
+
+
+def mesh() -> Mesh:
+    return context().mesh
+
+
+def _in_trace(axis_name: str) -> bool:
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def size() -> int:
+    """World size == device count (one rank per device, as in the reference)."""
+    return context().size
+
+
+def local_size() -> int:
+    return jax.local_device_count()
+
+
+def rank():
+    """Per-device rank inside traced code; first-local-device rank on host."""
+    ctx = context()
+    if _in_trace(ctx.axis_name):
+        return jax.lax.axis_index(ctx.axis_name)
+    local = [d for d in ctx.mesh.devices.flat
+             if d.process_index == jax.process_index()]
+    if not local:
+        return 0
+    flat = list(ctx.mesh.devices.flat)
+    return flat.index(local[0])
+
+
+def local_rank():
+    ctx = context()
+    if _in_trace(ctx.axis_name):
+        return jax.lax.axis_index(ctx.axis_name) % jax.local_device_count()
+    return 0
+
+
+def cross_size() -> int:
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    return jax.process_index()
+
+
+def is_homogeneous() -> bool:
+    """True when every process drives the same number of devices."""
+    return size() == cross_size() * local_size()
+
+
+# Build-introspection parity with basics.py (nccl_built/mpi_enabled/...):
+# on TPU the only data plane is XLA collectives, always built.
+def xla_built() -> bool:
+    return True
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def add_process_set(ranks: Sequence[int]) -> ProcessSet:
+    return context().process_sets.add(ranks)
+
+
+def remove_process_set(ps: "ProcessSet | int") -> None:
+    context().process_sets.remove(ps)

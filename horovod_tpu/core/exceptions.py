@@ -1,0 +1,35 @@
+"""Exception types mirroring the reference's ``horovod/common/exceptions.py``.
+
+Reference parity (SURVEY.md §2.4): ``HorovodInternalError`` is the signal the
+elastic layer catches to trigger comm re-initialisation + state restore;
+``HostsUpdatedInterrupt`` is raised when the driver notifies workers of a
+membership change, triggering re-init + state sync instead of rollback.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """An irrecoverable collective/runtime failure.
+
+    Under elastic training (``horovod_tpu.elastic.run``) this triggers
+    shutdown → re-init → ``state.restore()``.
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised when the host/slice membership changed under elastic training.
+
+    Triggers re-init → ``state.sync()`` (broadcast from the new rank 0).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(RuntimeError):
+    """An API needing an initialised context was called before ``init()``."""
+
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )

@@ -1,0 +1,80 @@
+"""Process sets: concurrent collectives over rank subsets.
+
+Reference parity: ``horovod/common/process_set.cc`` + ``process_sets.py``
+(SURVEY.md §2.1/§2.4) — each process set there owns its own controller,
+tensor queue and communicators. Under SPMD none of that machinery is needed:
+a process set is just a partition of the mesh's rank axis, realised at
+collective time via ``axis_index_groups`` on the XLA collective (which lowers
+to a partitioned ICI collective — strictly cheaper than a second NCCL comm).
+
+Semantics note (documented divergence): in the reference, ranks outside a
+process set simply do not call the op. Under SPMD every device executes the
+same program, so for reduce-type ops ranks outside the set are placed in
+singleton groups — they receive their own input unchanged. For shape-changing
+ops (allgather/alltoall/reducescatter) the axis partition induced by the sets
+must be into equal-size groups so the compiled program keeps static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessSet:
+    """A named subset of ranks. ``process_set_id`` 0 is the global set."""
+
+    process_set_id: int
+    ranks: tuple
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, rank: int) -> bool:
+        return rank in self.ranks
+
+    def rank_in_set(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank)
+
+
+class ProcessSetTable:
+    """Registry of process sets; id 0 is the global set over all ranks."""
+
+    def __init__(self, world_size: int):
+        self._world_size = world_size
+        self._next_id = 1
+        self._sets: Dict[int, ProcessSet] = {
+            0: ProcessSet(0, tuple(range(world_size)))
+        }
+
+    @property
+    def global_set(self) -> ProcessSet:
+        return self._sets[0]
+
+    def add(self, ranks: Sequence[int]) -> ProcessSet:
+        ranks = tuple(sorted(set(int(r) for r in ranks)))
+        if not ranks:
+            raise ValueError("process set must contain at least one rank")
+        if ranks[0] < 0 or ranks[-1] >= self._world_size:
+            raise ValueError(
+                f"ranks {ranks} out of range for world size {self._world_size}")
+        for ps in self._sets.values():
+            if ps.ranks == ranks:
+                return ps
+        ps = ProcessSet(self._next_id, ranks)
+        self._sets[self._next_id] = ps
+        self._next_id += 1
+        return ps
+
+    def remove(self, ps: "ProcessSet | int") -> None:
+        psid = ps.process_set_id if isinstance(ps, ProcessSet) else int(ps)
+        if psid == 0:
+            raise ValueError("cannot remove the global process set")
+        self._sets.pop(psid, None)
+
+    def get(self, psid: int) -> Optional[ProcessSet]:
+        return self._sets.get(psid)
+
+    def ids(self) -> List[int]:
+        return sorted(self._sets)

@@ -1,0 +1,109 @@
+"""Chrome-trace timeline writer.
+
+Reference parity: ``horovod/common/timeline.cc`` (SURVEY.md §5.1) — the
+reference logs every tensor's lifecycle (NEGOTIATE → QUEUE → MEMCPY_IN →
+NCCL_ALLREDUCE → MEMCPY_OUT) from a dedicated writer thread into a JSON
+file loadable in ``chrome://tracing``, enabled by ``HOROVOD_TIMELINE``.
+
+On TPU the device-side story is better served by ``jax.profiler`` (xplane →
+TensorBoard/Perfetto); this writer covers the HOST-side lifecycle that the
+XLA trace does not show — eager-op dispatch, elastic events, autotune trials,
+checkpoint commits — in the same Chrome-trace format so both can be loaded
+side by side. ``horovod_tpu.tools.profiler`` merges them.
+
+Thread model mirrors the reference: events are queued from any thread and a
+single writer thread drains to disk (crash-safe incremental JSON array).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Incremental Chrome-trace (JSON array format) event writer."""
+
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._start = time.time()
+        self._open_spans: dict = {}
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._writer = threading.Thread(target=self._drain, daemon=True,
+                                        name="hvd-timeline-writer")
+        self._closed = False
+        self._writer.start()
+
+    # -- event API (mirrors timeline.cc ActivityStart/ActivityEnd/Marker) --
+
+    def _us(self) -> int:
+        return int((time.time() - self._start) * 1e6)
+
+    def activity_start(self, name: str, activity: str, rank: int = 0) -> None:
+        self._q.put({"name": activity, "cat": name, "ph": "B",
+                     "ts": self._us(), "pid": rank, "tid": 0})
+
+    def activity_end(self, name: str, activity: str, rank: int = 0) -> None:
+        self._q.put({"name": activity, "cat": name, "ph": "E",
+                     "ts": self._us(), "pid": rank, "tid": 0})
+
+    def marker(self, name: str, rank: int = 0) -> None:
+        self._q.put({"name": name, "ph": "i", "ts": self._us(),
+                     "pid": rank, "tid": 0, "s": "g"})
+
+    def mark_cycle(self) -> None:
+        if self.mark_cycles:
+            self.marker("CYCLE")
+
+    def span(self, name: str, activity: str = "SPAN"):
+        """Context manager convenience (host-side spans)."""
+        tl = self
+
+        class _Span:
+            def __enter__(self):
+                tl.activity_start(name, activity)
+                return self
+
+            def __exit__(self, *exc):
+                tl.activity_end(name, activity)
+                return False
+
+        return _Span()
+
+    # -- writer thread ----------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            with self._lock:
+                if self._file.closed:
+                    return
+                if not self._first:
+                    self._file.write(",\n")
+                self._first = False
+                self._file.write(json.dumps(ev))
+                self._file.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._writer.join(timeout=5)
+        with self._lock:
+            self._file.write("\n]\n")
+            self._file.close()

@@ -1,0 +1,112 @@
+"""Adasum numerics tests — parity with the reference's
+test/parallel/test_adasum_pytorch.py (pairwise-combine formula checked
+against a NumPy model of the recursive tree)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.collectives import eager
+
+N = 8
+
+
+def np_combine(a, b):
+    dot = np.vdot(a, b)
+    na = np.vdot(a, a)
+    nb = np.vdot(b, b)
+    ca = 1.0 - dot / (2 * na) if na > 0 else 1.0
+    cb = 1.0 - dot / (2 * nb) if nb > 0 else 1.0
+    return ca * a + cb * b
+
+
+def np_adasum(vectors):
+    """Reference butterfly on host: combine XOR partners log2(n) times."""
+    vecs = [v.astype(np.float64) for v in vectors]
+    n = len(vecs)
+    d = 1
+    while d < n:
+        new = [np_combine(vecs[i], vecs[i ^ d]) for i in range(n)]
+        vecs = new
+        d *= 2
+    return vecs[0]
+
+
+def test_adasum_matches_numpy_model():
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, 37).astype(np.float32)
+    out = eager.adasum_allreduce(jnp.asarray(x))
+    expected = np_adasum(list(x))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_identical_inputs_is_identity():
+    """Adasum of n identical gradients returns ~the gradient itself
+    (combine(g, g) = g) — the scale-invariance property the reference
+    documents in docs/adasum_user_guide.rst."""
+    g = np.random.RandomState(1).randn(16).astype(np.float32)
+    x = np.tile(g, (N, 1))
+    out = eager.adasum_allreduce(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), g, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_orthogonal_inputs_sum():
+    """Orthogonal gradients have zero projection → plain sum."""
+    x = np.zeros((N, N), np.float32)
+    for i in range(N):
+        x[i, i] = float(i + 1)
+    out = eager.adasum_allreduce(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-4)
+
+
+def test_adasum_zero_inputs():
+    x = np.zeros((N, 5), np.float32)
+    out = eager.adasum_allreduce(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.zeros(5), atol=1e-7)
+
+
+def test_adasum_pytree():
+    rng = np.random.RandomState(2)
+    tree = {"w": jnp.asarray(rng.randn(N, 3, 4).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(N, 5).astype(np.float32))}
+    out = eager.adasum_allreduce(tree)
+    flat = np.concatenate([np.asarray(tree["b"]).reshape(N, -1),
+                           np.asarray(tree["w"]).reshape(N, -1)], axis=1)
+    # tree_flatten orders dict keys alphabetically: b then w
+    expected = np_adasum(list(flat))
+    got = np.concatenate([np.asarray(out["b"]).ravel(),
+                          np.asarray(out["w"]).ravel()])
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_via_allreduce_op():
+    x = np.random.RandomState(3).randn(N, 9).astype(np.float32)
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Adasum)
+    expected = np_adasum(list(x))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_process_set_pow2():
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = np.random.RandomState(4).randn(N, 6).astype(np.float32)
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Adasum,
+                                     process_set=ps))
+    # members: butterfly over ranks 0-3; non-members keep own value
+    d = 1
+    vecs = [x[i].astype(np.float64) for i in range(4)]
+    while d < 4:
+        vecs = [np_combine(vecs[i], vecs[i ^ d]) for i in range(4)]
+        d *= 2
+    for r in range(N):
+        if r < 4:
+            np.testing.assert_allclose(out[r], vecs[0], rtol=1e-4, atol=1e-5)
+        else:
+            np.testing.assert_allclose(out[r], x[r], rtol=1e-5)
+
+
+def test_adasum_non_pow2_raises():
+    ps = hvd.add_process_set([0, 1, 2])
+    with pytest.raises(ValueError):
+        eager.allreduce(jnp.asarray(np.zeros((N, 4), np.float32)),
+                        op=hvd.Adasum, process_set=ps)

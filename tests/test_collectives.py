@@ -1,0 +1,317 @@
+"""Collective op tests — the TPU analog of the reference's
+test/parallel/test_torch.py op matrix (every op × dtype × shape, grouped
+ops, process sets, prescale/postscale, compression)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.collectives import eager
+
+N = 8
+
+
+def stacked(shape=(4, 3), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(N, *shape).astype(dtype)
+
+
+# ---------------- allreduce ----------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, np.int32])
+def test_allreduce_sum(dtype):
+    x = (stacked(dtype=np.float32) * 4).astype(dtype)
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=2e-3, atol=2e-2)
+
+
+def test_allreduce_average():
+    x = stacked()
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), x.mean(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,ref", [(hvd.Min, np.min), (hvd.Max, np.max),
+                                    (hvd.Product, np.prod)])
+def test_allreduce_minmaxprod(op, ref):
+    x = stacked()
+    out = eager.allreduce(jnp.asarray(x), op=op)
+    np.testing.assert_allclose(np.asarray(out), ref(x, axis=0), rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale():
+    x = stacked()
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Sum,
+                          prescale_factor=0.5, postscale_factor=2.0)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_allreduce_pytree():
+    x = {"a": jnp.asarray(stacked()), "b": jnp.asarray(stacked((2,), seed=1))}
+    out = eager.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               np.asarray(x["a"]).sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["b"]),
+                               np.asarray(x["b"]).sum(0), rtol=1e-5)
+
+
+def test_allreduce_compression_fp16():
+    x = stacked()
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Sum,
+                          compression=hvd.Compression.fp16)
+    assert np.asarray(out).dtype == np.float32  # decompressed back
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-2, atol=1e-1)
+
+
+def test_allreduce_compression_bf16():
+    x = stacked()
+    out = eager.allreduce(jnp.asarray(x), op=hvd.Sum,
+                          compression=hvd.Compression.bf16)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=5e-2, atol=2e-1)
+
+
+def test_allreduce_process_set():
+    """Members reduce over the set; non-members keep their own value —
+    the SPMD rendering of 'non-members don't call the op'."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    x = stacked()
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Sum,
+                                     process_set=ps))
+    expected_members = x[[0, 2, 4, 6]].sum(0)
+    for r in range(N):
+        if r in (0, 2, 4, 6):
+            np.testing.assert_allclose(out[r], expected_members, rtol=1e-5)
+        else:
+            np.testing.assert_allclose(out[r], x[r], rtol=1e-6)
+
+
+# ---------------- grouped ----------------
+
+def test_grouped_allreduce_matches_individual():
+    xs = [jnp.asarray(stacked(seed=i)) for i in range(3)]
+    grouped = eager.grouped_allreduce(xs, op=hvd.Sum)
+    for x, g in zip(xs, grouped):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(x).sum(0),
+                                   rtol=1e-5)
+
+
+def test_grouped_allreduce_mixed_dtypes():
+    xs = {"f32": jnp.asarray(stacked()),
+          "f16": jnp.asarray(stacked(seed=2).astype(np.float16))}
+    out = eager.grouped_allreduce(xs, op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out["f32"]),
+                               np.asarray(xs["f32"]).sum(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["f16"]).astype(np.float32),
+        np.asarray(xs["f16"]).astype(np.float32).sum(0), rtol=2e-2, atol=1e-1)
+
+
+# ---------------- allgather ----------------
+
+def test_allgather():
+    x = stacked((2, 3))  # 2 rows per rank after reshape
+    flat = x.reshape(N * 2, 3)
+    out = eager.allgather(jnp.asarray(flat))
+    np.testing.assert_array_equal(np.asarray(out), flat)
+
+
+def test_allgather_process_set_even_odd():
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Max,
+                                     process_set=ps))
+    assert out[0, 0] == 6.0 and out[1, 0] == 1.0
+
+
+# ---------------- broadcast ----------------
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(root):
+    x = stacked()
+    out = eager.broadcast(jnp.asarray(x), root_rank=root)
+    np.testing.assert_allclose(np.asarray(out), x[root], rtol=1e-6)
+
+
+def test_broadcast_int():
+    x = np.arange(N * 4, dtype=np.int32).reshape(N, 4)
+    out = eager.broadcast(jnp.asarray(x), root_rank=5)
+    np.testing.assert_array_equal(np.asarray(out), x[5])
+
+
+def test_broadcast_process_set():
+    ps = hvd.add_process_set([1, 3, 5, 7])
+    x = stacked()
+    out = np.asarray(eager.broadcast(jnp.asarray(x), root_rank=3,
+                                     process_set=ps))
+    for r in range(N):
+        expect = x[3] if r in (1, 3, 5, 7) else x[r]
+        np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+
+def test_broadcast_root_not_in_set():
+    ps = hvd.add_process_set([1, 3])
+    with pytest.raises(ValueError):
+        eager.broadcast(jnp.asarray(stacked()), root_rank=0, process_set=ps)
+
+
+# ---------------- alltoall ----------------
+
+def test_alltoall():
+    # rank r sends value r*N+i to rank i → rank i receives [i, N+i, 2N+i...]
+    x = np.arange(N * N, dtype=np.float32).reshape(N, N, 1)
+    out = np.asarray(eager.alltoall(jnp.asarray(x)))
+    for i in range(N):
+        np.testing.assert_array_equal(out[i, :, 0],
+                                      np.arange(N) * N + i)
+
+
+def test_alltoall_multi_row():
+    # 2 rows per destination
+    x = np.arange(N * N * 2, dtype=np.float32).reshape(N, N * 2, 1)
+    out = np.asarray(eager.alltoall(jnp.asarray(x)))
+    assert out.shape == (N, N * 2, 1)
+    # rank 0 receives rows 0:2 of every rank
+    expected = np.concatenate([x[r, 0:2] for r in range(N)])
+    np.testing.assert_array_equal(out[0], expected)
+
+
+# ---------------- reducescatter ----------------
+
+def test_reducescatter_sum():
+    x = stacked((N * 2, 3))
+    out = np.asarray(eager.reducescatter(jnp.asarray(x), op=hvd.Sum))
+    total = x.sum(0)  # [N*2, 3]
+    for r in range(N):
+        np.testing.assert_allclose(out[r], total[r * 2:(r + 1) * 2],
+                                   rtol=1e-5)
+
+
+def test_reducescatter_average():
+    x = stacked((N, 3))
+    out = np.asarray(eager.reducescatter(jnp.asarray(x), op=hvd.Average))
+    total = x.mean(0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], total[r:r + 1], rtol=1e-5)
+
+
+# ---------------- barrier / in-graph use ----------------
+
+def test_ops_inside_user_shard_map():
+    """In-graph ops compose with user shard_map + jit — the core product."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    def step(x):
+        g = hvd.allreduce(x * 2.0, op=hvd.Average)
+        hvd.barrier()
+        return g
+
+    f = jax.jit(shard_map(step, mesh=hvd.mesh(),
+                          in_specs=P(hvd.RANK_AXIS), out_specs=P()))
+    x = stacked((1,)).reshape(N)
+    out = f(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), (x * 2).mean(), rtol=1e-5)
+
+
+# ---------------- review-finding regressions ----------------
+
+def test_allgather_process_set_groups():
+    """Process-set allgather returns per-rank group gathers (stacked), not
+    just the first group's result."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(eager.allgather(jnp.asarray(x), process_set=ps))
+    assert out.shape == (N, 4, 1)
+    np.testing.assert_array_equal(out[0, :, 0], [0, 2, 4, 6])
+    np.testing.assert_array_equal(out[1, :, 0], [1, 3, 5, 7])
+    np.testing.assert_array_equal(out[2, :, 0], [0, 2, 4, 6])
+
+
+def test_adasum_prescale_applied():
+    x = np.random.RandomState(7).randn(N, 6).astype(np.float32)
+    base = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Adasum))
+    scaled = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Adasum,
+                                        prescale_factor=100.0))
+    assert not np.allclose(base, scaled)
+    # Adasum is scale-invariant in direction: prescale by c scales result by c
+    np.testing.assert_allclose(scaled, base * 100.0, rtol=1e-3)
+
+
+def test_timeline_written(tmp_path):
+    import json
+    hvd.shutdown()
+    import horovod_tpu.core.config as _cfgmod
+    path = str(tmp_path / "tl.json")
+    cfg = hvd.Config.from_env()
+    cfg.timeline_path = path
+    hvd.init(config=cfg)
+    tl = hvd.core.context().timeline
+    assert tl is not None
+    with tl.span("tensor_x", "ALLREDUCE"):
+        pass
+    tl.marker("STEP")
+    hvd.shutdown()
+    events = json.load(open(path))
+    names = [e["ph"] for e in events]
+    assert "B" in names and "E" in names and "i" in names
+    hvd.init()
+
+
+def test_allreduce_process_set_average_nonmembers_unchanged():
+    """Average over a process set must not scale non-members' passthrough."""
+    ps = hvd.add_process_set([0, 1])
+    x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Average,
+                                     process_set=ps))
+    np.testing.assert_allclose(out[0, 0], 1.5)
+    np.testing.assert_allclose(out[1, 0], 1.5)
+    for r in range(2, N):
+        np.testing.assert_allclose(out[r, 0], x[r, 0])
+
+
+def test_allreduce_process_set_prescale_nonmembers_unchanged():
+    ps = hvd.add_process_set([0, 1])
+    x = np.arange(1, N + 1, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Sum,
+                                     process_set=ps, prescale_factor=10.0))
+    np.testing.assert_allclose(out[0, 0], 30.0)
+    for r in range(2, N):
+        np.testing.assert_allclose(out[r, 0], x[r, 0])
+
+
+def test_grouped_allreduce_process_set_average():
+    ps = hvd.add_process_set([0, 1])
+    xs = [jnp.asarray(np.arange(1, N + 1, dtype=np.float32).reshape(N, 1))]
+    out = np.asarray(eager.grouped_allreduce(xs, op=hvd.Average,
+                                             process_set=ps)[0])
+    np.testing.assert_allclose(out[0, 0], 1.5)
+    np.testing.assert_allclose(out[5, 0], 6.0)
+
+
+def test_adasum_process_set_prescale_nonmembers_unchanged():
+    ps = hvd.add_process_set([0, 1])
+    x = np.random.RandomState(11).randn(N, 4).astype(np.float32)
+    out = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Adasum,
+                                     process_set=ps, prescale_factor=50.0))
+    for r in range(2, N):
+        np.testing.assert_allclose(out[r], x[r], rtol=1e-5)
+
+
+def test_eager_jit_cache_reused():
+    from horovod_tpu.collectives.eager import _jit_cache
+    _jit_cache.clear()
+    x = jnp.asarray(stacked())
+    eager.allreduce(x, op=hvd.Sum)
+    n_entries = len(_jit_cache)
+    eager.allreduce(x, op=hvd.Sum)
+    eager.allreduce(jnp.asarray(stacked(seed=3)), op=hvd.Sum)
+    assert len(_jit_cache) == n_entries  # same key reused
+
+
+def test_getattr_missing_submodule_is_attribute_error():
+    assert not hasattr(hvd, "models")  # not built yet; must not raise MNFE

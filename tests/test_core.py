@@ -1,0 +1,96 @@
+"""Core context tests — parity with the reference's basics surface
+(hvd.init/size/rank/local_rank/process sets; test/parallel/test_torch.py's
+init-and-introspect cases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_size():
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_init_idempotent():
+    ctx1 = hvd.core.context()
+    hvd.init()
+    assert hvd.core.context() is ctx1
+
+
+def test_build_introspection():
+    # Parity with basics.py nccl_built()/mpi_enabled()/... flags used by the
+    # reference's test skip-markers.
+    assert hvd.xla_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_enabled()
+    assert not hvd.gloo_enabled()
+
+
+def test_rank_host_level():
+    assert hvd.rank() == 0  # single process: first device index
+    assert hvd.local_rank() == 0
+
+
+def test_rank_in_graph():
+    """rank() inside shard_map returns the per-device axis index."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    def body(x):
+        return x + hvd.rank()
+
+    f = shard_map(body, mesh=hvd.mesh(), in_specs=P(hvd.RANK_AXIS),
+                  out_specs=P(hvd.RANK_AXIS))
+    out = f(jnp.zeros((8,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8))
+
+
+def test_process_set_registry():
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    assert ps.process_set_id > 0
+    assert ps.size() == 4
+    assert ps.included(2) and not ps.included(1)
+    assert ps.rank_in_set(4) == 2
+    # duplicate registration returns the same set
+    ps2 = hvd.add_process_set([6, 4, 2, 0])
+    assert ps2.process_set_id == ps.process_set_id
+    hvd.remove_process_set(ps)
+
+
+def test_process_set_validation():
+    with pytest.raises(ValueError):
+        hvd.add_process_set([])
+    with pytest.raises(ValueError):
+        hvd.add_process_set([0, 99])
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(0)
+
+
+def test_not_initialized_error():
+    hvd.shutdown()
+    with pytest.raises(hvd.core.NotInitializedError):
+        hvd.size()
+    hvd.init()
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1048576")
+    monkeypatch.setenv("HOROVOD_TIMELINE", "")
+    monkeypatch.setenv("HOROVOD_ADASUM_ACCUMULATE_FP64", "1")
+    cfg = hvd.Config.from_env()
+    assert cfg.fusion_threshold_bytes == 1048576
+    assert cfg.timeline_path is None
+    assert cfg.adasum_accumulate_dtype == "float64"
+    flags = cfg.xla_combiner_flags()
+    assert any("1048576" in f for f in flags)
