@@ -40,10 +40,12 @@ def __getattr__(name):
     # so importing the top level stays light.
     import importlib
     if name in ("optimizer", "elastic", "models", "parallel", "runner",
-                "tools", "ops", "utils"):
+                "tools", "ops", "utils", "train"):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                raise  # a real missing dependency inside the submodule
             raise AttributeError(
                 f"module 'horovod_tpu' has no attribute {name!r}") from e
     raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -81,15 +81,16 @@ def alltoall_v(tensor, splits, *, max_split: Optional[int] = None,
     splits = jnp.asarray(splits, jnp.int32)
     if max_split is None:
         max_split = tensor.shape[0]
-    # Clamp so a too-small max_split degrades to consistent truncation on
-    # both the data and the size side channel (compact_gathered stays in
-    # bounds) instead of silently corrupting neighbouring slots.
+    # Offsets come from the ORIGINAL splits (that is how the caller laid the
+    # rows out); only the per-chunk length is clamped, so a too-small
+    # max_split truncates each destination's tail consistently on both the
+    # data and the size side channel instead of shifting later chunks.
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(splits)[:-1]])
     splits = jnp.minimum(splits, max_split)
     # Pad the source so dynamic_slice never clamps into valid data.
     pad = jnp.zeros((max_split,) + tensor.shape[1:], tensor.dtype)
     src = jnp.concatenate([tensor, pad], axis=0)
-    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                               jnp.cumsum(splits)[:-1]])
 
     def take_chunk(off, count):
         start = (off,) + (0,) * (tensor.ndim - 1)

@@ -59,6 +59,17 @@ def _run(builder, cache_key, tensor, out_replicated: bool):
                               check_vma=False)
         jitted = jax.jit(shmapped)
         _jit_cache[key] = jitted
+    tl = ctx.timeline
+    if tl is not None:
+        # Host-side lifecycle recording (reference: timeline.cc phases).
+        # Under XLA the on-device phases live in the jax.profiler trace
+        # (tools/profiler.py); this records the host dispatch span.
+        name = str(cache_key[0]).upper()
+        tl.activity_start(name, "DISPATCH")
+        out = jitted(tensor)
+        tl.activity_end(name, "DISPATCH")
+        tl.mark_cycle()
+        return out
     return jitted(tensor)
 
 

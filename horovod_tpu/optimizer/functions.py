@@ -1,0 +1,107 @@
+"""State broadcast / join helpers.
+
+Reference parity: ``horovod/torch/functions.py`` (``broadcast_parameters``,
+``broadcast_optimizer_state``, ``broadcast_object``) and ``hvd.join()``
+(SURVEY.md §2.4, §5.4). In the reference these rank-0-broadcasts run once at
+startup/resume so all workers agree before training; ``join()`` lets ranks
+with uneven data exit a step gracefully.
+
+Under single-controller JAX, device arrays driven by one process are
+consistent by construction; divergence happens **across hosts** (each host
+may have restored different data, e.g. from per-host checkpoints or RNG).
+So these helpers broadcast host-process state via the coordination service
+(DCN), the analog of the reference's rank-0 MPI/NCCL broadcast.
+
+``join()`` has no SPMD analog (every device runs the same program), so the
+uneven-data capability is provided as :func:`join_allreduce` — a masked
+gradient average where ranks that ran out of data contribute zeros and the
+divisor counts only live ranks (the continue-flag psum design from
+SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..collectives import ops as _ops
+from ..collectives.eager import broadcast_ as _host_broadcast
+from ..core.process_sets import ProcessSet
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Make every host's copy of ``params`` identical to ``root_rank``'s
+    process. Call once after init / restore, like the reference's
+    ``hvd.broadcast_parameters(model.state_dict(), root_rank=0)``."""
+    return _host_broadcast(params, root_rank)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast optimizer state (momenta, step counters, ...) from
+    ``root_rank``'s process. Reference: broadcast_optimizer_state."""
+    return _host_broadcast(opt_state, root_rank)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Broadcast an arbitrary picklable Python object from ``root_rank``'s
+    process (reference: ``hvd.broadcast_object`` via cloudpickle + byte
+    allgather). Single-host: identity."""
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+    is_src = jax.process_index() == root_rank
+    payload = pickle.dumps(obj) if is_src else b""
+    # Length first (fixed shape), then padded byte buffer.
+    n = np.asarray([len(payload)], np.int32)
+    n = multihost_utils.broadcast_one_to_all(n, is_source=is_src)
+    buf = np.zeros((int(n[0]),), np.uint8)
+    if is_src:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    return pickle.loads(buf.tobytes())
+
+
+def join_allreduce(grads: Any, have_data, *,
+                   op: str = _ops.Average,
+                   axis_name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> Any:
+    """Uneven-data gradient reduction: the in-graph rendering of
+    ``hvd.join()``.
+
+    ``have_data`` is a per-rank bool/0-1 scalar: ranks whose data ran out
+    pass False and contribute zeros; the average divides by the number of
+    live ranks (not world size). When no rank has data the result is zeros.
+    Call every step inside the jitted loop; there is no separate join()
+    barrier because SPMD steps are barriers by construction.
+    """
+    if op not in (_ops.Sum, _ops.Average):
+        raise ValueError(f"join_allreduce supports Sum and Average, got {op}")
+    axis = _ops._axis(axis_name)
+    flag = jnp.asarray(have_data, jnp.float32)
+    live = jax.lax.psum(flag, axis) if process_set is None else \
+        jax.lax.psum(flag, axis,
+                     axis_index_groups=_ops._groups(process_set, axis))
+
+    def leaf(g):
+        contrib = g * flag.astype(g.dtype)
+        total = jax.lax.psum(
+            contrib, axis,
+            axis_index_groups=_ops._groups(process_set, axis))
+        if op == _ops.Average:
+            total = total / jnp.maximum(live, 1.0).astype(total.dtype)
+        return total
+
+    return jax.tree_util.tree_map(leaf, grads)
+
+
+def join(*, axis_name: Optional[str] = None) -> int:
+    """Eager parity shim for ``hvd.join()``. Under SPMD there is nothing to
+    negotiate; returns the last rank (the reference returns the last rank to
+    join). Provided so ported scripts run; for real uneven-data handling use
+    :func:`join_allreduce` inside the step."""
+    from horovod_tpu.core import context_api as _ctx
+    return _ctx.size() - 1

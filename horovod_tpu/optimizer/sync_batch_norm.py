@@ -1,0 +1,33 @@
+"""SyncBatchNorm — cross-replica batch normalisation.
+
+Reference parity: ``horovod/torch/sync_batch_norm.py`` (SURVEY.md §2.4,
+§2.6) — the reference allgathers per-worker batch statistics (sum, sum of
+squares, count) and normalises with the global mean/var.
+
+TPU-native: ``flax.linen.BatchNorm`` already supports exactly this via its
+``axis_name`` argument (a ``psum`` of the statistics inside the compiled
+graph — cheaper than the reference's allgather since only the reduced
+moments travel). ``SyncBatchNorm`` pins ``axis_name`` to the Horovod rank
+axis so a ported model gets cross-replica stats by default, and keeps the
+reference's constructor knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from ..core.context_api import RANK_AXIS
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """``flax.linen.BatchNorm`` that syncs batch statistics across the
+    Horovod rank axis (and any extra axes given in ``axis_name``).
+
+    Use inside a model traced under ``shard_map``/``pjit`` with the rank
+    axis in scope, exactly where the reference's module replaces
+    ``torch.nn.BatchNorm*d``.
+    """
+
+    axis_name: Optional[str] = RANK_AXIS

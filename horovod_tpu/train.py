@@ -1,0 +1,118 @@
+"""Data-parallel training harness — the minimum end-to-end slice.
+
+Reference parity: the training loop every Horovod example script assembles
+by hand (``examples/pytorch/pytorch_imagenet_resnet50.py``: init → broadcast
+params → per-step backward → DistributedOptimizer allreduce → step). Here the
+whole step is ONE compiled XLA program over the mesh: forward, backward,
+fused gradient allreduce, and the optimizer update all inside ``jit`` +
+``shard_map`` — data rides ICI, nothing bounces through the host.
+
+This module is deliberately small: models plug in as flax Modules, optimizers
+as optax transforms wrapped by ``horovod_tpu.optimizer.distributed``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from .core import context_api as _ctx
+from .optimizer import broadcast_parameters
+
+
+class TrainState(NamedTuple):
+    step: Any
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # {} for models without BatchNorm
+
+
+def create_train_state(model, rng, sample_input,
+                       optimizer: optax.GradientTransformation,
+                       broadcast: bool = True) -> TrainState:
+    """Init variables + optimizer state; broadcast from rank-0's process so
+    all hosts agree (reference: ``hvd.broadcast_parameters`` at startup)."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    if broadcast:
+        params = broadcast_parameters(params)
+        batch_stats = broadcast_parameters(batch_stats)
+    opt_state = optimizer.init(params)
+    return TrainState(jnp.zeros((), jnp.int32), params, opt_state,
+                      batch_stats)
+
+
+def make_train_step(model, optimizer: optax.GradientTransformation,
+                    loss_fn: Callable[[Any, Any], Any], *,
+                    axis_name: Optional[str] = None,
+                    mesh=None,
+                    donate: bool = True,
+                    scan_steps: Optional[int] = None):
+    """Build the jitted DP train step: ``step(state, batch, labels) ->
+    (state, loss)``. ``batch``/``labels`` are sharded over the rank axis,
+    state is replicated; the gradient allreduce happens inside ``optimizer``
+    (a ``horovod_tpu.optimizer.distributed`` transform).
+
+    ``scan_steps=k`` wraps k consecutive steps in a device-side ``lax.scan``
+    over the same batch (one dispatch, one sync) — used by benchmarks to
+    measure pure device throughput without host dispatch in the loop."""
+    mesh = mesh if mesh is not None else _ctx.mesh()
+    axis = axis_name or _ctx.context().axis_name
+
+    def sharded_step(state: TrainState, batch, labels):
+        def loss_of(params):
+            variables = {"params": params}
+            stats = state.batch_stats
+            use_stats = len(jax.tree_util.tree_leaves(stats)) > 0
+            if use_stats:
+                variables["batch_stats"] = stats
+                out, mutated = model.apply(variables, batch, train=True,
+                                           mutable=["batch_stats"])
+                new_stats = mutated["batch_stats"]
+            else:
+                out = model.apply(variables, batch, train=True)
+                new_stats = stats
+            return loss_fn(out, labels), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        loss = jax.lax.pmean(loss, axis)
+        # TrainState is declared replicated (out_specs P()); if the model's
+        # BatchNorm does not itself sync (axis_name=None), per-device stats
+        # would silently diverge — pmean makes them truly replicated (a
+        # no-op when the model already synced them).
+        new_stats = jax.tree_util.tree_map(
+            lambda s: jax.lax.pmean(s, axis), new_stats)
+        return TrainState(state.step + 1, params, opt_state,
+                          new_stats), loss
+
+    if scan_steps is not None:
+        inner = sharded_step
+
+        def sharded_step(state, batch, labels):  # noqa: F811
+            def body(st, _):
+                st, loss = inner(st, batch, labels)
+                return st, loss
+            state, losses = jax.lax.scan(body, state, None,
+                                         length=scan_steps)
+            return state, losses[-1]
+
+    step = _shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

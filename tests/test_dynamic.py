@@ -78,3 +78,29 @@ def test_alltoall_v():
             parts.append(data[r, start:start + splits[r, i]])
         expected = np.concatenate(parts) if parts else np.zeros((0, 2))
         np.testing.assert_allclose(dense, expected, rtol=1e-6)
+
+
+def test_alltoall_v_small_max_split_truncates_consistently():
+    """Too-small max_split must truncate tails, not shift later chunks."""
+    # every rank sends 5 rows to rank 0 and 3 rows to rank 1 (others 0)
+    splits = np.zeros((N, N), np.int32)
+    splits[:, 0] = 5
+    splits[:, 1] = 3
+    data = np.zeros((N, 8, 1), np.float32)
+    for r in range(N):
+        data[r, :, 0] = np.arange(8) + 100 * r
+
+    def body(x, s):
+        recv, rs = alltoall_v(x[0], s[0], max_split=4)
+        return recv[None], rs[None]
+
+    f = shard_map(body, mesh=hvd.mesh(), in_specs=(P(hvd.RANK_AXIS),) * 2,
+                  out_specs=(P(hvd.RANK_AXIS),) * 2, check_vma=False)
+    recv, rs = jax.jit(f)(jnp.asarray(data), jnp.asarray(splits))
+    recv, rs = np.asarray(recv), np.asarray(rs)
+    # rank0 gets first min(5,4)=4 rows of each sender's 0-offset chunk
+    np.testing.assert_array_equal(rs[0], np.full(N, 4))
+    np.testing.assert_array_equal(recv[0, :4, 0], [0, 1, 2, 3])
+    # rank1's chunk starts at offset 5 (the ORIGINAL split), rows 5,6,7
+    np.testing.assert_array_equal(rs[1], np.full(N, 3))
+    np.testing.assert_array_equal(recv[1, :3, 0], [5, 6, 7])

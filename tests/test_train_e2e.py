@@ -1,0 +1,108 @@
+"""Minimum end-to-end slice (SURVEY.md §7 step 4): ResNet DP training on the
+8-device mesh must match single-device training on the same global batch —
+the parity invariant the reference's examples rely on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNetTiny
+from horovod_tpu.optimizer import distributed
+from horovod_tpu.train import TrainState, create_train_state, make_train_step
+
+N = 8
+
+
+def xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(0)
+    images = rng.randn(N * 2, 8, 8, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(N * 2,))
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def test_dp_matches_single_device(data):
+    images, labels = data
+    model = ResNetTiny(num_classes=10, dtype=jnp.float32,
+                       axis_name=hvd.RANK_AXIS)
+    model_local = ResNetTiny(num_classes=10, dtype=jnp.float32,
+                             axis_name=None)
+    rng = jax.random.PRNGKey(42)
+
+    # --- single device, full batch ---
+    variables = model_local.init(rng, images, train=False)
+    opt = optax.sgd(0.1)
+    params, stats = variables["params"], variables["batch_stats"]
+    opt_state = opt.init(params)
+    losses_ref = []
+    for _ in range(3):
+        def loss_of(p):
+            out, mut = model_local.apply(
+                {"params": p, "batch_stats": stats}, images, train=True,
+                mutable=["batch_stats"])
+            return xent(out, labels), mut["batch_stats"]
+        (l, stats), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses_ref.append(float(l))
+
+    # --- DP over 8 devices, same global batch (2 images per rank) ---
+    dopt = distributed(optax.sgd(0.1))
+    state = create_train_state(model, rng, images[:1], dopt)
+    step = make_train_step(model, dopt, xent)
+    losses_dp = []
+    for _ in range(3):
+        state, loss = step(state, images, labels)
+        losses_dp.append(float(loss))
+
+    np.testing.assert_allclose(losses_dp, losses_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_train_step_without_batch_stats():
+    """Models without BatchNorm (empty batch_stats) train fine."""
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    rng = np.random.RandomState(1)
+    images = jnp.asarray(rng.randn(N * 2, 4, 4, 1).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=(N * 2,)))
+    dopt = distributed(optax.adam(1e-2))
+    state = create_train_state(model, jax.random.PRNGKey(0), images[:1], dopt)
+    step = make_train_step(model, dopt, xent)
+    prev = None
+    for _ in range(5):
+        state, loss = step(state, images, labels)
+        if prev is not None:
+            assert float(loss) < prev + 1.0
+        prev = float(loss)
+    assert int(state.step) == 5
+
+
+def test_loss_decreases_resnet(data):
+    images, labels = data
+    model = ResNetTiny(num_classes=10, dtype=jnp.float32,
+                       axis_name=hvd.RANK_AXIS)
+    dopt = distributed(optax.adam(1e-3))
+    state = create_train_state(model, jax.random.PRNGKey(7), images[:1], dopt)
+    step = make_train_step(model, dopt, xent)
+    first = None
+    for i in range(8):
+        state, loss = step(state, images, labels)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
