@@ -1,0 +1,110 @@
+"""DLRM: deep learning recommendation model with sharded embedding tables.
+
+Role: BASELINE.md config 5 (DLRM — sparse allgather/allreduce of embedding
+tables in the reference; the reference's examples do sparse-gradient
+allreduce via allgather of indices+values). TPU-native layout (the public
+DLRM-on-TPU recipe): the big embedding tables are MODEL-parallel — sharded
+over the ``ep`` axis (table-wise: table i lives on device i mod n) — while
+the dense MLPs are data-parallel; the per-batch exchange of looked-up
+embedding rows is an all_to_all in the compiled graph, which XLA derives
+from the sharding constraints below. Dense/sparse interaction is the
+standard pairwise dot-product feature interaction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+from .llama import _part
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    num_tables: int = 26
+    rows_per_table: int = 100000
+    embed_dim: int = 64
+    dense_features: int = 13
+    bottom_mlp: Sequence[int] = (512, 256, 64)
+    top_mlp: Sequence[int] = (512, 256, 1)
+    dtype: Any = jnp.float32
+
+
+def dlrm_criteo() -> DLRMConfig:
+    return DLRMConfig()
+
+
+def dlrm_tiny() -> DLRMConfig:
+    return DLRMConfig(num_tables=8, rows_per_table=64, embed_dim=8,
+                      dense_features=4, bottom_mlp=(16, 8),
+                      top_mlp=(16, 1))
+
+
+class MLPStack(nn.Module):
+    sizes: Sequence[int]
+    dtype: Any
+    final_act: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        for i, s in enumerate(self.sizes):
+            x = nn.Dense(s, dtype=self.dtype, name=f"fc{i}",
+                         kernel_init=_part(nn.initializers.lecun_normal(),
+                                           (None, None)))(x)
+            if i < len(self.sizes) - 1 or self.final_act:
+                x = nn.relu(x)
+        return x
+
+
+class DLRM(nn.Module):
+    """Inputs: dense [B, dense_features] float, sparse [B, num_tables] int
+    (one categorical id per table). Output: logit [B]."""
+
+    cfg: DLRMConfig
+
+    @nn.compact
+    def __call__(self, dense, sparse, train: bool = True):
+        c = self.cfg
+        # [tables, rows, dim] sharded table-wise over ep — the model-parallel
+        # half of the DLRM hybrid.
+        tables = self.param("embedding_tables",
+                            _part(nn.initializers.normal(0.01),
+                                  ("experts", None, None)),
+                            (c.num_tables, c.rows_per_table, c.embed_dim),
+                            jnp.float32)
+        B = dense.shape[0]
+        # bottom MLP on dense features (data parallel)
+        d = MLPStack(c.bottom_mlp, c.dtype, name="bottom")(
+            dense.astype(c.dtype))
+        if d.shape[-1] != c.embed_dim:
+            raise ValueError("bottom_mlp must end at embed_dim")
+        # sparse lookups: one row per table; gather over the table axis.
+        # vmap over tables, then constrain so the exchange to batch-sharded
+        # layout is one all_to_all.
+        looked = jax.vmap(lambda tab, idx: jnp.take(tab, idx, axis=0),
+                          in_axes=(0, 1), out_axes=1)(tables, sparse)
+        looked = nn_partitioning.with_sharding_constraint(
+            looked, ("batch", None, None))  # [B, tables, dim]
+        feats = jnp.concatenate([d[:, None, :], looked.astype(c.dtype)],
+                                axis=1)  # [B, 1+tables, dim]
+        # pairwise dot-product interaction (upper triangle, no diag)
+        inter = jnp.einsum("bnd,bmd->bnm", feats, feats)
+        n = feats.shape[1]
+        iu, ju = jnp.triu_indices(n, k=1)
+        inter = inter[:, iu, ju]  # [B, n*(n-1)/2]
+        top_in = jnp.concatenate([d, inter.astype(c.dtype)], axis=1)
+        out = MLPStack(c.top_mlp, c.dtype, final_act=False,
+                       name="top")(top_in)
+        return out[:, 0]
+
+
+def bce_loss(logits, labels):
+    """Binary cross entropy on click labels (the DLRM objective)."""
+    logits = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(logits, 0) - logits * labels +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))

@@ -1,0 +1,240 @@
+"""Llama-family decoder transformer, GSPMD-sharded (flagship model).
+
+Role: BASELINE.md config 3 (Llama-3 8B fine-tune — grad allreduce + Adasum
+over ICI rings). The reference has no model zoo of its own (it wraps user
+models); this framework ships the models its benchmark configs name, built
+TPU-first:
+
+- bf16 compute everywhere, fp32 params/optimizer (MXU-native);
+- parallelism by **sharding annotation, not code**: params carry logical
+  axis names (flax ``with_logical_partitioning``); activations get logical
+  constraints; a rule table maps logical axes → mesh axes (dp/fsdp/sp/tp),
+  and XLA inserts the collectives (psum for the DP grad sync, all-gathers
+  for fsdp, partial-sum psums for tp) — the scaling-book recipe;
+- ``lax.scan`` over layers + ``nn.remat`` for compile time and HBM;
+- GQA attention with RoPE; causal mask; SwiGLU MLP.
+
+For explicit-collective sequence parallelism (ring/Ulysses attention over an
+``sp`` axis) see ``horovod_tpu.parallel``; the GSPMD path shards the
+sequence axis of activations directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+# Logical → mesh axis rules (see parallel/mesh.py for axis vocabulary).
+LOGICAL_RULES = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("vocab", "tp"),
+    ("embed", None),
+    ("embed_fsdp", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("experts", "ep"),
+    ("layers", None),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    scan_layers: bool = True
+    tie_embeddings: bool = False
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama_tiny(vocab: int = 256) -> LlamaConfig:
+    """CPU-mesh test configuration."""
+    return LlamaConfig(vocab_size=vocab, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                       dtype=jnp.float32, remat=False, scan_layers=False)
+
+
+def _part(init, names):
+    return nn.with_logical_partitioning(init, names)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", _part(nn.initializers.ones_init(),
+                                          ("embed",)), (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+def rope(x, positions, theta: float):
+    """Rotary embedding on [..., T, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [.., T, half]
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+        axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.cfg
+        head_dim = c.dim // c.n_heads
+        B, T = x.shape[0], x.shape[1]
+        # 2-D kernels (merged head×head_dim out dim): flax initialises Dense
+        # kernels at their stored rank, so the logical names line up and
+        # 'heads'→tp shards the merged dim — identical layout to per-head
+        # sharding since head_dim is contiguous within each head.
+        dense = lambda feats, names, name: nn.Dense(
+            feats, use_bias=False, dtype=c.dtype, name=name,
+            kernel_init=_part(nn.initializers.lecun_normal(), names))
+        q = dense(c.n_heads * head_dim, ("embed", "heads"), "wq")(x)
+        k = dense(c.n_kv_heads * head_dim, ("embed", "kv_heads"), "wk")(x)
+        v = dense(c.n_kv_heads * head_dim, ("embed", "kv_heads"), "wv")(x)
+        q = q.reshape(B, T, c.n_heads, head_dim)
+        k = k.reshape(B, T, c.n_kv_heads, head_dim)
+        v = v.reshape(B, T, c.n_kv_heads, head_dim)
+        q = nn_partitioning.with_sharding_constraint(
+            q, ("batch", "seq", "heads", "head_dim"))
+        q = rope(q, positions, c.rope_theta)
+        k = rope(k, positions, c.rope_theta)
+        rep = c.n_heads // c.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        scale = 1.0 / jnp.sqrt(head_dim)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        o = o.reshape(B, T, c.n_heads * head_dim)
+        out = nn.Dense(
+            c.dim, use_bias=False, dtype=c.dtype, name="wo",
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              ("heads", "embed")))(o)
+        return nn_partitioning.with_sharding_constraint(
+            out, ("batch", "seq", "embed"))
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        dense = lambda feats, names, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=c.dtype, name=name,
+            kernel_init=_part(nn.initializers.lecun_normal(), names))
+        gate = dense(c.hidden_dim, ("embed", "mlp"), "w1")(x)
+        up = dense(c.hidden_dim, ("embed", "mlp"), "w3")(x)
+        h = nn.silu(gate) * up
+        h = nn_partitioning.with_sharding_constraint(h, ("batch", "seq", "mlp"))
+        return dense(c.dim, ("mlp", "embed"), "w2")(h)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.cfg
+        x = x + Attention(c, name="attn")(
+            RMSNorm(c.norm_eps, c.dtype, name="attn_norm")(x), positions)
+        x = x + MLP(c, name="mlp")(
+            RMSNorm(c.norm_eps, c.dtype, name="mlp_norm")(x))
+        return x
+
+
+class ScannedBlock(nn.Module):
+    """Block with (carry, broadcast) -> (carry, None) signature for
+    ``nn.scan`` over the layer axis."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return Block(self.cfg, name="block")(x, positions), None
+
+
+def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
+                  scanned_cls, extra_scan_collections=()):
+    """Shared decoder body (embedding → blocks → norm → lm head) used by
+    Llama and Mixtral; called from inside a module's compact ``__call__`` so
+    parameters stay flat under the calling module."""
+    emb = mdl.param("embedding",
+                    _part(nn.initializers.normal(0.02), ("vocab", "embed")),
+                    (c.vocab_size, c.dim), jnp.float32)
+    x = jnp.take(emb, tokens, axis=0).astype(c.dtype)
+    x = nn_partitioning.with_sharding_constraint(x, ("batch", "seq", "embed"))
+    positions = jnp.arange(tokens.shape[1])[None, :]
+
+    if c.scan_layers:
+        scanned = scanned_cls
+        if c.remat:
+            scanned = nn.remat(scanned_cls, prevent_cse=False)
+        variable_axes = {"params": 0}
+        for coll in extra_scan_collections:
+            variable_axes[coll] = 0
+        x, _ = nn.scan(
+            scanned,
+            variable_axes=variable_axes,
+            split_rngs={"params": True},
+            in_axes=nn.broadcast,
+            length=c.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(c, name="layers")(x, positions)
+    else:
+        block = nn.remat(block_cls, prevent_cse=False) if c.remat \
+            else block_cls
+        for i in range(c.n_layers):
+            x = block(c, name=f"block_{i}")(x, positions)
+    x = RMSNorm(c.norm_eps, c.dtype, name="final_norm")(x)
+    if c.tie_embeddings:
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), emb)
+    else:
+        logits = nn.DenseGeneral(
+            c.vocab_size, axis=-1, use_bias=False, dtype=jnp.float32,
+            name="lm_head",
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              ("embed", "vocab")))(x)
+    return logits
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        return decoder_trunk(self, self.cfg, tokens, Block, ScannedBlock)

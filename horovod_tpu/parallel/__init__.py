@@ -1,0 +1,13 @@
+from .mesh import AXIS_ORDER, axis_size, create_hybrid_mesh, create_mesh
+from .moe import (RouterOutput, expert_alltoall, expert_alltoall_back,
+                  routed_experts, topk_router)
+from .pipeline import pipeline
+from .ring import local_attention, ring_attention
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
+
+__all__ = [
+    "AXIS_ORDER", "axis_size", "create_hybrid_mesh", "create_mesh",
+    "RouterOutput", "expert_alltoall", "expert_alltoall_back",
+    "routed_experts", "topk_router", "pipeline", "local_attention",
+    "ring_attention", "heads_to_seq", "seq_to_heads", "ulysses_attention",
+]

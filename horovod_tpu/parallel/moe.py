@@ -1,0 +1,121 @@
+"""Expert parallelism: capacity-based MoE dispatch over an all_to_all axis.
+
+Reference parity (SURVEY.md §2.6): the reference ships the ``alltoall``
+primitive (nccl_operations.cc AllToAll, MPI_Alltoallv) but no MoE layer or
+router — EP is "primitive only". BASELINE.md config 4 (Mixtral-8x7B) demands
+the full path, built here the TPU way:
+
+- tokens are routed top-k with a capacity limit (Switch/GShard-style
+  one-hot dispatch tensors — all static shapes, MXU-friendly einsums);
+- experts are sharded over the ``ep`` mesh axis; the token exchange is ONE
+  ``lax.all_to_all`` each way over ICI (the exact op the reference exposes
+  but can only run host-side, here fused into the compiled graph);
+- the combine applies router probabilities on the way back.
+
+All functions run inside ``shard_map`` over the ep axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class RouterOutput(NamedTuple):
+    dispatch: jnp.ndarray   # [T, E, C] one-hot routing tensor
+    combine: jnp.ndarray    # [T, E, C] probability-weighted combine tensor
+    aux_loss: jnp.ndarray   # load-balancing auxiliary loss (scalar)
+
+
+def topk_router(router_logits, num_experts: int, capacity: int,
+                top_k: int = 2) -> RouterOutput:
+    """GShard-style top-k router with per-expert capacity.
+
+    Tokens beyond an expert's capacity are dropped (standard behavior;
+    combine weight 0 → they pass through the residual path).
+    """
+    T = router_logits.shape[0]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    # aux loss (Switch eq. 4): E * mean(frac_tokens * frac_probs)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top1, num_experts, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
+
+    dispatch = jnp.zeros((T, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((T, num_experts, capacity), jnp.float32)
+    # claimed positions per expert accumulate across the k choices
+    base_count = jnp.zeros((num_experts,), jnp.int32)
+    p_rem = probs
+    for _ in range(top_k):
+        choice = jnp.argmax(p_rem, axis=-1)                   # [T]
+        gate = jnp.take_along_axis(p_rem, choice[:, None], 1)[:, 0]
+        onehot = jax.nn.one_hot(choice, num_experts, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) - 1 + base_count[None, :]
+        pos_in_choice = jnp.take_along_axis(pos, choice[:, None], 1)[:, 0]
+        keep = pos_in_choice < capacity
+        d = (jax.nn.one_hot(choice, num_experts, dtype=jnp.float32)
+             [:, :, None] *
+             jax.nn.one_hot(jnp.clip(pos_in_choice, 0, capacity - 1),
+                            capacity, dtype=jnp.float32)[:, None, :])
+        d = d * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        base_count = base_count + jnp.sum(onehot, axis=0)
+        p_rem = p_rem * (1.0 - jax.nn.one_hot(choice, num_experts,
+                                              dtype=jnp.float32))
+    # renormalise combine weights over the selected experts (Mixtral style)
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return RouterOutput(dispatch, combine, aux_loss)
+
+
+def expert_alltoall(expert_inputs, axis_name: str):
+    """[E, C, D] (all experts' buffers on this device) -> [E_local, n*C, D]
+    (this device's experts, tokens from every device). One all_to_all."""
+    n = lax.axis_size(axis_name)
+    E, C, D = expert_inputs.shape
+    if E % n:
+        raise ValueError(f"experts {E} not divisible by ep axis size {n}")
+    x = lax.all_to_all(expert_inputs, axis_name, split_axis=0, concat_axis=1,
+                       tiled=True)  # [E/n, n*C, D]
+    return x
+
+
+def expert_alltoall_back(expert_outputs, axis_name: str):
+    """Inverse of :func:`expert_alltoall`: [E_local, n*C, D] -> [E, C, D]."""
+    return lax.all_to_all(expert_outputs, axis_name, split_axis=1,
+                          concat_axis=0, tiled=True)
+
+
+def routed_experts(x, router_logits, expert_fn: Callable, *,
+                   axis_name: Optional[str], num_experts: int,
+                   capacity_factor: float = 1.25, top_k: int = 2,
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full MoE layer body: route → all_to_all → experts → all_to_all → combine.
+
+    x: [T, D] local tokens; router_logits: [T, E];
+    ``expert_fn(expert_inputs)`` maps [E_local, tokens, D] -> same (vmapped
+    per-expert weights live in the caller's closure).
+    Returns (output [T, D], aux_loss scalar).
+    With ``axis_name=None`` runs single-device (all experts local).
+    """
+    T, D = x.shape
+    n = lax.axis_size(axis_name) if axis_name else 1
+    capacity = max(1, int(capacity_factor * top_k * T / num_experts))
+    r = topk_router(router_logits, num_experts, capacity, top_k)
+    # [T,E,C] x [T,D] -> [E,C,D]
+    dispatched = jnp.einsum("tec,td->ecd", r.dispatch,
+                            x.astype(jnp.float32)).astype(x.dtype)
+    if axis_name:
+        dispatched = expert_alltoall(dispatched, axis_name)  # [E/n, n*C, D]
+    out = expert_fn(dispatched)
+    if axis_name:
+        out = expert_alltoall_back(out, axis_name)           # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", r.combine,
+                   out.astype(jnp.float32)).astype(x.dtype)
+    return y, r.aux_loss

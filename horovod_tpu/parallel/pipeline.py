@@ -1,0 +1,60 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Capability-NEW vs the reference (SURVEY.md §2.6: "PP — absent"). TPU-native
+shape: each device along the ``pp`` mesh axis owns one stage's parameters;
+activations hand off between neighbouring stages with ``lax.ppermute`` (one
+ICI hop); microbatches keep every stage busy except the fill/drain bubble
+(bubble fraction = (n_stages-1)/(n_micro+n_stages-1)).
+
+This is the explicit shard_map rendering (every transfer visible, in the
+spirit of this framework); run it inside ``shard_map`` over the pp axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline(stage_fn: Callable, stage_params, x_microbatches,
+             axis_name: str):
+    """Run microbatches through the pipeline.
+
+    stage_fn(params, x) -> y     (all stages same signature/shapes)
+    stage_params: this device's stage parameters (stage i on rank i)
+    x_microbatches: [M, ...] microbatches — only rank 0's value is consumed;
+    returns [M, ...] outputs valid on the LAST rank (replicate/collect as
+    needed by the caller).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    total = M + n - 1  # fill + drain
+    fwd_perm = [(r, (r + 1) % n) for r in range(n)]
+
+    buf = jnp.zeros_like(x_microbatches[0])
+    outs = jnp.zeros((M,) + x_microbatches.shape[1:],
+                     x_microbatches.dtype)
+
+    def body(t, carry):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (while t < M); others use received buf
+        feed = jnp.where(t < M, t, M - 1)
+        x_in = jnp.where(idx == 0, x_microbatches[feed], buf)
+        y = stage_fn(stage_params, x_in)
+        # last stage records its result for microbatch (t - n + 1)
+        mb = t - (n - 1)
+        valid = (idx == n - 1) & (mb >= 0)
+        outs = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(outs, y, jnp.clip(mb, 0, M - 1),
+                                            0),
+            outs)
+        buf = lax.ppermute(y, axis_name, fwd_perm)
+        return buf, outs
+
+    _, outs = lax.fori_loop(0, total, body, (buf, outs))
+    return outs

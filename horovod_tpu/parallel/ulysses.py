@@ -1,0 +1,53 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+Capability-NEW vs the reference (SURVEY.md §5.7): the reference exposes the
+``alltoall`` primitive Ulysses needs but has no sequence-parallel layer. The
+scheme (DeepSpeed-Ulysses, public): activations arrive sequence-sharded
+[B, T/n, H, D]; one all_to_all re-shards them head-sharded [B, T, H/n, D] so
+each device runs FULL-sequence attention for its head subset; a second
+all_to_all restores sequence sharding. Cost: two all_to_alls of the
+activation tensor per attention layer, riding ICI; attention itself needs no
+communication (contrast ring.py, which trades that for n ppermute hops of
+K/V only).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .ring import local_attention
+
+
+def seq_to_heads(x, axis_name: str):
+    """[B, T_local, H, D] -> [B, T_global, H_local, D] via one all_to_all."""
+    n = lax.axis_size(axis_name)
+    B, t, H, D = x.shape
+    if H % n:
+        raise ValueError(f"head count {H} not divisible by sp axis size {n}")
+    # split heads across the axis, concatenate sequence
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str):
+    """[B, T_global, H_local, D] -> [B, T_local, H, D] (inverse)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """Sequence-parallel attention via head scatter, inside ``shard_map``
+    over ``axis_name``. q/k/v: [B, T_local, H, D]; returns the same shape.
+    ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to the exact
+    full-sequence attention (swap in a Pallas flash kernel on TPU)."""
+    attn = attn_fn or local_attention
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    oh = attn(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(oh, axis_name)

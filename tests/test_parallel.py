@@ -1,0 +1,181 @@
+"""Parallelism-primitive tests: ring attention, Ulysses, MoE dispatch,
+pipeline — each checked against a single-device oracle (SURVEY.md §4 pattern:
+CPU mesh as the universal fake backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import (create_mesh, local_attention, pipeline,
+                                  ring_attention, routed_experts,
+                                  topk_router, ulysses_attention)
+
+N = 8
+
+
+def sp_mesh():
+    return create_mesh({"sp": N})
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_local(causal):
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 32, 4, 8
+    q = rng.randn(B, T, H, D).astype(np.float32) * 0.5
+    k = rng.randn(B, T, H, D).astype(np.float32) * 0.5
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    ref = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=causal))
+
+    mesh = sp_mesh()
+
+    def body(qb, kb, vb):
+        return ring_attention(qb, kb, vb, "sp", causal=causal)
+
+    f = jax.jit(shard_map(body, mesh=mesh,
+                          in_specs=(P(None, "sp"),) * 3,
+                          out_specs=P(None, "sp"), check_vma=False))
+    out = np.asarray(f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_matches_local():
+    rng = np.random.RandomState(1)
+    B, T, H, D = 2, 32, 8, 4
+    q = rng.randn(B, T, H, D).astype(np.float32) * 0.5
+    k = rng.randn(B, T, H, D).astype(np.float32) * 0.5
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    ref = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=True))
+    mesh = sp_mesh()
+
+    def body(qb, kb, vb):
+        return ulysses_attention(qb, kb, vb, "sp", causal=True)
+
+    f = jax.jit(shard_map(body, mesh=mesh,
+                          in_specs=(P(None, "sp"),) * 3,
+                          out_specs=P(None, "sp"), check_vma=False))
+    out = np.asarray(f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_count_validation():
+    mesh = sp_mesh()
+
+    def body(q):
+        from horovod_tpu.parallel import seq_to_heads
+        return seq_to_heads(q, "sp")
+
+    f = shard_map(body, mesh=mesh, in_specs=P(None, "sp"),
+                  out_specs=P(None, "sp"), check_vma=False)
+    with pytest.raises(ValueError):
+        f(jnp.zeros((2, 16, 6, 4)))  # 6 heads not divisible by 8
+
+
+# ---------------- MoE ----------------
+
+def test_topk_router_shapes_and_capacity():
+    rng = np.random.RandomState(2)
+    T, E, C = 16, 4, 3
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    r = topk_router(logits, E, C, top_k=2)
+    d = np.asarray(r.dispatch)
+    assert d.shape == (T, E, C)
+    # no slot double-booked
+    assert (d.sum(0) <= 1.0 + 1e-6).all()
+    # each token dispatched at most twice (may be dropped on overflow)
+    assert (d.sum((1, 2)) <= 2 + 1e-6).all()
+    assert np.isfinite(float(r.aux_loss))
+
+
+def test_routed_experts_single_device_identity_expert():
+    """With identity experts and top-1 routing (no drops), MoE output ==
+    input (combine weights renormalised to 1)."""
+    rng = np.random.RandomState(3)
+    T, D, E = 8, 4, 2
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    y, aux = routed_experts(x, logits, lambda e: e, axis_name=None,
+                            num_experts=E, capacity_factor=8.0, top_k=1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_routed_experts_ep_matches_single_device():
+    """Expert-parallel dispatch over 8 devices == single-device MoE."""
+    rng = np.random.RandomState(4)
+    Tl, D, E = 8, 6, 8  # per-device tokens; one expert per device
+    x = rng.randn(N, Tl, D).astype(np.float32)
+    logits = rng.randn(N, Tl, E).astype(np.float32)
+    # per-expert scale weights: expert e multiplies by (e+1)
+    scales = np.arange(1, E + 1, dtype=np.float32)
+
+    def single_device_moe(xl, ll):
+        def expert_fn(einp):  # [E, C, D]
+            return einp * scales[:, None, None]
+        return routed_experts(jnp.asarray(xl), jnp.asarray(ll), expert_fn,
+                              axis_name=None, num_experts=E,
+                              capacity_factor=8.0, top_k=2)[0]
+
+    ref = np.stack([np.asarray(single_device_moe(x[r], logits[r]))
+                    for r in range(N)])
+
+    mesh = create_mesh({"ep": N})
+
+    def body(xb, lb):
+        local_scales = jnp.asarray(scales).reshape(N, 1)[
+            jax.lax.axis_index("ep")]
+
+        def expert_fn(einp):  # [E/n=1, n*C, D]
+            return einp * local_scales[:, None, None]
+
+        y, aux = routed_experts(xb[0], lb[0], expert_fn, axis_name="ep",
+                                num_experts=E, capacity_factor=8.0, top_k=2)
+        return y[None]
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("ep"), P("ep")),
+                          out_specs=P("ep"), check_vma=False))
+    out = np.asarray(f(jnp.asarray(x), jnp.asarray(logits)))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+# ---------------- pipeline ----------------
+
+def test_pipeline_matches_sequential():
+    """8-stage pipeline of affine stages == sequential composition."""
+    rng = np.random.RandomState(5)
+    D, M = 4, 6  # feature dim, microbatches
+    Ws = rng.randn(N, D, D).astype(np.float32) * 0.3
+    bs = rng.randn(N, D).astype(np.float32) * 0.1
+    xs = rng.randn(M, 3, D).astype(np.float32)  # [M, B, D]
+
+    def stage_fn(params, x):
+        W, b = params
+        return jnp.tanh(x @ W + b)
+
+    # sequential oracle
+    ref = xs.copy()
+    for s in range(N):
+        ref = np.tanh(ref @ Ws[s] + bs[s])
+
+    mesh = create_mesh({"pp": N})
+
+    def body(W, b, x):
+        out = pipeline(stage_fn, (W[0], b[0]), x, "pp")
+        return out[None]
+
+    f = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P()),
+        out_specs=P("pp"), check_vma=False))
+    out = np.asarray(f(jnp.asarray(Ws), jnp.asarray(bs), jnp.asarray(xs)))
+    # result lands on the last stage (rank N-1)
+    np.testing.assert_allclose(out[N - 1], ref, rtol=2e-4, atol=2e-5)
