@@ -2,6 +2,7 @@ from . import eager
 from .adasum import adasum_allreduce, hierarchical_adasum
 from .compression import Compression
 from .dynamic import allgather_v, alltoall_v, compact_gathered
+from .join import iterate_with_join, join, join_allreduce, join_count
 from .ops import (Adasum, Average, Max, Min, Product, Sum, allgather,
                   allreduce, alltoall, barrier, broadcast, grouped_allgather,
                   grouped_allreduce, grouped_broadcast, grouped_reducescatter,
@@ -9,7 +10,8 @@ from .ops import (Adasum, Average, Max, Min, Product, Sum, allgather,
 
 __all__ = [
     "eager", "adasum_allreduce", "hierarchical_adasum", "Compression",
-    "allgather_v", "alltoall_v", "compact_gathered", "Adasum", "Average",
+    "allgather_v", "alltoall_v", "compact_gathered", "iterate_with_join",
+    "join", "join_allreduce", "join_count", "Adasum", "Average",
     "Max", "Min", "Product", "Sum", "allgather", "allreduce", "alltoall",
     "barrier", "broadcast", "grouped_allgather", "grouped_allreduce",
     "grouped_broadcast", "grouped_reducescatter", "reducescatter",

@@ -1,0 +1,128 @@
+"""``hvd.join()`` — graceful uneven-data exit.
+
+Reference parity: ``hvd.join`` (horovod/torch/mpi_ops.py ``join()``,
+``horovod/common/operations.cc`` JoinOp; SURVEY.md §2.4, §5.3). In the
+reference, a rank that runs out of data calls ``join()``; the background
+runtime keeps answering collectives on its behalf with zero contributions
+until every rank has joined, and ``join()`` returns the rank that joined
+last (used to pick whose parameters to trust afterwards).
+
+Under SPMD there is no background thread to impersonate a rank — every
+device runs the same compiled step — so join is re-expressed as data, not
+control flow (SURVEY.md §7 "hard parts": continue-flag psum +
+zero-contribution masking):
+
+- each rank carries a traced boolean ``active`` ("I still have data");
+- ``join_allreduce`` masks inactive contributions to zero and averages by
+  the *active* count, which is exactly what the reference's JoinOp makes
+  the collective compute;
+- ``join(active)`` returns (any_active, last_joined_rank) so the train
+  loop can stop when ``any_active`` is False — the moment the reference's
+  blocking ``join()`` would return on the last rank.
+
+The host-side generator :func:`iterate_with_join` wraps this for eager
+train loops over per-rank datasets of different lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.core import context_api as _ctx
+from .compression import Compression, Compressor
+from .ops import Average, Sum, _axis
+
+
+def join_count(active, *, axis_name: Optional[str] = None):
+    """Traced number of not-yet-joined ranks (int32 scalar, replicated)."""
+    axis = _axis(axis_name)
+    return lax.psum(jnp.asarray(active, jnp.int32), axis)
+
+
+def join(active, *, axis_name: Optional[str] = None):
+    """In-graph join poll.
+
+    Returns ``(any_active, last_joined_rank)``:
+
+    - ``any_active`` — traced bool, True while at least one rank still has
+      data (the loop-continue flag);
+    - ``last_joined_rank`` — highest rank index that is still active (the
+      rank that will join last under deterministic per-step draining), or
+      the reference's ``-1`` convention once nobody is active. Matches the
+      reference's use of the return value: "whose state is freshest".
+    """
+    axis = _axis(axis_name)
+    n = join_count(active, axis_name=axis)
+    idx = lax.axis_index(axis)
+    mine = jnp.where(jnp.asarray(active, jnp.bool_), idx.astype(jnp.int32),
+                     jnp.int32(-1))
+    last = lax.pmax(mine, axis)
+    return n > 0, last
+
+
+def join_allreduce(tensor: Any, active, op: str = Average, *,
+                   axis_name: Optional[str] = None,
+                   compression: Compressor = Compression.none) -> Any:
+    """Allreduce in which joined (inactive) ranks contribute zeros.
+
+    ``op=Average`` divides by the number of *active* ranks (clamped to 1
+    when everyone has joined), reproducing the reference JoinOp semantics:
+    gradients from exhausted ranks neither shift the mean nor stall the
+    step. Works on pytrees; jit/shard_map-compatible.
+    """
+    if op not in (Sum, Average):
+        raise ValueError("join_allreduce supports Sum and Average")
+    axis = _axis(axis_name)
+    n_active = join_count(active, axis_name=axis)
+    denom = jnp.maximum(n_active, 1)
+    act = jnp.asarray(active, jnp.bool_)
+
+    def leaf(x):
+        cx, cctx = compression.compress(x)
+        contrib = jnp.where(act, cx, jnp.zeros_like(cx))
+        y = lax.psum(contrib, axis)
+        if op == Average:
+            y = y / denom.astype(y.dtype if jnp.issubdtype(y.dtype, jnp.floating)
+                                 else jnp.float32)
+        return compression.decompress(y, cctx)
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def iterate_with_join(batches: Sequence[Any],
+                      total_steps: Optional[int] = None
+                      ) -> Iterable[Tuple[Any, Any]]:
+    """Host-side loop helper for uneven per-rank data (eager path).
+
+    ``batches`` is this process's list of per-step stacked batches, each
+    leaf shaped ``[size, ...]`` with a per-rank row (the eager-collective
+    convention). **Uneven lengths are declared, not inferred**: set
+    ``batches.per_rank_lengths = [steps_rank0, steps_rank1, ...]`` (any
+    sequence works; a helper list subclass suffices) — rank *r* is marked
+    inactive from step ``per_rank_lengths[r]`` onward, so whatever stale
+    rows it carries after that are masked to zero effect by
+    :func:`join_allreduce`. Without ``per_rank_lengths`` every rank is
+    assumed to own all ``len(batches)`` steps (even data; masks all-True).
+    ``total_steps`` defaults to ``len(batches)`` and should be
+    ``max(per_rank_lengths)`` for uneven data. Yields
+    ``(batch, active_mask)`` with ``active_mask`` a ``[size]`` bool array;
+    exhausted ranks are fed the last batch (masked to zero effect).
+
+    Single-controller JAX knows every rank's length up front, so unlike the
+    reference there is nothing to negotiate — the mask IS the protocol.
+    """
+    if not batches:
+        return
+    total = total_steps if total_steps is not None else len(batches)
+    lengths = getattr(batches, "per_rank_lengths", None)
+    if lengths is None:
+        lengths = [len(batches)] * _ctx.size()
+    for step in range(total):
+        active = np.asarray([step < l for l in lengths], dtype=bool)
+        b = batches[min(step, len(batches) - 1)]
+        yield b, jnp.asarray(active)

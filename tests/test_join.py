@@ -1,0 +1,110 @@
+"""join() uneven-data semantics (reference: test/parallel/test_torch.py
+join cases; SURVEY.md §7 "hard parts")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+import horovod_tpu as hvd
+from horovod_tpu.collectives.join import (iterate_with_join, join,
+                                          join_allreduce, join_count)
+
+AX = hvd.RANK_AXIS
+
+
+def _shmap(f, mesh, in_specs, out_specs):
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))
+
+
+def test_join_allreduce_masks_inactive(mesh8):
+    n = 8
+    # Ranks 0..5 active, 6..7 joined.
+    active = jnp.asarray([True] * 6 + [False] * 2)
+    x = jnp.arange(n, dtype=jnp.float32)  # rank r contributes r
+
+    def body(a, v):
+        return join_allreduce(v[0], a[0], hvd.Average)[None]
+
+    out = _shmap(body, mesh8, (P(AX), P(AX)), P(AX))(active, x)
+    # Average over active ranks only: (0+1+2+3+4+5)/6 = 2.5
+    np.testing.assert_allclose(np.asarray(out), 2.5)
+
+
+def test_join_allreduce_sum_all_joined(mesh8):
+    active = jnp.zeros(8, dtype=bool)
+    x = jnp.ones(8, dtype=jnp.float32)
+
+    def body(a, v):
+        return join_allreduce(v[0], a[0], hvd.Sum)[None]
+
+    out = _shmap(body, mesh8, (P(AX), P(AX)), P(AX))(active, x)
+    np.testing.assert_allclose(np.asarray(out), 0.0)  # everyone masked
+
+
+def test_join_poll_last_rank(mesh8):
+    active = jnp.asarray([True, True, False, True, False, False, False, False])
+
+    def body(a):
+        any_active, last = join(a[0])
+        return jnp.stack([any_active.astype(jnp.int32), last])[None]
+
+    out = np.asarray(_shmap(body, mesh8, P(AX), P(AX))(active))
+    assert out[0, 0] == 1          # someone still active
+    assert out[0, 1] == 3          # highest active rank
+
+
+def test_join_poll_nobody_active(mesh8):
+    active = jnp.zeros(8, dtype=bool)
+
+    def body(a):
+        any_active, last = join(a[0])
+        return jnp.stack([any_active.astype(jnp.int32), last])[None]
+
+    out = np.asarray(_shmap(body, mesh8, P(AX), P(AX))(active))
+    assert out[0, 0] == 0
+    assert out[0, 1] == -1         # reference convention: -1 when done
+
+
+def test_join_count(mesh8):
+    active = jnp.asarray([True] * 3 + [False] * 5)
+
+    def body(a):
+        return join_count(a[0])[None]
+
+    out = np.asarray(_shmap(body, mesh8, P(AX), P(AX))(active))
+    assert out[0] == 3
+
+
+def test_uneven_training_loop(mesh8):
+    """End-to-end: 8 ranks with dataset lengths 5..12; the masked-average
+    gradient equals the average over ranks that still have data."""
+    n = 8
+    lengths = list(range(5, 13))
+    steps = max(lengths)
+
+    class Batches(list):
+        pass
+
+    rng = np.random.RandomState(0)
+    bs = Batches(jnp.asarray(rng.randn(n).astype(np.float32))
+                 for _ in range(steps))
+    bs.per_rank_lengths = lengths
+
+    def body(a, v):
+        return join_allreduce(v[0], a[0], hvd.Average)[None]
+
+    f = _shmap(body, mesh8, (P(AX), P(AX)), P(AX))
+    for step, (batch, active) in enumerate(iterate_with_join(bs, steps)):
+        act = np.asarray(active)
+        expected = np.asarray(batch)[act].mean() if act.any() else 0.0
+        got = np.asarray(f(active, batch))[0]
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+    assert step == steps - 1
