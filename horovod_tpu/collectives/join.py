@@ -95,21 +95,22 @@ def join_allreduce(tensor: Any, active, op: str = Average, *,
 
 
 def iterate_with_join(batches: Sequence[Any],
-                      total_steps: Optional[int] = None
+                      total_steps: Optional[int] = None,
+                      per_rank_lengths: Optional[Sequence[int]] = None
                       ) -> Iterable[Tuple[Any, Any]]:
     """Host-side loop helper for uneven per-rank data (eager path).
 
     ``batches`` is this process's list of per-step stacked batches, each
     leaf shaped ``[size, ...]`` with a per-rank row (the eager-collective
-    convention). **Uneven lengths are declared, not inferred**: set
-    ``batches.per_rank_lengths = [steps_rank0, steps_rank1, ...]`` (any
-    sequence works; a helper list subclass suffices) — rank *r* is marked
-    inactive from step ``per_rank_lengths[r]`` onward, so whatever stale
-    rows it carries after that are masked to zero effect by
-    :func:`join_allreduce`. Without ``per_rank_lengths`` every rank is
-    assumed to own all ``len(batches)`` steps (even data; masks all-True).
-    ``total_steps`` defaults to ``len(batches)`` and should be
-    ``max(per_rank_lengths)`` for uneven data. Yields
+    convention). **Uneven lengths are declared, not inferred**: pass
+    ``per_rank_lengths=[steps_rank0, steps_rank1, ...]`` — rank *r* is
+    marked inactive from step ``per_rank_lengths[r]`` onward, so whatever
+    stale rows it carries after that are masked to zero effect by
+    :func:`join_allreduce`. (A ``batches.per_rank_lengths`` attribute is
+    also honoured for pre-bundled dataset objects.) Without lengths every
+    rank is assumed to own all ``len(batches)`` steps (even data; masks
+    all-True). ``total_steps`` defaults to ``max(per_rank_lengths)`` when
+    lengths are given, else ``len(batches)``. Yields
     ``(batch, active_mask)`` with ``active_mask`` a ``[size]`` bool array;
     exhausted ranks are fed the last batch (masked to zero effect).
 
@@ -118,8 +119,14 @@ def iterate_with_join(batches: Sequence[Any],
     """
     if not batches:
         return
-    total = total_steps if total_steps is not None else len(batches)
-    lengths = getattr(batches, "per_rank_lengths", None)
+    lengths = per_rank_lengths if per_rank_lengths is not None \
+        else getattr(batches, "per_rank_lengths", None)
+    if total_steps is not None:
+        total = total_steps
+    elif lengths is not None:
+        total = max(lengths)
+    else:
+        total = len(batches)
     if lengths is None:
         lengths = [len(batches)] * _ctx.size()
     for step in range(total):

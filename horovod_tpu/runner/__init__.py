@@ -1,0 +1,19 @@
+"""horovod_tpu.runner — launcher / CLI layer (reference L6, SURVEY.md §2.5).
+
+``python -m horovod_tpu.runner.launch`` (alias ``hvdrun``) replaces
+``horovodrun``; ``runner.run()`` replaces ``horovod.run()``. The Gloo HTTP
+rendezvous + per-GPU ssh workers of the reference collapse into per-host
+processes joined through the JAX coordination service over DCN (§2.7).
+"""
+
+from .api import run
+from .hosts import (HostAssignment, HostInfo, SlotInfo, get_host_assignments,
+                    parse_host_files, parse_hosts)
+from .launch import check_build, main, make_parser, parse_settings, run_commandline
+from .settings import Settings
+
+__all__ = [
+    "run", "HostAssignment", "HostInfo", "SlotInfo", "get_host_assignments",
+    "parse_host_files", "parse_hosts", "check_build", "main", "make_parser",
+    "parse_settings", "run_commandline", "Settings",
+]

@@ -1,0 +1,5 @@
+"""``python -m horovod_tpu.runner`` == ``hvdrun`` (reference: horovodrun)."""
+
+from .launch import main
+
+main()

@@ -1,0 +1,58 @@
+"""``horovod_tpu.runner.run()`` — launch a Python function on every host.
+
+Reference parity: ``horovod.run()`` (horovod/runner/__init__.py): pickle
+the function with cloudpickle, launch workers, collect per-process return
+values ordered by process id.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from . import secret
+from .exec_run import default_coordinator_addr, is_local, launch_job
+from .hosts import get_host_assignments, parse_hosts
+from .settings import Settings
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 1, hosts: Optional[str] = None,
+        settings: Optional[Settings] = None,
+        verbose: int = 0) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on every host process; returns the list
+    of per-process results (index == process id). Raises RuntimeError if
+    any worker fails, like the reference."""
+    import cloudpickle
+    s = settings or Settings(num_proc=np, verbose=verbose)
+    hs = parse_hosts(hosts) if hosts else parse_hosts(f"localhost:{np}")
+    assignments = get_host_assignments(hs, np)
+    if any(not is_local(a.hostname) for a in assignments):
+        # The pickled-fn/results handshake runs over a launcher-local tmp
+        # dir; remote hosts would need a shared FS plus a remote
+        # coordinator. Launch remote jobs as commands via the CLI
+        # (hvdrun), whose workers carry their own entrypoint.
+        raise NotImplementedError(
+            "runner.run() is single-host (function transport uses a local "
+            "tmp dir); use `python -m horovod_tpu.runner` for multi-host")
+    with tempfile.TemporaryDirectory(prefix="hvd_run_") as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump((fn, args, kwargs or {}), f)
+        command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
+                   fn_path, tmp]
+        code = launch_job(assignments, command, s,
+                          coordinator_addr=default_coordinator_addr(
+                              assignments, s),
+                          secret_key=secret.make_secret_key())
+        if code != 0:
+            raise RuntimeError(f"horovod_tpu.runner.run failed (exit {code})")
+        results = []
+        for a in assignments:
+            with open(os.path.join(tmp, f"result.{a.process_id}.pkl"),
+                      "rb") as f:
+                rcode, val = cloudpickle.load(f)
+            results.append(val)
+        return results

@@ -1,0 +1,200 @@
+"""Per-host worker launch: env wiring + command construction + job control.
+
+Reference parity: ``horovod/runner/gloo_run.py`` + ``mpi_run.py``
+(SURVEY.md §3.3). The reference execs one worker per slot over ssh with
+``HOROVOD_RANK/SIZE/GLOO_RENDEZVOUS_ADDR`` env; here one worker per *host*
+is execed with the JAX coordination-service coordinates
+(``HOROVOD_COORDINATOR_ADDR/NUM_PROCESSES/PROCESS_ID`` — consumed by
+``hvd.init()``, core/context_api.py), which replaces the Gloo HTTP
+rendezvous (§2.7). Command construction is pure (testable without ssh,
+reference test_run.py pattern); job control kills every host's tree on
+first failure.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from . import secret
+from .hosts import HostAssignment
+from .safe_shell_exec import execute
+from .settings import Settings
+
+#: env prefixes forwarded to workers by default (reference: launch.py
+#: env_util.is_exportable + HOROVOD_* passthrough).
+FORWARD_PREFIXES = ("HOROVOD_", "XLA_", "JAX_", "TPU_", "LIBTPU_", "PYTHON")
+
+
+def find_free_port(bind_host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((bind_host, 0))
+        return s.getsockname()[1]
+
+
+def get_run_env(a: HostAssignment, settings: Settings,
+                coordinator_addr: str, secret_key: Optional[bytes] = None
+                ) -> Dict[str, str]:
+    """Env for host-process ``a`` (a pure function of the assignment).
+
+    The HMAC secret only enters the env on the LOCAL spawn path (a child's
+    environ is not world-readable); the ssh path delivers it over stdin
+    instead — see :func:`get_ssh_command` — so it never appears in a
+    command line / ``ps`` output.
+    """
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(FORWARD_PREFIXES) or k in ("PATH", "HOME",
+                                                      "PYTHONPATH")}
+    env.update(settings.env)
+    env.update({
+        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_NUM_PROCESSES": str(a.num_processes),
+        "HOROVOD_PROCESS_ID": str(a.process_id),
+        "HOROVOD_SIZE": str(a.world_size),
+        "HOROVOD_LOCAL_SIZE": str(a.local_size),
+        "HOROVOD_FIRST_RANK": str(a.first_rank),
+        "HOROVOD_HOSTNAME": a.hostname,
+    })
+    if secret_key is not None:
+        env[secret.ENV_VAR] = secret.encode(secret_key)
+    return env
+
+
+def quoted_env_assignments(env: Dict[str, str],
+                           keys: Optional[Sequence[str]] = None) -> str:
+    ks = keys if keys is not None else sorted(env)
+    return " ".join(f"{k}={shlex.quote(env[k])}" for k in ks if k in env)
+
+
+def get_ssh_command(a: HostAssignment, command: Sequence[str],
+                    env: Dict[str, str], settings: Settings,
+                    cwd: Optional[str] = None,
+                    secret_on_stdin: bool = False) -> str:
+    """Build the ssh line for a remote host (reference: gloo_run.py
+    _exec_command_fn). Returned as a string for assertion-style tests.
+
+    ``secret_on_stdin``: the remote shell reads ``HOROVOD_SECRET_KEY`` from
+    its stdin (the launcher writes it via ``execute(stdin_data=...)``) so
+    the key never appears in ``ps``/``/proc/*/cmdline`` on either side.
+    """
+    ssh = ["ssh", "-o", "PasswordAuthentication=no",
+           "-o", "StrictHostKeyChecking=no"]
+    if settings.ssh_port:
+        ssh += ["-p", str(settings.ssh_port)]
+    if settings.ssh_identity_file:
+        ssh += ["-i", settings.ssh_identity_file]
+    if settings.extra_ssh_args:
+        ssh += settings.extra_ssh_args.split()
+    ssh.append(a.hostname)
+    inner = ""
+    if cwd:
+        inner += f"cd {shlex.quote(cwd)} && "
+    if secret_on_stdin:
+        inner += "IFS= read -r HOROVOD_SECRET_KEY && " \
+                 "export HOROVOD_SECRET_KEY && "
+    # Launcher-owned env goes over the wire: forwarded prefixes plus every
+    # key the user put in Settings.env (same set a local worker receives);
+    # the remote shell keeps its own PATH/HOME. The secret travels on
+    # stdin, never inline.
+    wire_env = {k: v for k, v in env.items()
+                if (k.startswith(FORWARD_PREFIXES) or k in settings.env)
+                and k != secret.ENV_VAR}
+    inner += f"env {quoted_env_assignments(wire_env)} "
+    inner += " ".join(shlex.quote(c) for c in command)
+    return " ".join(ssh) + " " + shlex.quote(inner)
+
+
+def is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def default_coordinator_addr(assignments: List[HostAssignment],
+                             settings: Settings) -> str:
+    """Coordinator = process 0's host. Local: bind host + a probed free
+    port; remote: the hostname + ``Settings.coordinator_port`` (or 29400,
+    the conventional JAX coordination-service port) since the launcher
+    cannot probe a remote port."""
+    host0 = assignments[0].hostname
+    if is_local(host0):
+        bind = settings.coordinator_bind_host
+        port = settings.coordinator_port or find_free_port(bind)
+        return f"{bind}:{port}"
+    port = settings.coordinator_port or int(
+        os.environ.get("HOROVOD_COORDINATOR_PORT", 29400))
+    return f"{host0}:{port}"
+
+
+def launch_job(assignments: List[HostAssignment], command: Sequence[str],
+               settings: Settings, coordinator_addr: Optional[str] = None,
+               secret_key: Optional[bytes] = None) -> int:
+    """Spawn one worker process per host; first failure tears down the rest
+    (reference: gloo_run launch loop + MPI's fate-sharing). Returns the
+    first non-zero exit code, else 0."""
+    if coordinator_addr is None:
+        coordinator_addr = default_coordinator_addr(assignments, settings)
+    stop = threading.Event()
+    codes: Dict[int, int] = {}
+    threads = []
+
+    # --start-timeout bounds STARTUP only (reference semantics): the first
+    # worker to exit (success or failure) arms nothing; a worker may run
+    # for days. Only `events` (peer failure / launcher shutdown) and an
+    # explicit job_timeout_s in Settings.env would bound the lifetime.
+    def run_one(a: HostAssignment):
+        env = get_run_env(a, settings, coordinator_addr, secret_key)
+        out = err = None
+        opened = []
+        if settings.output_filename:
+            os.makedirs(settings.output_filename, exist_ok=True)
+            out = open(os.path.join(settings.output_filename,
+                                    f"rank.{a.process_id}.stdout"), "w")
+            err = open(os.path.join(settings.output_filename,
+                                    f"rank.{a.process_id}.stderr"), "w")
+            opened = [out, err]
+        try:
+            if is_local(a.hostname):
+                code = execute(list(command), env=env, stdout=out, stderr=err,
+                               prefix=str(a.process_id) if settings.verbose
+                               else None,
+                               events=[stop])
+            else:
+                line = get_ssh_command(a, command, env, settings,
+                                       cwd=os.getcwd(),
+                                       secret_on_stdin=secret_key is not None)
+                code = execute(line, env=dict(os.environ), stdout=out,
+                               stderr=err,
+                               prefix=str(a.process_id) if settings.verbose
+                               else None,
+                               events=[stop],
+                               stdin_data=(secret.encode(secret_key) + "\n")
+                               .encode() if secret_key is not None else None)
+        finally:
+            for f in opened:
+                f.close()
+        codes[a.process_id] = code
+        if code != 0:
+            stop.set()
+
+    for a in assignments:
+        t = threading.Thread(target=run_one, args=(a,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    failures = {pid: c for pid, c in codes.items() if c != 0}
+    if failures:
+        # Prefer the originating failure (positive exit code) over peers the
+        # teardown itself signalled (negative = -signum), so the job reports
+        # the real culprit, as the reference's launcher does.
+        originating = {p: c for p, c in failures.items() if c > 0}
+        pick = originating or failures
+        pid = min(pick)
+        code = pick[pid]
+        print(f"[horovod_tpu.runner] process {pid} exited with code "
+              f"{code}; job torn down", file=sys.stderr)
+        return code if code > 0 else 128 - code
+    return 0

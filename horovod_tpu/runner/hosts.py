@@ -1,0 +1,120 @@
+"""Host/slot parsing and rank assignment.
+
+Reference parity: ``horovod/runner/common/util/hosts.py`` (parse_hosts,
+get_host_assignments) and the ``-H host1:4,host2:4`` CLI convention
+(SURVEY.md §2.5). Semantics preserved; the TPU twist is the process model:
+the reference launches one process per *slot* (GPU), while JAX is
+single-controller per host, so a slot here is a *device* and the launcher
+spawns one process per host that drives all of that host's slots. Rank
+bookkeeping (rank / local_rank / cross_rank / size) is identical — it is
+just computed per device and owned by the per-host process.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @staticmethod
+    def from_string(spec: str) -> "HostInfo":
+        m = re.fullmatch(r"([^:\s]+):(\d+)", spec.strip())
+        if not m:
+            raise ValueError(
+                f"bad host spec {spec!r}: expected 'hostname:slots'")
+        slots = int(m.group(2))
+        if slots < 1:
+            raise ValueError(f"bad host spec {spec!r}: slots must be >= 1")
+        return HostInfo(m.group(1), slots)
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse ``host1:2,host2:4`` (reference: hosts.parse_hosts)."""
+    if not hosts_string or not hosts_string.strip():
+        raise ValueError("empty hosts string")
+    return [HostInfo.from_string(s) for s in hosts_string.split(",") if s.strip()]
+
+
+def parse_host_files(path: str) -> str:
+    """Read an mpirun-style hostfile (``host slots=N`` per line) into the
+    ``-H`` comma form (reference: launch.py --hostfile handling)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.fullmatch(r"(\S+)(?:\s+slots\s*=\s*(\d+))?", line)
+            if not m:
+                raise ValueError(f"bad hostfile line: {line!r}")
+            out.append(f"{m.group(1)}:{m.group(2) or 1}")
+    return ",".join(out)
+
+
+@dataclass
+class SlotInfo:
+    """One device-rank's coordinates (reference: common/util/hosts.SlotInfo)."""
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+@dataclass
+class HostAssignment:
+    """Per-host process launch spec: the process owns a contiguous block of
+    device ranks ``[first_rank, first_rank + local_size)``."""
+    hostname: str
+    process_id: int        # == cross_rank of this host's process
+    num_processes: int     # total host processes
+    first_rank: int
+    local_size: int
+    world_size: int
+    slots: List[SlotInfo] = field(default_factory=list)
+
+
+def get_host_assignments(hosts: List[HostInfo],
+                         np_: Optional[int] = None
+                         ) -> List[HostAssignment]:
+    """Assign ranks host-major (reference: hosts.get_host_assignments).
+
+    ``np_`` caps the total ranks; hosts are filled in order. Raises when the
+    requested world size exceeds available slots, like the reference.
+    """
+    total = sum(h.slots for h in hosts)
+    world = np_ if np_ is not None else total
+    if world > total:
+        raise ValueError(
+            f"requested -np {world} but only {total} slots available "
+            f"({','.join(f'{h.hostname}:{h.slots}' for h in hosts)})")
+    if world < 1:
+        raise ValueError("world size must be >= 1")
+    assignments: List[HostAssignment] = []
+    rank = 0
+    used_hosts = []
+    for h in hosts:
+        if rank >= world:
+            break
+        take = min(h.slots, world - rank)
+        used_hosts.append((h, rank, take))
+        rank += take
+    n_proc = len(used_hosts)
+    for pid, (h, first, take) in enumerate(used_hosts):
+        a = HostAssignment(hostname=h.hostname, process_id=pid,
+                           num_processes=n_proc, first_rank=first,
+                           local_size=take, world_size=world)
+        a.slots = [SlotInfo(hostname=h.hostname, rank=first + i,
+                            local_rank=i, cross_rank=pid, size=world,
+                            local_size=take, cross_size=n_proc)
+                   for i in range(take)]
+        assignments.append(a)
+    return assignments

@@ -1,0 +1,139 @@
+"""``hvdrun`` — the ``horovodrun`` CLI rebuilt for TPU pods.
+
+Reference parity: ``horovod/runner/launch.py`` (SURVEY.md §3.3). Flag
+surface kept recognisable (``-np``, ``-H``, ``--hostfile``, ``--min-np/
+--max-np/--host-discovery-script`` for elastic, ``--start-timeout``,
+``--output-filename``, ``--verbose``, ``--check-build``); launch path is
+the per-host process model of exec_run.py instead of per-GPU ssh workers.
+
+Usage:
+    python -m horovod_tpu.runner.launch -np 8 -H a:4,b:4 python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import secret
+from .exec_run import default_coordinator_addr, launch_job
+from .hosts import get_host_assignments, parse_host_files, parse_hosts
+from .settings import Settings
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job across TPU hosts.")
+    p.add_argument("-np", "--num-proc", type=int, dest="np",
+                   help="total number of device ranks")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help="host list, e.g. host1:4,host2:4 (slots = chips)")
+    p.add_argument("--hostfile", dest="hostfile",
+                   help="mpirun-style hostfile (host slots=N per line)")
+    p.add_argument("--start-timeout", type=float, default=600.0,
+                   dest="start_timeout",
+                   help="seconds allowed for all workers to start and "
+                        "rendezvous (reference semantics; running jobs are "
+                        "never time-bounded)")
+    p.add_argument("--output-filename", dest="output_filename",
+                   help="directory for per-host rank.N.{stdout,stderr}")
+    p.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file")
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--check-build", action="store_true",
+                   help="print framework build info and exit")
+    # Elastic (reference: _run_elastic)
+    p.add_argument("--min-np", type=int, dest="min_np")
+    p.add_argument("--max-np", type=int, dest="max_np")
+    p.add_argument("--host-discovery-script", dest="host_discovery_script")
+    p.add_argument("--slots-per-host", type=int, default=1, dest="slots")
+    p.add_argument("--reset-limit", type=int, dest="reset_limit")
+    p.add_argument("--blacklist-cooldown", type=float,
+                   dest="blacklist_cooldown")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and args to launch on every host")
+    return p
+
+
+def check_build(file=sys.stdout) -> None:
+    """Reference parity: ``horovodrun --check-build`` capability matrix."""
+    import horovod_tpu as hvd
+    print("horovod_tpu v" + hvd.__version__, file=file)
+    print("""
+Available backends:
+    [X] XLA (TPU/CPU collectives over ICI/DCN)
+    [ ] NCCL (n/a on TPU; see SURVEY.md §2.7)
+    [ ] MPI  (replaced by the JAX coordination service)
+    [ ] Gloo (replaced by the JAX coordination service)
+
+Available features:
+    [X] allreduce / grouped_allreduce (+ Adasum, compression)
+    [X] allgather / allgather_v / broadcast / alltoall(_v) / reducescatter
+    [X] process sets
+    [X] join (uneven data)
+    [X] elastic
+""", file=file)
+
+
+def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
+    args = make_parser().parse_args(argv)
+    if args.check_build:
+        check_build()
+        raise SystemExit(0)
+    hosts_str = args.hosts
+    if args.hostfile:
+        hosts_str = parse_host_files(args.hostfile)
+    hosts = parse_hosts(hosts_str) if hosts_str else []
+    elastic = bool(args.host_discovery_script or args.min_np or args.max_np)
+    s = Settings(num_proc=args.np, hosts=hosts,
+                 ssh_port=args.ssh_port,
+                 ssh_identity_file=args.ssh_identity_file,
+                 start_timeout_s=args.start_timeout,
+                 verbose=args.verbose,
+                 output_filename=args.output_filename,
+                 elastic=elastic, min_np=args.min_np, max_np=args.max_np,
+                 host_discovery_script=args.host_discovery_script,
+                 slots_per_host=args.slots,
+                 reset_limit=args.reset_limit,
+                 blacklist_cooldown_s=args.blacklist_cooldown)
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise SystemExit("no command given; usage: hvdrun -np N [-H ...] "
+                         "python train.py")
+    s.validate()
+    return s, command
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    s, command = parse_settings(argv if argv is not None
+                                else sys.argv[1:])
+    if s.elastic:
+        try:
+            from ..elastic.driver import run_elastic
+        except ModuleNotFoundError as e:  # pragma: no cover
+            raise SystemExit(f"elastic launch unavailable: {e}")
+        return run_elastic(s, command)
+    hosts = s.hosts or parse_hosts(f"localhost:{s.num_proc}")
+    assignments = get_host_assignments(hosts, s.num_proc)
+    coord = default_coordinator_addr(assignments, s)
+    key = secret.make_secret_key()
+    if s.verbose:
+        plan = ", ".join(f"{a.hostname}(pid={a.process_id},"
+                         f"ranks={a.first_rank}..{a.first_rank + a.local_size - 1})"
+                         for a in assignments)
+        print(f"[hvdrun] world={assignments[0].world_size} coord={coord} "
+              f"hosts: {plan}")
+    return launch_job(assignments, command, s, coordinator_addr=coord,
+                      secret_key=key)
+
+
+def main() -> None:
+    raise SystemExit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

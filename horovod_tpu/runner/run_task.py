@@ -1,0 +1,36 @@
+"""Worker-side bootstrap for the ``horovod_tpu.runner.run()`` function API.
+
+Reference parity: ``horovod/runner/run_task.py`` — the launcher pickles the
+user function (cloudpickle), workers exec this module which loads and runs
+it, returning the result via a per-process file (the reference returns
+results over its task service; a results dir on a shared/local FS is the
+launcher-local equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(fn_path: str, results_dir: str) -> int:
+    import cloudpickle
+    with open(fn_path, "rb") as f:
+        fn, args, kwargs = cloudpickle.load(f)
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        result = fn(*args, **kwargs)
+        code = 0
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        result, code = None, 1
+    pid = os.environ.get("HOROVOD_PROCESS_ID", "0")
+    with open(os.path.join(results_dir, f"result.{pid}.pkl"), "wb") as f:
+        cloudpickle.dump((code, result), f)
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1], sys.argv[2]))

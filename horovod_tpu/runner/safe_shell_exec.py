@@ -1,0 +1,132 @@
+"""Subprocess execution with kill-tree cleanup.
+
+Reference parity: ``horovod/runner/common/util/safe_shell_exec.py``
+(SURVEY.md §2.5): run worker commands in their own process group, stream
+stdout/stderr, and guarantee no orphaned grandchildren on termination —
+the property the reference needs so a dying launcher never leaks workers.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import IO, Dict, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5.0
+
+
+def _tee(src: IO[bytes], sinks: List[IO]) -> None:
+    for line in iter(src.readline, b""):
+        for sink in sinks:
+            try:
+                if hasattr(sink, "buffer"):
+                    sink.buffer.write(line)
+                else:
+                    sink.write(line)
+                sink.flush()
+            except (ValueError, OSError):
+                pass
+    src.close()
+
+
+def terminate_process_group(proc: subprocess.Popen,
+                            grace_s: float = GRACEFUL_TERMINATION_TIME_S
+                            ) -> None:
+    """SIGTERM the child's process group, escalate to SIGKILL after grace."""
+    if proc.poll() is not None:
+        return
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.05)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def execute(command: "List[str] | str",
+            env: Optional[Dict[str, str]] = None,
+            stdout: Optional[IO] = None,
+            stderr: Optional[IO] = None,
+            prefix: Optional[str] = None,
+            events: Optional[List[threading.Event]] = None,
+            timeout_s: Optional[float] = None,
+            stdin_data: Optional[bytes] = None) -> int:
+    """Run ``command`` in a new process group; return its exit code.
+
+    ``events``: if any event is set, the process tree is torn down (the
+    reference uses this to propagate launcher shutdown to every worker).
+    ``prefix``: per-line tag, the reference's ``[1]<stdout>`` style.
+    ``timeout_s``: wall-clock cap on THIS process (used for bounded probes,
+    not worker lifetimes). ``stdin_data``: written to the child's stdin then
+    closed (secret delivery; keeps it off the command line).
+    """
+    shell = isinstance(command, str)
+    out_sink = stdout if stdout is not None else sys.stdout
+    err_sink = stderr if stderr is not None else sys.stderr
+    proc = subprocess.Popen(
+        command, shell=shell, env=env, start_new_session=True,
+        stdin=subprocess.PIPE if stdin_data is not None else subprocess.DEVNULL,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.flush()
+        except BrokenPipeError:
+            pass
+        finally:
+            proc.stdin.close()
+
+    sinks_out: List[IO] = [out_sink]
+    sinks_err: List[IO] = [err_sink]
+    if prefix is not None:
+        class _Prefixer:
+            def __init__(self, sink, tag):
+                self.sink, self.tag = sink, tag
+            def write(self, line: bytes):
+                text = line.decode("utf-8", "replace")
+                w = getattr(self.sink, "write")
+                w(f"{self.tag}{text}")
+            def flush(self):
+                self.sink.flush()
+        sinks_out = [_Prefixer(out_sink, f"[{prefix}]<stdout> ")]
+        sinks_err = [_Prefixer(err_sink, f"[{prefix}]<stderr> ")]
+
+    t_out = threading.Thread(target=_tee, args=(proc.stdout, sinks_out),
+                             daemon=True)
+    t_err = threading.Thread(target=_tee, args=(proc.stderr, sinks_err),
+                             daemon=True)
+    t_out.start(); t_err.start()
+
+    deadline = (time.monotonic() + timeout_s) if timeout_s else None
+    try:
+        while True:
+            if proc.poll() is not None:
+                break
+            if events and any(e.is_set() for e in events):
+                terminate_process_group(proc)
+                break
+            if deadline and time.monotonic() > deadline:
+                terminate_process_group(proc)
+                break
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            terminate_process_group(proc)
+    t_out.join(timeout=2)
+    t_err.join(timeout=2)
+    return proc.wait()

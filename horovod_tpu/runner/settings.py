@@ -1,0 +1,51 @@
+"""Launcher settings.
+
+Reference parity: ``horovod/runner/common/util/settings.py`` +
+``runner/elastic/settings.py`` (SURVEY.md §2.5/§5.6). One typed dataclass
+instead of the reference's pickled Settings objects; the elastic fields
+live here too so the elastic driver shares the same object.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .hosts import HostInfo
+
+
+@dataclass
+class Settings:
+    num_proc: Optional[int] = None           # -np (device ranks)
+    hosts: List[HostInfo] = field(default_factory=list)
+    ssh_port: Optional[int] = None
+    ssh_identity_file: Optional[str] = None
+    extra_ssh_args: Optional[str] = None
+    start_timeout_s: float = 600.0           # reference default --start-timeout
+    verbose: int = 0
+    output_filename: Optional[str] = None    # per-rank log dir
+    env: Dict[str, str] = field(default_factory=dict)   # passthrough env
+    coordinator_bind_host: str = "127.0.0.1"
+    coordinator_port: int = 0                # 0 = pick a free port
+    # Elastic (reference: elastic/settings.py)
+    elastic: bool = False
+    min_np: Optional[int] = None
+    max_np: Optional[int] = None
+    host_discovery_script: Optional[str] = None
+    discovery_interval_s: float = 1.0
+    slots_per_host: int = 1
+    reset_limit: Optional[int] = None        # max re-rendezvous before abort
+    blacklist_cooldown_s: Optional[float] = None
+    run_func_args: tuple = ()
+
+    def validate(self) -> None:
+        if self.elastic:
+            if not self.host_discovery_script and not self.hosts:
+                raise ValueError(
+                    "elastic mode needs --host-discovery-script or -H")
+            if (self.min_np and self.max_np
+                    and self.min_np > self.max_np):
+                raise ValueError("--min-np must be <= --max-np")
+        else:
+            if self.num_proc is None and not self.hosts:
+                raise ValueError("need -np and/or -H/--hostfile")

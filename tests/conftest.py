@@ -29,6 +29,11 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "integration: spawns real worker subprocesses")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_context():
     """Each test gets a fresh (re-)initialised context."""
