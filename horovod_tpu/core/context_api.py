@@ -89,8 +89,18 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                 int(os.environ["HOROVOD_PROCESS_ID"])
                 if "HOROVOD_PROCESS_ID" in os.environ else None)
             get_logger().info("joining coordination service at %s", coord)
+            # --start-timeout (launcher) bounds the rendezvous here, on the
+            # worker side, where "all peers joined" is actually observable.
+            kw = {}
+            if "HOROVOD_START_TIMEOUT" in os.environ:
+                try:
+                    kw["initialization_timeout"] = int(
+                        float(os.environ["HOROVOD_START_TIMEOUT"]))
+                except (TypeError, ValueError):
+                    pass
             jax.distributed.initialize(
-                coordinator_address=coord, num_processes=nproc, process_id=pid)
+                coordinator_address=coord, num_processes=nproc,
+                process_id=pid, **kw)
         cfg = config or Config.from_env()
         if "HOROVOD_FUSION_THRESHOLD" in os.environ:
             # Best-effort: forward the fusion threshold to XLA's collective

@@ -47,12 +47,30 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                           coordinator_addr=default_coordinator_addr(
                               assignments, s),
                           secret_key=secret.make_secret_key())
+
+        def load_result(a):
+            path = os.path.join(tmp, f"result.{a.process_id}.pkl")
+            if not os.path.exists(path):
+                return 1, None
+            with open(path, "rb") as f:
+                return cloudpickle.load(f)
+
         if code != 0:
-            raise RuntimeError(f"horovod_tpu.runner.run failed (exit {code})")
+            # Surface the first worker traceback (run_task pickles it as the
+            # failed result) instead of just an opaque exit code.
+            details = ""
+            for a in assignments:
+                rcode, val = load_result(a)
+                if rcode != 0 and isinstance(val, str):
+                    details = (f"\nworker {a.process_id} traceback:\n{val}")
+                    break
+            raise RuntimeError(
+                f"horovod_tpu.runner.run failed (exit {code}){details}")
         results = []
         for a in assignments:
-            with open(os.path.join(tmp, f"result.{a.process_id}.pkl"),
-                      "rb") as f:
-                rcode, val = cloudpickle.load(f)
+            rcode, val = load_result(a)
+            if rcode != 0:
+                raise RuntimeError(
+                    f"worker {a.process_id} reported failure: {val!r}")
             results.append(val)
         return results

@@ -25,9 +25,18 @@ from .hosts import HostAssignment
 from .safe_shell_exec import execute
 from .settings import Settings
 
-#: env prefixes forwarded to workers by default (reference: launch.py
-#: env_util.is_exportable + HOROVOD_* passthrough).
+#: env prefixes forwarded over ssh to REMOTE workers (host-specific vars like
+#: PATH/HOME/TMPDIR must not cross hosts; the remote shell supplies its own).
 FORWARD_PREFIXES = ("HOROVOD_", "XLA_", "JAX_", "TPU_", "LIBTPU_", "PYTHON")
+
+#: env vars never forwarded to any worker (reference: env_util.is_exportable
+#: blocklist). Local workers otherwise inherit the full launcher environ.
+#: PALLAS_AXON_/AXON_ are single-process accelerator-tunnel claims: a worker
+#: inheriting them would re-claim the launcher's chip and pre-register a
+#: 1-process topology, breaking the multi-process coordination world.
+BLOCKED_ENV = ("HOROVOD_SECRET_KEY", "BASH_FUNC_", "OLDPWD", "SSH_AUTH_SOCK",
+               "SSH_CONNECTION", "SSH_CLIENT", "SSH_TTY",
+               "PALLAS_AXON_", "AXON_")
 
 
 def find_free_port(bind_host: str = "127.0.0.1") -> int:
@@ -46,12 +55,15 @@ def get_run_env(a: HostAssignment, settings: Settings,
     instead — see :func:`get_ssh_command` — so it never appears in a
     command line / ``ps`` output.
     """
+    # Local spawn inherits the full launcher environ minus a blocklist
+    # (reference: env_util.is_exportable excludes, not includes); the ssh
+    # path later narrows this to FORWARD_PREFIXES — see get_ssh_command.
     env = {k: v for k, v in os.environ.items()
-           if k.startswith(FORWARD_PREFIXES) or k in ("PATH", "HOME",
-                                                      "PYTHONPATH")}
+           if not k.startswith(BLOCKED_ENV)}
     env.update(settings.env)
     env.update({
         "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_START_TIMEOUT": str(settings.start_timeout_s),
         "HOROVOD_NUM_PROCESSES": str(a.num_processes),
         "HOROVOD_PROCESS_ID": str(a.process_id),
         "HOROVOD_SIZE": str(a.world_size),
@@ -112,17 +124,52 @@ def is_local(hostname: str) -> bool:
     return hostname in ("localhost", "127.0.0.1", socket.gethostname())
 
 
+def routable_local_addr(remote_host: str) -> str:
+    """The local address a REMOTE host can reach this machine at (the
+    loopback bind host would point remote workers at their own lo). Probes
+    the routing table with a connected UDP socket (no packet is sent)."""
+    # UDP connect() never sends a packet — it only consults the routing
+    # table — so unresolvable/unreachable targets cost nothing. Probe the
+    # actual remote first, then any globally-routed address, then DNS.
+    for target in (remote_host, "8.8.8.8", "192.0.2.255"):
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((target, 9))
+                addr = s.getsockname()[0]
+            if not addr.startswith("127."):
+                return addr
+        except OSError:
+            continue
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        # Debian-style /etc/hosts maps the hostname to 127.0.1.1 — a
+        # loopback answer is exactly the wrong thing to advertise.
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return socket.gethostname()
+
+
 def default_coordinator_addr(assignments: List[HostAssignment],
                              settings: Settings) -> str:
-    """Coordinator = process 0's host. Local: bind host + a probed free
-    port; remote: the hostname + ``Settings.coordinator_port`` (or 29400,
-    the conventional JAX coordination-service port) since the launcher
-    cannot probe a remote port."""
+    """Coordinator = process 0's host. All-local job: bind host + a probed
+    free port. Mixed local+remote with a local process 0: a *routable*
+    local address (remote workers must be able to dial it). Remote process
+    0: the hostname + ``Settings.coordinator_port`` (or 29400, the
+    conventional JAX coordination-service port) since the launcher cannot
+    probe a remote port."""
     host0 = assignments[0].hostname
     if is_local(host0):
-        bind = settings.coordinator_bind_host
-        port = settings.coordinator_port or find_free_port(bind)
-        return f"{bind}:{port}"
+        remotes = [a.hostname for a in assignments
+                   if not is_local(a.hostname)]
+        if not remotes:
+            bind = settings.coordinator_bind_host
+            port = settings.coordinator_port or find_free_port(bind)
+            return f"{bind}:{port}"
+        addr = routable_local_addr(remotes[0])
+        port = settings.coordinator_port or find_free_port("0.0.0.0")
+        return f"{addr}:{port}"
     port = settings.coordinator_port or int(
         os.environ.get("HOROVOD_COORDINATOR_PORT", 29400))
     return f"{host0}:{port}"
@@ -145,39 +192,55 @@ def launch_job(assignments: List[HostAssignment], command: Sequence[str],
     # for days. Only `events` (peer failure / launcher shutdown) and an
     # explicit job_timeout_s in Settings.env would bound the lifetime.
     def run_one(a: HostAssignment):
-        env = get_run_env(a, settings, coordinator_addr, secret_key)
-        out = err = None
-        opened = []
-        if settings.output_filename:
-            os.makedirs(settings.output_filename, exist_ok=True)
-            out = open(os.path.join(settings.output_filename,
-                                    f"rank.{a.process_id}.stdout"), "w")
-            err = open(os.path.join(settings.output_filename,
-                                    f"rank.{a.process_id}.stderr"), "w")
-            opened = [out, err]
+        # Any launch-time exception (missing binary, unreachable output
+        # dir, ssh absent) must surface as a failure + teardown, never a
+        # silently dead thread with no codes[] entry (which would read as
+        # success while peers hang at rendezvous).
+        code = 1
         try:
-            if is_local(a.hostname):
-                code = execute(list(command), env=env, stdout=out, stderr=err,
-                               prefix=str(a.process_id) if settings.verbose
-                               else None,
-                               events=[stop])
-            else:
-                line = get_ssh_command(a, command, env, settings,
-                                       cwd=os.getcwd(),
-                                       secret_on_stdin=secret_key is not None)
-                code = execute(line, env=dict(os.environ), stdout=out,
-                               stderr=err,
-                               prefix=str(a.process_id) if settings.verbose
-                               else None,
-                               events=[stop],
-                               stdin_data=(secret.encode(secret_key) + "\n")
-                               .encode() if secret_key is not None else None)
+            env = get_run_env(a, settings, coordinator_addr, secret_key)
+            out = err = None
+            opened = []
+            if settings.output_filename:
+                os.makedirs(settings.output_filename, exist_ok=True)
+                out = open(os.path.join(settings.output_filename,
+                                        f"rank.{a.process_id}.stdout"), "w")
+                err = open(os.path.join(settings.output_filename,
+                                        f"rank.{a.process_id}.stderr"), "w")
+                opened = [out, err]
+            try:
+                if is_local(a.hostname):
+                    code = execute(list(command), env=env, stdout=out,
+                                   stderr=err,
+                                   prefix=str(a.process_id) if settings.verbose
+                                   else None,
+                                   events=[stop])
+                else:
+                    line = get_ssh_command(a, command, env, settings,
+                                           cwd=os.getcwd(),
+                                           secret_on_stdin=secret_key
+                                           is not None)
+                    code = execute(line, env=dict(os.environ), stdout=out,
+                                   stderr=err,
+                                   prefix=str(a.process_id) if settings.verbose
+                                   else None,
+                                   events=[stop],
+                                   stdin_data=(secret.encode(secret_key)
+                                               + "\n").encode()
+                                   if secret_key is not None else None)
+            finally:
+                for f in opened:
+                    f.close()
+        except BaseException:
+            import traceback
+            print(f"[horovod_tpu.runner] failed to launch process "
+                  f"{a.process_id} on {a.hostname}:", file=sys.stderr)
+            traceback.print_exc()
+            code = 1
         finally:
-            for f in opened:
-                f.close()
-        codes[a.process_id] = code
-        if code != 0:
-            stop.set()
+            codes[a.process_id] = code
+            if code != 0:
+                stop.set()
 
     for a in assignments:
         t = threading.Thread(target=run_one, args=(a,), daemon=True)

@@ -59,9 +59,13 @@ def make_parser() -> argparse.ArgumentParser:
 
 def check_build(file=sys.stdout) -> None:
     """Reference parity: ``horovodrun --check-build`` capability matrix."""
+    import importlib.util
+
     import horovod_tpu as hvd
+    elastic = "X" if importlib.util.find_spec(
+        "horovod_tpu.elastic") is not None else " "
     print("horovod_tpu v" + hvd.__version__, file=file)
-    print("""
+    print(f"""
 Available backends:
     [X] XLA (TPU/CPU collectives over ICI/DCN)
     [ ] NCCL (n/a on TPU; see SURVEY.md §2.7)
@@ -73,7 +77,7 @@ Available features:
     [X] allgather / allgather_v / broadcast / alltoall(_v) / reducescatter
     [X] process sets
     [X] join (uneven data)
-    [X] elastic
+    [{elastic}] elastic
 """, file=file)
 
 

@@ -25,7 +25,9 @@ def main(fn_path: str, results_dir: str) -> int:
     except BaseException:
         import traceback
         traceback.print_exc()
-        result, code = None, 1
+        # Ship the formatted traceback as the "result" so the launcher can
+        # raise with the real worker error, not just an exit code.
+        result, code = traceback.format_exc(), 1
     pid = os.environ.get("HOROVOD_PROCESS_ID", "0")
     with open(os.path.join(results_dir, f"result.{pid}.pkl"), "wb") as f:
         cloudpickle.dump((code, result), f)

@@ -195,3 +195,47 @@ def test_run_function_two_processes():
     # 2 processes × 8 forced-cpu devices each
     assert results[0][2] == results[1][2] == 16
     assert [r[3] for r in results] == [0, 1]
+
+
+def test_get_run_env_blocklist_and_timeout(monkeypatch):
+    """Full environ is inherited minus the blocklist; --start-timeout is
+    exported for worker-side rendezvous bounding."""
+    a = get_host_assignments(parse_hosts("localhost:1"))[0]
+    monkeypatch.setenv("HVD_TEST_RANDOM_VAR", "yes")
+    monkeypatch.setenv("SSH_AUTH_SOCK", "/tmp/agent.sock")
+    env = get_run_env(a, Settings(start_timeout_s=42.0), "c:1")
+    assert env["HVD_TEST_RANDOM_VAR"] == "yes"     # blocklist, not allowlist
+    assert "SSH_AUTH_SOCK" not in env
+    assert secret.ENV_VAR not in env
+    assert not any(k.startswith(("PALLAS_AXON_", "AXON_")) for k in env)
+    assert env["HOROVOD_START_TIMEOUT"] == "42.0"
+
+
+def test_coordinator_addr_routable_for_mixed_job(monkeypatch):
+    """A local process 0 with remote peers must advertise a routable
+    address, never the loopback bind host."""
+    from horovod_tpu.runner import exec_run
+    monkeypatch.setattr(exec_run, "routable_local_addr",
+                        lambda remote: "10.0.0.5")
+    mixed = get_host_assignments(parse_hosts("localhost:2,tpu-b:2"))
+    addr = exec_run.default_coordinator_addr(mixed, Settings())
+    host, port = addr.rsplit(":", 1)
+    assert host == "10.0.0.5"
+    assert 1024 <= int(port) <= 65535
+
+
+def test_routable_local_addr_never_loopback():
+    """Whatever the probe path, a loopback answer must not be returned
+    unless there is literally nothing else (then the hostname is)."""
+    from horovod_tpu.runner.exec_run import routable_local_addr
+    addr = routable_local_addr("host-that-does-not-resolve.invalid")
+    assert not addr.startswith("127.")
+
+
+def test_launch_job_surfaces_spawn_failure(tmp_path):
+    """A missing binary must yield a non-zero job exit, not silent success."""
+    from horovod_tpu.runner.exec_run import launch_job
+    a = get_host_assignments(parse_hosts("localhost:1"))
+    code = launch_job(a, ["/nonexistent/binary-xyz"], Settings(),
+                      coordinator_addr="127.0.0.1:1")
+    assert code != 0
