@@ -1,0 +1,36 @@
+"""horovod_tpu.elastic — fault-tolerant / dynamic-membership training.
+
+Reference parity (SURVEY.md §3.4, §5.3, §7 step 7): the elastic layer of
+``horovod/common/elastic.py`` + ``horovod/torch/elastic/`` +
+``horovod/runner/elastic/``, re-designed for TPU slices:
+
+- :func:`run` — the ``@hvd.elastic.run`` train-loop wrapper
+  (rollback/sync/retry; process-restart on membership change).
+- :class:`State` / :class:`ObjectState` / :class:`JaxState` — commit /
+  restore / sync state objects (``JaxState`` ≈ the reference's
+  ``TorchState``).
+- :class:`ElasticSampler` — re-shardable sampler that never drops or
+  repeats examples across resets.
+- :class:`ElasticDriver` / :func:`run_elastic` — launcher-side membership
+  watcher + generation relauncher (used by ``hvdrun --min-np/--max-np``).
+- :class:`HostDiscovery` / :class:`HostDiscoveryScript` — host discovery.
+"""
+
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .constants import ABORT_EXIT_CODE, RESTART_EXIT_CODE
+from .discovery import (FixedHostDiscovery, HostDiscovery,
+                        HostDiscoveryScript)
+from .driver import Blacklist, ElasticDriver, run_elastic
+from .run_fn import run
+from .sampler import ElasticSampler
+from .state import (JaxState, ObjectState, State, WorkerNotificationManager,
+                    notification_manager)
+
+__all__ = [
+    "ABORT_EXIT_CODE", "Blacklist", "ElasticDriver", "ElasticSampler",
+    "FixedHostDiscovery", "HorovodInternalError", "HostDiscovery",
+    "HostDiscoveryScript", "HostsUpdatedInterrupt", "JaxState",
+    "ObjectState", "RESTART_EXIT_CODE", "State",
+    "WorkerNotificationManager", "notification_manager", "run",
+    "run_elastic",
+]

@@ -1,0 +1,39 @@
+"""Elastic subsystem constants.
+
+Reference parity: ``horovod/runner/elastic/constants.py`` (SURVEY.md §2.5).
+"""
+
+#: Exit code a worker uses to request a coordinated relaunch with the new
+#: membership (graceful reset — NOT a failure). The reference re-inits comms
+#: in-process after HostsUpdatedInterrupt; a TPU slice cannot resize its
+#: process world in-process (the XLA backend pins the device topology at
+#: init), so the run_fn wrapper persists state and exits with this code and
+#: the driver relaunches everyone (see elastic/run_fn.py for the mapping).
+RESTART_EXIT_CODE = 73
+
+#: Worker exit code for "state is unrecoverable, do not relaunch me".
+ABORT_EXIT_CODE = 74
+
+#: env: address of the driver's coordinator service (host:port).
+COORD_ADDR_ENV = "HOROVOD_ELASTIC_COORD_ADDR"
+
+#: env: the membership version a worker generation was launched with.
+WORLD_VERSION_ENV = "HOROVOD_ELASTIC_WORLD_VERSION"
+
+#: env: directory state commits persist to across worker generations.
+COMMIT_DIR_ENV = "HOROVOD_ELASTIC_COMMIT_DIR"
+
+#: env: "restart" (default, TPU-true process-restart elasticity) or
+#: "inprocess" (re-init inside the worker process; valid only when the
+#: device topology is unchanged — used by the parity tests).
+MODE_ENV = "HOROVOD_ELASTIC_MODE"
+
+#: env: max resets before the wrapper/driver aborts.
+RESET_LIMIT_ENV = "HOROVOD_ELASTIC_RESET_LIMIT"
+
+#: seconds between worker polls of the coordinator's world version; commits
+#: more frequent than this reuse the cached answer.
+DEFAULT_POLL_INTERVAL_S = 0.2
+
+#: driver: how many failures (within the cooldown window) blacklist a host.
+BLACKLIST_STRIKES = 2

@@ -1,0 +1,76 @@
+"""Host discovery for elastic training.
+
+Reference parity: ``horovod/runner/elastic/discovery.py`` —
+``HostDiscovery`` (interface), ``HostDiscoveryScript`` (user script polled
+for the current host set), plus a fixed-list variant (SURVEY.md §3.4: the
+discovery thread polls the script ~every second). Script output format is
+the reference's: one host per line, ``hostname`` or ``hostname:slots``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict
+
+from ..core.logging import get_logger
+
+
+class HostDiscovery:
+    """Interface: return the currently-available hosts and their slots."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """Static host set (elastic restarts without membership change —
+    covers the 'failed worker on a fixed pool' scenario)."""
+
+    def __init__(self, hosts_and_slots: Dict[str, int]):
+        self._hosts = dict(hosts_and_slots)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script whose stdout lists available hosts.
+
+    Reference semantics preserved: non-zero exit or empty output means "no
+    hosts currently known" (the driver decides whether that is fatal via
+    min_np); a missing slots suffix uses the default slots per host.
+    """
+
+    def __init__(self, script: str, default_slots: int = 1,
+                 timeout_s: float = 10.0):
+        self._script = script
+        self._default_slots = max(1, default_slots)
+        self._timeout_s = timeout_s
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        try:
+            out = subprocess.run(
+                self._script, shell=True, capture_output=True,
+                timeout=self._timeout_s, text=True)
+        except subprocess.TimeoutExpired:
+            get_logger().warning("host discovery script timed out (%.1fs)",
+                                 self._timeout_s)
+            return {}
+        if out.returncode != 0:
+            get_logger().warning("host discovery script exited %d",
+                                 out.returncode)
+            return {}
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                name, _, slots = line.partition(":")
+                try:
+                    hosts[name.strip()] = max(1, int(slots))
+                except ValueError:
+                    get_logger().warning("bad discovery line %r", line)
+            else:
+                hosts[line] = self._default_slots
+        return hosts

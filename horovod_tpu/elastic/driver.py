@@ -1,0 +1,265 @@
+"""ElasticDriver: membership watching, generation relaunch, blacklisting.
+
+Reference parity: ``horovod/runner/elastic/driver.py`` (SURVEY.md §3.4 —
+the subsystem the rb-determined-ai fork centers on). Preserved semantics:
+
+- a discovery poll loop (~1 s) watching the available host set,
+- slot assignment over the effective hosts (min_np/max_np clamped),
+- worker (re)launch on failure or membership change,
+- host blacklisting after repeated failures (with optional cooldown
+  re-admission),
+- reset counting with ``--reset-limit`` abort.
+
+TPU delta: workers run in **generations**. A generation is the whole SPMD
+world launched for one membership view; any failure or membership change
+retires the generation (workers exit — RESTART_EXIT_CODE for graceful
+resets — and a new one launches over the updated hosts). In-generation
+state continuity comes from persisted commits (elastic/state.py), not from
+surviving processes, because a resized TPU world must recompile anyway.
+The reference's per-worker relaunch inside a live rendezvous is a
+GPU/Gloo-ism this design deliberately drops (SURVEY.md §7 step 7).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.logging import get_logger
+from ..runner import secret as _secret
+from ..runner.exec_run import (default_coordinator_addr, is_local,
+                               routable_local_addr, run_host_process)
+from ..runner.hosts import HostInfo, get_host_assignments
+from ..runner.settings import Settings
+from . import constants as C
+from .discovery import (FixedHostDiscovery, HostDiscovery,
+                        HostDiscoveryScript)
+from .service import CoordinatorService
+
+
+class Blacklist:
+    """Hosts with repeated failures are excluded; an optional cooldown
+    re-admits them (reference: elastic driver host blacklist)."""
+
+    def __init__(self, strikes: int = C.BLACKLIST_STRIKES,
+                 cooldown_s: Optional[float] = None):
+        self._strikes = max(1, strikes)
+        self._cooldown_s = cooldown_s
+        self._fails: Dict[str, List[float]] = {}
+        self._banned: Dict[str, float] = {}
+
+    def record_failure(self, host: str) -> None:
+        now = time.monotonic()
+        self._fails.setdefault(host, []).append(now)
+        if len(self._fails[host]) >= self._strikes:
+            get_logger().warning("blacklisting host %s after %d failures",
+                                 host, len(self._fails[host]))
+            self._banned[host] = now
+
+    def is_banned(self, host: str) -> bool:
+        if host not in self._banned:
+            return False
+        if (self._cooldown_s is not None
+                and time.monotonic() - self._banned[host] > self._cooldown_s):
+            del self._banned[host]
+            self._fails[host] = []
+            return False
+        return True
+
+    def filter(self, hosts: Dict[str, int]) -> Dict[str, int]:
+        return {h: s for h, s in hosts.items() if not self.is_banned(h)}
+
+
+class ElasticDriver:
+    """Drives generations of workers against a changing host set."""
+
+    def __init__(self, settings: Settings, command: Sequence[str],
+                 discovery: Optional[HostDiscovery] = None):
+        self._settings = settings
+        self._command = list(command)
+        if discovery is not None:
+            self._discovery = discovery
+        elif settings.host_discovery_script:
+            self._discovery = HostDiscoveryScript(
+                settings.host_discovery_script,
+                default_slots=settings.slots_per_host)
+        else:
+            self._discovery = FixedHostDiscovery(
+                {h.hostname: h.slots for h in settings.hosts})
+        self._blacklist = Blacklist(cooldown_s=settings.blacklist_cooldown_s)
+        self._key = _secret.make_secret_key()
+        self._service = CoordinatorService(self._key)
+        self._resets = 0
+
+    # -- membership ----------------------------------------------------------
+
+    def effective_hosts(self) -> Dict[str, int]:
+        return self._blacklist.filter(
+            self._discovery.find_available_hosts_and_slots())
+
+    def _target_np(self, hosts: Dict[str, int]) -> int:
+        total = sum(hosts.values())
+        if self._settings.max_np:
+            total = min(total, self._settings.max_np)
+        return total
+
+    def _enough(self, hosts: Dict[str, int]) -> bool:
+        need = self._settings.min_np or 1
+        return sum(hosts.values()) >= need
+
+    def wait_for_available_slots(self, timeout_s: Optional[float] = None
+                                 ) -> Dict[str, int]:
+        """Block until >= min_np slots are discoverable (reference:
+        driver.wait_for_available_slots)."""
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        while True:
+            hosts = self.effective_hosts()
+            if self._enough(hosts):
+                return hosts
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {self._settings.min_np or 1} "
+                    f"slots; discovered {hosts}")
+            time.sleep(self._settings.discovery_interval_s)
+
+    # -- generation launch ---------------------------------------------------
+
+    def _advertise_host(self, hosts: Dict[str, int]) -> str:
+        remotes = [h for h in hosts if not is_local(h)]
+        return routable_local_addr(remotes[0]) if remotes else "127.0.0.1"
+
+    def _launch_generation(self, hosts: Dict[str, int], version: int,
+                           commit_dir: str,
+                           stop: threading.Event) -> Dict[str, int]:
+        """Run one generation to completion; returns {hostname: exit_code}.
+
+        Modeled on runner.exec_run.launch_job (same env/ssh construction,
+        same fate-sharing teardown) but keyed by host and interruptible via
+        ``stop`` so the watch loop can retire a generation on membership
+        change."""
+        infos = [HostInfo(h, s) for h, s in sorted(hosts.items())]
+        np_ = self._target_np(hosts)
+        assignments = get_host_assignments(infos, np_)
+        used = {a.hostname for a in assignments}
+        coord = default_coordinator_addr(assignments, self._settings)
+        extra = {
+            C.COORD_ADDR_ENV: self._service.addr(
+                self._advertise_host(hosts)),
+            C.WORLD_VERSION_ENV: str(version),
+            C.COMMIT_DIR_ENV: commit_dir,
+            C.RESET_LIMIT_ENV: str(self._settings.reset_limit or 0),
+        }
+        out_dir = None
+        if self._settings.output_filename:
+            out_dir = os.path.join(self._settings.output_filename,
+                                   f"generation.{version}")
+        codes: Dict[str, int] = {}
+        lock = threading.Lock()
+
+        def run_one(a):
+            code = run_host_process(a, self._command, self._settings, coord,
+                                    self._key, stop, extra_env=extra,
+                                    output_dir=out_dir)
+            with lock:
+                codes[a.hostname] = code
+            # Fate sharing: first non-zero exit retires the whole
+            # generation. RESTART exits retire it too (that is their
+            # purpose) but are not failures.
+            if code != 0:
+                stop.set()
+
+        threads = [threading.Thread(target=run_one, args=(a,), daemon=True)
+                   for a in assignments]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {h: codes.get(h, 1) for h in used}
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self) -> int:
+        """The elastic job loop; returns the job's final exit code."""
+        s = self._settings
+        commit_dir = tempfile.mkdtemp(prefix="hvd_elastic_")
+        try:
+            while True:
+                try:
+                    hosts = self.wait_for_available_slots(s.start_timeout_s)
+                except TimeoutError as e:
+                    get_logger().error("%s", e)
+                    return 1
+                version = self._service.update_world(
+                    hosts, self._target_np(hosts))
+                get_logger().info(
+                    "launching generation v%d over %s (np=%d)", version,
+                    hosts, self._target_np(hosts))
+                stop = threading.Event()
+                watcher = threading.Thread(
+                    target=self._watch_membership,
+                    args=(hosts, version, stop), daemon=True)
+                watcher.start()
+                codes = self._launch_generation(hosts, version, commit_dir,
+                                                stop)
+                stop.set()
+                watcher.join()
+                result = self._classify(codes)
+                if result == "success":
+                    return 0
+                if result == "abort":
+                    return C.ABORT_EXIT_CODE
+                self._resets += 1
+                if s.reset_limit and self._resets >= s.reset_limit:
+                    get_logger().error(
+                        "reset limit %d reached; aborting", s.reset_limit)
+                    return C.ABORT_EXIT_CODE
+        finally:
+            self._service.close()
+
+    def _watch_membership(self, hosts: Dict[str, int], version: int,
+                          stop: threading.Event) -> None:
+        """Poll discovery while a generation runs. A LOST running host hard-
+        stops the generation; NEW capacity only bumps the version so workers
+        reset gracefully at their next commit. The loop keeps watching after
+        a gain (with an updated baseline) so a later host LOSS in the same
+        generation is still detected — e.g. an ssh session that hangs
+        instead of exiting would otherwise never trip fate-sharing."""
+        running = dict(hosts)
+        while not stop.is_set():
+            time.sleep(self._settings.discovery_interval_s)
+            if stop.is_set():
+                break
+            now = self.effective_hosts()
+            lost = [h for h in running if h not in now]
+            gained = [h for h in now if h not in running]
+            if lost:
+                get_logger().warning("hosts lost mid-generation: %s", lost)
+                self._service.update_world(now, self._target_np(now))
+                stop.set()
+            elif gained and self._target_np(now) > self._target_np(running):
+                get_logger().info("hosts gained: %s (graceful reset at next "
+                                  "commit)", gained)
+                self._service.update_world(now, self._target_np(now))
+                running = dict(now)
+
+    def _classify(self, codes: Dict[str, int]) -> str:
+        """Map a generation's exit codes to success / reset / abort, and
+        feed the blacklist."""
+        if all(c == 0 for c in codes.values()):
+            return "success"
+        if any(c == C.ABORT_EXIT_CODE for c in codes.values()):
+            return "abort"
+        for host, c in codes.items():
+            # Teardown SIGTERMs surface as negative codes; RESTART exits are
+            # graceful. Anything else is that host's own failure.
+            if c not in (0, C.RESTART_EXIT_CODE) and c > 0:
+                self._blacklist.record_failure(host)
+        return "reset"
+
+
+def run_elastic(settings: Settings, command: Sequence[str]) -> int:
+    """Entry point used by ``hvdrun`` (runner/launch.py)."""
+    return ElasticDriver(settings, command).run()

@@ -1,0 +1,103 @@
+"""``@hvd.elastic.run`` — the fault-tolerant training-loop wrapper.
+
+Reference parity: ``horovod/common/elastic.py run_fn`` (SURVEY.md §3.4):
+
+    FAILURE: collective error → HorovodInternalError → shutdown → re-init
+             → state.restore() (rollback) → retry
+    HOSTS UPDATED: driver notification → HostsUpdatedInterrupt at commit
+             → shutdown → re-init → state.sync() → retry
+
+TPU delta (the honest part): a JAX process cannot resize its device world
+in-process — the XLA backend pins topology at ``jax.distributed.initialize``
+— so "shutdown → re-init" comes in two modes (``HOROVOD_ELASTIC_MODE``):
+
+- ``restart`` (default, TPU-true): the wrapper persists state (commits
+  already did), then **exits the process** with ``RESTART_EXIT_CODE``. The
+  elastic driver relaunches the generation with the new membership and the
+  wrapper restores the newest on-disk commit before re-entering the train
+  function. Same observable loop as the reference, with the process
+  boundary where TPU reality puts it (slice membership change ⇒ recompile
+  anyway, SURVEY.md §7 "hard parts").
+- ``inprocess``: re-init inside the process (hvd.shutdown/init), valid when
+  the device topology is unchanged — single-host tests and same-size
+  worker replacement. This is the closest analog of the reference's gloo
+  re-rendezvous path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+from typing import Callable
+
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..core.logging import get_logger
+from . import constants as C
+from .state import State
+
+
+def _mode() -> str:
+    return os.environ.get(C.MODE_ENV, "restart")
+
+
+def _reset_limit() -> int:
+    try:
+        return int(os.environ.get(C.RESET_LIMIT_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def _reinitialize() -> None:
+    """In-process re-init (topology-unchanged path)."""
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorate ``func(state, *args, **kwargs)`` with the elastic loop."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        import horovod_tpu as hvd
+        if not hvd.is_initialized():
+            hvd.init()
+        from .state import notification_manager
+        notification_manager.init_from_env()
+        notification_manager.register()
+        # Process-restart resume: adopt the newest persisted commit (no-op
+        # when there is none or no commit dir is configured).
+        if hasattr(state, "load_latest") and state.load_latest():
+            get_logger().info("restored persisted elastic commit")
+        # A fresh generation starts from synced state (reference: run_fn
+        # syncs before the first call so late joiners match rank 0).
+        state.sync()
+        resets = 0
+        limit = _reset_limit()
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                get_logger().warning("collective failure: rolling back to "
+                                     "last commit")
+                if _mode() == "restart":
+                    # State was persisted at the last commit; ask the driver
+                    # for a relaunch with whatever membership is now alive.
+                    sys.exit(C.RESTART_EXIT_CODE)
+                state.restore()
+                _reinitialize()
+            except HostsUpdatedInterrupt as e:
+                get_logger().info("hosts updated: resetting")
+                if _mode() == "restart":
+                    sys.exit(C.RESTART_EXIT_CODE)
+                _reinitialize()
+                if not e.skip_sync:
+                    state.sync()
+            resets += 1
+            if limit and resets >= limit:
+                get_logger().error("reset limit %d reached; aborting", limit)
+                sys.exit(C.ABORT_EXIT_CODE)
+            state.on_reset()
+
+    return wrapper

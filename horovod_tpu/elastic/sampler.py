@@ -1,0 +1,103 @@
+"""ElasticSampler: rank-sharded sampling that survives resets.
+
+Reference parity: ``horovod/torch/elastic/sampler.py`` — shard the dataset
+across ranks, track processed indices, and on reset re-shard only the
+*remaining* indices over the new world size so no example is dropped or
+repeated within the epoch.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional, Sequence
+
+
+class ElasticSampler:
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0, rank: Optional[int] = None,
+                 num_replicas: Optional[int] = None):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        if rank is None or num_replicas is None:
+            from ..core import context_api as _ctx
+            rank = _ctx.cross_rank() if rank is None else rank
+            num_replicas = (_ctx.cross_size() if num_replicas is None
+                            else num_replicas)
+        self.rank = rank
+        self.num_replicas = max(1, num_replicas)
+        self._reset_indices()
+
+    # -- epoch / progress bookkeeping ---------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reset_indices()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark ``batch_size`` examples starting at local batch ``batch_idx``
+        as processed (reference API)."""
+        start = batch_idx * batch_size
+        self.record_indices(self.indices[start:start + batch_size])
+
+    def record_indices(self, indices: Sequence[int]) -> None:
+        self.processed_indices.extend(int(i) for i in indices)
+
+    # -- reset (world size changed) -----------------------------------------
+
+    def reset(self, rank: Optional[int] = None,
+              num_replicas: Optional[int] = None) -> None:
+        """Re-shard the REMAINING indices over the new world."""
+        if rank is not None:
+            self.rank = rank
+        if num_replicas is not None:
+            self.num_replicas = max(1, num_replicas)
+        self._reset_indices()
+
+    # -- iteration -----------------------------------------------------------
+
+    def _global_order(self) -> List[int]:
+        order = list(range(self.dataset_size))
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(order)
+        return order
+
+    def _reset_indices(self) -> None:
+        done = set(self.processed_indices)
+        remaining = [i for i in self._global_order() if i not in done]
+        # Pad to a multiple of num_replicas (reference behavior: wrap) so
+        # every rank yields the same count — a hard requirement under SPMD.
+        # Wrap REPEATEDLY: with fewer remaining examples than the pad size
+        # a single slice would under-fill and leave ranks uneven (epoch
+        # tails, e.g. 1 example over 4 ranks), hanging collectives.
+        n = len(remaining)
+        if n and n % self.num_replicas:
+            target = n + self.num_replicas - n % self.num_replicas
+            reps = -(-target // n)   # ceil
+            remaining = (remaining * reps)[:target]
+        self.indices = remaining[self.rank::self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    # -- (de)serialisation for State ----------------------------------------
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "processed_indices": list(self.processed_indices),
+                "seed": self.seed, "shuffle": self.shuffle,
+                "dataset_size": self.dataset_size}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.dataset_size = sd["dataset_size"]
+        self.seed = sd["seed"]
+        self.shuffle = sd["shuffle"]
+        self.epoch = sd["epoch"]
+        self.processed_indices = list(sd["processed_indices"])
+        self._reset_indices()

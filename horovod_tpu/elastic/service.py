@@ -1,0 +1,156 @@
+"""Driver↔worker coordination service for elastic training.
+
+Reference parity: this one HMAC-authenticated HTTP service collapses three
+reference components (SURVEY.md §2.5/§3.4):
+
+- ``runner/elastic/rendezvous.py`` (the re-init rendezvous KV store),
+- ``runner/elastic/registration.py`` (worker registration/last-seen),
+- ``runner/elastic/worker.py`` (WorkerNotificationService — driver→worker
+  host-update pushes).
+
+The push direction is inverted: instead of every worker hosting a
+notification server the driver registers with, workers cheaply poll the
+driver's ``/world`` for a monotonically-increasing membership *version* at
+``state.commit()`` (rate-limited). A version newer than the generation a
+worker was launched with means "hosts updated" → the state machinery raises
+``HostsUpdatedInterrupt``. This removes two RPC surfaces and all
+registration races while keeping the observable semantics: workers learn of
+membership changes at commit boundaries, exactly where the reference's
+interrupt lands (its notification also only takes effect at
+commit/check points).
+
+Wire format: JSON body + ``X-HVD-Sig`` HMAC (runner/secret.py) over the
+body, both directions. Replay within a job is harmless (monotonic version).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib import request as _urlreq
+
+from ..runner import secret as _secret
+
+SIG_HEADER = "X-HVD-Sig"
+
+
+class CoordinatorService:
+    """Launcher-side service holding the current membership view."""
+
+    def __init__(self, secret_key: bytes, bind_host: str = "0.0.0.0"):
+        self._key = secret_key
+        self._lock = threading.Lock()
+        self._version = 0
+        self._hosts: Dict[str, int] = {}
+        self._np = 0
+        self._started: Dict[int, float] = {}   # process_id -> monotonic ts
+
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(SIG_HEADER, _secret.sign(svc._key, body))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/world":
+                    with svc._lock:
+                        self._reply({"version": svc._version,
+                                     "hosts": svc._hosts, "np": svc._np})
+                else:
+                    self._reply({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                sig = self.headers.get(SIG_HEADER, "")
+                if not _secret.check(svc._key, body, sig):
+                    self._reply({"error": "bad signature"}, 403)
+                    return
+                msg = json.loads(body or b"{}")
+                if self.path == "/register":
+                    import time
+                    with svc._lock:
+                        svc._started[int(msg["process_id"])] = time.monotonic()
+                    self._reply({"ok": True})
+                else:
+                    self._reply({"error": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer((bind_host, 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def addr(self, advertise_host: str) -> str:
+        return f"{advertise_host}:{self.port}"
+
+    def update_world(self, hosts: Dict[str, int], np_: int) -> int:
+        """Publish a new membership view; returns the new version."""
+        with self._lock:
+            self._version += 1
+            self._hosts = dict(hosts)
+            self._np = np_
+            return self._version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def registered_workers(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._started)
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class CoordinatorClient:
+    """Worker-side client (used by the commit-time membership watcher)."""
+
+    def __init__(self, addr: str, secret_key: bytes, timeout_s: float = 5.0):
+        self._base = f"http://{addr}"
+        self._key = secret_key
+        self._timeout_s = timeout_s
+
+    def get_world(self) -> Optional[dict]:
+        """Current membership view, or None if the driver is unreachable
+        (workers treat that as 'no change' — the driver's process death
+        tears workers down anyway via the launch job)."""
+        try:
+            with _urlreq.urlopen(f"{self._base}/world",
+                                 timeout=self._timeout_s) as r:
+                body = r.read()
+                sig = r.headers.get(SIG_HEADER, "")
+            if not _secret.check(self._key, body, sig):
+                return None
+            return json.loads(body)
+        except OSError:
+            return None
+
+    def register(self, process_id: int) -> bool:
+        body = json.dumps({"process_id": process_id}).encode()
+        req = _urlreq.Request(
+            f"{self._base}/register", data=body,
+            headers={"Content-Type": "application/json",
+                     SIG_HEADER: _secret.sign(self._key, body)})
+        try:
+            with _urlreq.urlopen(req, timeout=self._timeout_s) as r:
+                return r.status == 200
+        except OSError:
+            return False

@@ -1,0 +1,300 @@
+"""Elastic state objects: commit / restore / sync.
+
+Reference parity (SURVEY.md §3.4, §5.3/§5.4): ``horovod/common/elastic.py``
+(``State``, ``ObjectState``) and ``horovod/torch/elastic/state.py``
+(``TorchState``). Semantics preserved:
+
+- ``commit()`` — snapshot the state (the in-memory checkpoint the training
+  loop rolls back to after a failure) and check for host updates.
+- ``restore()`` — roll back to the last commit (after
+  ``HorovodInternalError``).
+- ``sync()`` — make every worker identical to rank 0 (after membership
+  change, when no rollback is needed).
+- reset callbacks — user hooks run after a re-initialisation (the reference
+  uses these to rebuild samplers/optimizers for the new world size).
+
+TPU deltas:
+
+- Snapshots are **host copies** (``jax.device_get``) of array pytrees:
+  device buffers die with the mesh on reset, host snapshots do not.
+- When ``HOROVOD_ELASTIC_COMMIT_DIR`` is set (the elastic driver always
+  sets it), ``commit()`` also persists the snapshot to disk atomically on
+  rank 0. This is what makes **process-restart elasticity** (the TPU-true
+  mode — see elastic/run_fn.py) lossless: a relaunched generation restores
+  the latest on-disk commit instead of starting over. The reference keeps
+  commits purely in-memory because its workers survive resets; ours may not.
+- ``JaxState`` is the ``TorchState`` analog holding ``params``/``opt_state``
+  pytrees plus arbitrary scalar attrs (epoch, batch, ...).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..core.exceptions import HostsUpdatedInterrupt
+from ..core.logging import get_logger
+from . import constants as C
+
+
+class WorkerNotificationManager:
+    """Commit-time membership watcher (worker side).
+
+    Reference parity: ``horovod/runner/elastic/worker.py``'s
+    WorkerNotificationManager, with the push inverted into a rate-limited
+    poll of the driver's coordinator service (see elastic/service.py).
+    """
+
+    def __init__(self):
+        self._client = None
+        self._launch_version: Optional[int] = None
+        self._last_poll = 0.0
+        self._poll_interval_s = C.DEFAULT_POLL_INTERVAL_S
+        self._pending = False
+        self._lock = threading.Lock()
+
+    def init_from_env(self) -> None:
+        addr = os.environ.get(C.COORD_ADDR_ENV)
+        if not addr or self._client is not None:
+            return
+        from ..runner import secret as _secret
+        key_s = os.environ.get(_secret.ENV_VAR)
+        if not key_s:
+            return
+        from .service import CoordinatorClient
+        self._client = CoordinatorClient(addr, _secret.decode(key_s))
+        v = os.environ.get(C.WORLD_VERSION_ENV)
+        self._launch_version = int(v) if v else None
+
+    def check(self) -> None:
+        """Raise HostsUpdatedInterrupt if membership moved past the version
+        this worker generation was launched with."""
+        with self._lock:
+            if self._pending:
+                self._pending = False
+                raise HostsUpdatedInterrupt()
+            if self._client is None or self._launch_version is None:
+                return
+            now = time.monotonic()
+            if now - self._last_poll < self._poll_interval_s:
+                return
+            self._last_poll = now
+            world = self._client.get_world()
+            if world is not None and world["version"] > self._launch_version:
+                get_logger().info(
+                    "membership version %d > launch version %d: hosts updated",
+                    world["version"], self._launch_version)
+                # Don't re-raise forever on subsequent checks: the interrupt
+                # fires once per observed change.
+                self._launch_version = world["version"]
+                raise HostsUpdatedInterrupt()
+
+    def signal(self) -> None:
+        """Inject a host-update (tests / in-process driver)."""
+        with self._lock:
+            self._pending = True
+
+    def register(self) -> None:
+        """Announce this worker to the driver (reference:
+        registration.py last-seen bookkeeping; feeds the driver's
+        ``registered_workers`` observability view)."""
+        with self._lock:
+            if self._client is None:
+                return
+            pid = os.environ.get("HOROVOD_PROCESS_ID")
+            if pid is not None:
+                self._client.register(int(pid))
+
+
+notification_manager = WorkerNotificationManager()
+
+
+class State:
+    """Base state machinery (reference: common/elastic.py State)."""
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self,
+                                 callbacks: List[Callable[[], None]]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def reset(self) -> None:
+        """Override: rebuild world-size-dependent members."""
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        notification_manager.init_from_env()
+        notification_manager.check()
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+def _commit_path(commit_dir: str) -> str:
+    return os.path.join(commit_dir, "state.latest.pkl")
+
+
+def _persist(commit_dir: str, payload: Dict[str, Any]) -> None:
+    """Atomic write (tmp + rename) so a crash mid-commit never corrupts the
+    restore point.
+
+    EVERY process persists to its own local disk (the commit_dir path is
+    per-host), so losing any host — including the one that was process 0 —
+    leaves survivors with a usable restore point; ``load_persisted_world``
+    picks the newest across the relaunched world.
+    """
+    os.makedirs(commit_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=commit_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, _commit_path(commit_dir))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_persisted(commit_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_commit_path(commit_dir), "rb") as f:
+            return pickle.load(f)
+    except (OSError, pickle.UnpicklingError, EOFError):
+        return None
+
+
+def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:
+    """The newest persisted commit across ALL processes of the (re)launched
+    world. A relaunched generation may have a different process 0 whose
+    disk never saw a commit (lost-host recovery); every process reports its
+    local commit sequence number and the highest one is broadcast."""
+    local = load_persisted(commit_dir) if commit_dir else None
+    if jax.process_count() == 1:
+        return local
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from ..optimizer.functions import broadcast_object
+    seq = -1 if local is None else int(local.get("seq", 0))
+    seqs = multihost_utils.process_allgather(np.asarray([seq], np.int64))
+    seqs = np.asarray(seqs).reshape(-1)
+    owner = int(np.argmax(seqs))
+    if seqs[owner] < 0:
+        return None
+    return broadcast_object(local, root_rank=owner)
+
+
+class ObjectState(State):
+    """State whose attrs are arbitrary picklable objects
+    (reference: common/elastic.py ObjectState)."""
+
+    #: attr names excluded from snapshots.
+    _INTERNAL = ("_reset_callbacks", "_saved", "_commit_dir", "_commit_seq")
+
+    def __init__(self, commit_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        self._commit_dir = commit_dir or os.environ.get(C.COMMIT_DIR_ENV)
+        self._commit_seq = 0
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        # In-memory snapshot only: persisting here would clobber a previous
+        # generation's on-disk commit before load_latest() can adopt it.
+        self._saved = self._snapshot()
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if k not in self._INTERNAL}
+
+    def _snapshot(self) -> Dict[str, Any]:
+        return {k: self._host_copy(v) for k, v in self._public_attrs().items()}
+
+    @staticmethod
+    def _host_copy(v: Any) -> Any:
+        """Device arrays → host numpy (survives mesh teardown); everything
+        else deep-copied."""
+        import numpy as np
+        leaves, treedef = jax.tree_util.tree_flatten(v)
+        out = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                out.append(np.asarray(jax.device_get(leaf)))
+            else:
+                out.append(copy.deepcopy(leaf))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def save(self) -> None:
+        self._saved = self._snapshot()
+        if self._commit_dir:
+            self._commit_seq += 1
+            _persist(self._commit_dir,
+                     {"seq": self._commit_seq, "attrs": self._saved})
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v) if not isinstance(v, jax.Array)
+                    else v)
+
+    def load_latest(self) -> bool:
+        """Adopt the newest persisted commit across the world (process-
+        restart resume; survives losing the former process 0's disk).
+        Returns True if one was found."""
+        if not self._commit_dir:
+            return False
+        payload = load_persisted_world(self._commit_dir)
+        if payload is None:
+            return False
+        self._commit_seq = int(payload.get("seq", 0))
+        self._saved = payload.get("attrs", payload)
+        self.restore()
+        return True
+
+    def sync(self) -> None:
+        """Every process adopts process 0's attrs (reference: state.sync()
+        broadcast from new rank 0). Broadcasts the HOST snapshot — live
+        device buffers may be non-fully-addressable shards that cannot be
+        pickled (and would be wrong to ship whole from one host anyway)."""
+        from ..optimizer.functions import broadcast_object
+        synced = broadcast_object(self._snapshot(), root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """``TorchState`` analog: model/optimizer pytrees + loop counters.
+
+    Usage::
+
+        state = JaxState(params=params, opt_state=opt_state,
+                         epoch=0, batch=0)
+        state.commit()                       # after each (few) step(s)
+        params = state.params                # restored/synced on reset
+
+    Arrays are snapshotted as host copies and restored as host numpy — the
+    next jitted step re-places them onto the (possibly new) mesh, which is
+    exactly what a post-reset recompile needs.
+    """

@@ -175,6 +175,60 @@ def default_coordinator_addr(assignments: List[HostAssignment],
     return f"{host0}:{port}"
 
 
+def run_host_process(a: HostAssignment, command: Sequence[str],
+                     settings: Settings, coordinator_addr: str,
+                     secret_key: Optional[bytes], stop: threading.Event,
+                     extra_env: Optional[Dict[str, str]] = None,
+                     output_dir: Optional[str] = None) -> int:
+    """Run ONE host's worker process to completion; the single launch path
+    shared by the static launcher and the elastic driver's generations.
+
+    Any launch-time exception (missing binary, unreachable output dir, ssh
+    absent) surfaces as exit code 1, never as a silently dead thread —
+    which would read as success while peers hang at rendezvous.
+    """
+    try:
+        env = get_run_env(a, settings, coordinator_addr, secret_key)
+        if extra_env:
+            env.update(extra_env)
+        out = err = None
+        opened = []
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            out = open(os.path.join(output_dir,
+                                    f"rank.{a.process_id}.stdout"), "w")
+            err = open(os.path.join(output_dir,
+                                    f"rank.{a.process_id}.stderr"), "w")
+            opened = [out, err]
+        try:
+            if is_local(a.hostname):
+                return execute(list(command), env=env, stdout=out,
+                               stderr=err,
+                               prefix=str(a.process_id) if settings.verbose
+                               else None,
+                               events=[stop])
+            line = get_ssh_command(a, command, env, settings,
+                                   cwd=os.getcwd(),
+                                   secret_on_stdin=secret_key is not None)
+            return execute(line, env=dict(os.environ), stdout=out,
+                           stderr=err,
+                           prefix=str(a.process_id) if settings.verbose
+                           else None,
+                           events=[stop],
+                           stdin_data=(secret.encode(secret_key)
+                                       + "\n").encode()
+                           if secret_key is not None else None)
+        finally:
+            for f in opened:
+                f.close()
+    except BaseException:
+        import traceback
+        print(f"[horovod_tpu.runner] failed to launch process "
+              f"{a.process_id} on {a.hostname}:", file=sys.stderr)
+        traceback.print_exc()
+        return 1
+
+
 def launch_job(assignments: List[HostAssignment], command: Sequence[str],
                settings: Settings, coordinator_addr: Optional[str] = None,
                secret_key: Optional[bytes] = None) -> int:
@@ -192,55 +246,12 @@ def launch_job(assignments: List[HostAssignment], command: Sequence[str],
     # for days. Only `events` (peer failure / launcher shutdown) and an
     # explicit job_timeout_s in Settings.env would bound the lifetime.
     def run_one(a: HostAssignment):
-        # Any launch-time exception (missing binary, unreachable output
-        # dir, ssh absent) must surface as a failure + teardown, never a
-        # silently dead thread with no codes[] entry (which would read as
-        # success while peers hang at rendezvous).
-        code = 1
-        try:
-            env = get_run_env(a, settings, coordinator_addr, secret_key)
-            out = err = None
-            opened = []
-            if settings.output_filename:
-                os.makedirs(settings.output_filename, exist_ok=True)
-                out = open(os.path.join(settings.output_filename,
-                                        f"rank.{a.process_id}.stdout"), "w")
-                err = open(os.path.join(settings.output_filename,
-                                        f"rank.{a.process_id}.stderr"), "w")
-                opened = [out, err]
-            try:
-                if is_local(a.hostname):
-                    code = execute(list(command), env=env, stdout=out,
-                                   stderr=err,
-                                   prefix=str(a.process_id) if settings.verbose
-                                   else None,
-                                   events=[stop])
-                else:
-                    line = get_ssh_command(a, command, env, settings,
-                                           cwd=os.getcwd(),
-                                           secret_on_stdin=secret_key
-                                           is not None)
-                    code = execute(line, env=dict(os.environ), stdout=out,
-                                   stderr=err,
-                                   prefix=str(a.process_id) if settings.verbose
-                                   else None,
-                                   events=[stop],
-                                   stdin_data=(secret.encode(secret_key)
-                                               + "\n").encode()
-                                   if secret_key is not None else None)
-            finally:
-                for f in opened:
-                    f.close()
-        except BaseException:
-            import traceback
-            print(f"[horovod_tpu.runner] failed to launch process "
-                  f"{a.process_id} on {a.hostname}:", file=sys.stderr)
-            traceback.print_exc()
-            code = 1
-        finally:
-            codes[a.process_id] = code
-            if code != 0:
-                stop.set()
+        code = run_host_process(a, command, settings, coordinator_addr,
+                                secret_key, stop,
+                                output_dir=settings.output_filename)
+        codes[a.process_id] = code
+        if code != 0:
+            stop.set()
 
     for a in assignments:
         t = threading.Thread(target=run_one, args=(a,), daemon=True)

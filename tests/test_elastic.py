@@ -1,0 +1,472 @@
+"""Elastic subsystem tests.
+
+Reference parity: ``test/integration/test_elastic_torch.py`` +
+``test/single`` elastic driver tests (SURVEY.md §4) — the discovery-script
+fixture that mutates a hosts file mid-run is the reference's deterministic
+fault-injection trick, reproduced here on localhost.
+"""
+
+import os
+import stat
+import sys
+import textwrap
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.core.exceptions import (HorovodInternalError,
+                                         HostsUpdatedInterrupt)
+from horovod_tpu.elastic import constants as C
+from horovod_tpu.elastic.service import CoordinatorClient, CoordinatorService
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.runner.settings import Settings
+
+
+# --- state objects ----------------------------------------------------------
+
+def test_object_state_commit_restore():
+    s = elastic.ObjectState(epoch=0, batch=0,
+                            w=jnp.ones((2, 2)))
+    s.epoch, s.batch = 3, 7
+    s.w = s.w * 5.0
+    s.commit()
+    s.epoch, s.batch = 9, 9
+    s.w = s.w * 100.0
+    s.restore()
+    assert s.epoch == 3 and s.batch == 7
+    np.testing.assert_allclose(np.asarray(s.w), 5.0 * np.ones((2, 2)))
+
+
+def test_object_state_snapshot_is_host_copy():
+    s = elastic.ObjectState(w=jnp.arange(4.0))
+    assert isinstance(s._saved["w"], np.ndarray)   # survives mesh teardown
+
+
+def test_jax_state_pytrees():
+    params = {"dense": {"kernel": jnp.ones((3, 3)), "bias": jnp.zeros(3)}}
+    s = elastic.JaxState(params=params, opt_state=(jnp.zeros(3),), step=0)
+    s.params = jax_tree_scale(s.params, 2.0)
+    s.step = 5
+    s.commit()
+    s.params = jax_tree_scale(s.params, 100.0)
+    s.restore()
+    np.testing.assert_allclose(
+        np.asarray(s.params["dense"]["kernel"]), 2.0 * np.ones((3, 3)))
+    assert s.step == 5
+
+
+def jax_tree_scale(tree, f):
+    import jax
+    return jax.tree_util.tree_map(lambda x: x * f, tree)
+
+
+def test_state_persistence_roundtrip(tmp_path):
+    d = str(tmp_path / "commits")
+    s = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.ones(3))
+    s.steps = 4
+    s.commit()
+    # A NEW state object (fresh process in real life) adopts the commit.
+    s2 = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.zeros(3))
+    assert s2.load_latest()
+    assert s2.steps == 4
+    np.testing.assert_allclose(np.asarray(s2.w), np.ones(3))
+
+
+def test_fresh_state_does_not_clobber_persisted_commit(tmp_path):
+    d = str(tmp_path / "commits")
+    s = elastic.ObjectState(commit_dir=d, steps=0)
+    s.steps = 9
+    s.commit()
+    # Constructing a new generation's state must NOT overwrite the commit.
+    s2 = elastic.ObjectState(commit_dir=d, steps=0)
+    assert s2.load_latest() and s2.steps == 9
+
+
+def test_sync_single_process_identity():
+    s = elastic.ObjectState(x=1)
+    s.x = 2
+    s.sync()
+    assert s.x == 2
+
+
+def test_reset_callbacks():
+    s = elastic.ObjectState(x=0)
+    called = []
+    s.register_reset_callbacks([lambda: called.append(True)])
+    s.on_reset()
+    assert called == [True]
+
+
+def test_notification_signal_raises_at_commit():
+    s = elastic.ObjectState(x=0)
+    elastic.notification_manager.signal()
+    with pytest.raises(HostsUpdatedInterrupt):
+        s.commit()
+    s.commit()   # flag consumed; next commit is clean
+
+
+# --- sampler ----------------------------------------------------------------
+
+def test_sampler_shards_evenly():
+    a = elastic.ElasticSampler(20, shuffle=False, rank=0, num_replicas=2)
+    b = elastic.ElasticSampler(20, shuffle=False, rank=1, num_replicas=2)
+    assert sorted(list(a) + list(b)) == list(range(20))
+    assert len(a) == len(b) == 10
+
+
+def test_sampler_reset_reshards_remaining_no_drop_no_repeat():
+    a = elastic.ElasticSampler(12, shuffle=False, rank=0, num_replicas=2)
+    b = elastic.ElasticSampler(12, shuffle=False, rank=1, num_replicas=2)
+    # Each rank processes its first 2 examples (4 globally).
+    a.record_indices(a.indices[:2])
+    b.record_indices(b.indices[:2])
+    done = set(a.indices[:2]) | set(b.indices[:2])
+    # World shrinks to 1: survivor must see exactly the remaining 8.
+    a.processed_indices.extend(b.processed_indices)   # survivor merges
+    a.reset(rank=0, num_replicas=1)
+    assert sorted(a.indices) == sorted(set(range(12)) - done)
+
+
+def test_sampler_pads_to_world_multiple():
+    s = elastic.ElasticSampler(10, shuffle=False, rank=0, num_replicas=4)
+    s2 = elastic.ElasticSampler(10, shuffle=False, rank=3, num_replicas=4)
+    assert len(s) == len(s2) == 3      # 10 -> padded to 12
+
+
+def test_sampler_state_dict_roundtrip():
+    s = elastic.ElasticSampler(10, shuffle=True, seed=7, rank=0,
+                               num_replicas=2)
+    s.set_epoch(1)
+    s.record_indices(s.indices[:2])
+    sd = s.state_dict()
+    s.reset()          # load_state_dict re-shards; compare like with like
+    t = elastic.ElasticSampler(10, shuffle=True, rank=0, num_replicas=2)
+    t.load_state_dict(sd)
+    assert t.epoch == 1 and t.processed_indices == s.processed_indices
+    assert list(t) == list(s)
+
+
+# --- run wrapper (inprocess mode) -------------------------------------------
+
+@pytest.fixture
+def inprocess_mode(monkeypatch):
+    monkeypatch.setenv(C.MODE_ENV, "inprocess")
+
+
+class _CountingState(elastic.ObjectState):
+    """Counters live on the CLASS so they are not snapshotted/rolled back."""
+    restores = 0
+    syncs = 0
+
+    def restore(self):
+        type(self).restores += 1
+        super().restore()
+
+    def sync(self):
+        type(self).syncs += 1
+        super().sync()
+
+
+def test_run_retries_after_internal_error(inprocess_mode):
+    _CountingState.restores = 0
+    state = _CountingState(attempts=0, completed=False)
+    calls = {"n": 0}
+
+    @elastic.run
+    def train(st):
+        st.attempts += 1
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HorovodInternalError("fake collective failure")
+        st.completed = True
+        return "done"
+
+    assert train(state) == "done"
+    assert _CountingState.restores >= 1 and state.completed
+    # attempts rolled back to the pre-failure commit then re-incremented
+    assert state.attempts == 1
+
+
+def test_run_syncs_after_hosts_updated(inprocess_mode):
+    _CountingState.syncs = 0
+    state = _CountingState(attempts=0)
+    calls = {"n": 0}
+
+    @elastic.run
+    def train(st):
+        st.attempts += 1
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HostsUpdatedInterrupt()
+        return st.attempts
+
+    assert train(state) == 2   # no rollback on hosts-updated (sync path)
+    assert _CountingState.syncs >= 2   # once at entry, once after interrupt
+
+
+def test_run_reset_limit_aborts(inprocess_mode, monkeypatch):
+    monkeypatch.setenv(C.RESET_LIMIT_ENV, "2")
+    state = elastic.ObjectState(x=0)
+
+    @elastic.run
+    def train(st):
+        raise HorovodInternalError("always fails")
+
+    with pytest.raises(SystemExit) as e:
+        train(state)
+    assert e.value.code == C.ABORT_EXIT_CODE
+
+
+def test_run_restart_mode_exits_with_restart_code(monkeypatch, tmp_path):
+    monkeypatch.setenv(C.MODE_ENV, "restart")
+    monkeypatch.setenv(C.COMMIT_DIR_ENV, str(tmp_path))
+    state = elastic.ObjectState(x=0)
+
+    @elastic.run
+    def train(st):
+        raise HostsUpdatedInterrupt()
+
+    with pytest.raises(SystemExit) as e:
+        train(state)
+    assert e.value.code == C.RESTART_EXIT_CODE
+
+
+# --- discovery --------------------------------------------------------------
+
+def _write_script(path, body):
+    path.write_text(body)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def test_host_discovery_script(tmp_path):
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("a:4\nb\n# comment\n\n")
+    script = _write_script(tmp_path / "d.sh",
+                           f"#!/bin/sh\ncat {hosts_file}\n")
+    d = elastic.HostDiscoveryScript(script, default_slots=2)
+    assert d.find_available_hosts_and_slots() == {"a": 4, "b": 2}
+    hosts_file.write_text("a:4\n")          # the mutation fixture
+    assert d.find_available_hosts_and_slots() == {"a": 4}
+
+
+def test_host_discovery_script_failure_is_empty(tmp_path):
+    script = _write_script(tmp_path / "d.sh", "#!/bin/sh\nexit 3\n")
+    assert elastic.HostDiscoveryScript(
+        script).find_available_hosts_and_slots() == {}
+
+
+# --- blacklist --------------------------------------------------------------
+
+def test_blacklist_strikes_and_cooldown():
+    bl = elastic.Blacklist(strikes=2, cooldown_s=0.2)
+    bl.record_failure("h1")
+    assert not bl.is_banned("h1")
+    bl.record_failure("h1")
+    assert bl.is_banned("h1")
+    assert bl.filter({"h1": 4, "h2": 4}) == {"h2": 4}
+    time.sleep(0.25)
+    assert not bl.is_banned("h1")           # cooldown re-admission
+
+
+# --- coordinator service ----------------------------------------------------
+
+def test_coordinator_service_versioning_and_hmac():
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        assert svc.version == 0
+        v = svc.update_world({"a": 4}, 4)
+        assert v == 1
+        client = CoordinatorClient(f"127.0.0.1:{svc.port}", key)
+        world = client.get_world()
+        assert world == {"version": 1, "hosts": {"a": 4}, "np": 4}
+        assert client.register(0)
+        assert 0 in svc.registered_workers()
+        # Wrong key -> signature check fails -> treated as unreachable.
+        bad = CoordinatorClient(f"127.0.0.1:{svc.port}",
+                                _secret.make_secret_key())
+        assert bad.get_world() is None
+        assert not bad.register(1)
+    finally:
+        svc.close()
+
+
+def test_notification_manager_polls_service(monkeypatch):
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"a": 1}, 1)
+        monkeypatch.setenv(C.COORD_ADDR_ENV, f"127.0.0.1:{svc.port}")
+        monkeypatch.setenv(C.WORLD_VERSION_ENV, "1")
+        monkeypatch.setenv(_secret.ENV_VAR, _secret.encode(key))
+        mgr = elastic.WorkerNotificationManager()
+        mgr.init_from_env()
+        mgr._poll_interval_s = 0.0
+        mgr.check()                          # same version: no interrupt
+        svc.update_world({"a": 1, "b": 1}, 2)
+        with pytest.raises(HostsUpdatedInterrupt):
+            mgr.check()
+        mgr.check()                          # fires once per change
+    finally:
+        svc.close()
+
+
+# --- driver unit ------------------------------------------------------------
+
+def test_driver_target_np_clamps():
+    s = Settings(elastic=True, min_np=2, max_np=4, num_proc=None,
+                 host_discovery_script="true")
+    d = elastic.ElasticDriver(s, ["true"])
+    try:
+        assert d._target_np({"a": 2, "b": 6}) == 4      # max_np clamp
+        assert d._target_np({"a": 1}) == 1
+        assert d._enough({"a": 2}) and not d._enough({"a": 1})
+    finally:
+        d._service.close()
+
+
+def test_driver_wait_for_slots_timeout(tmp_path):
+    script = _write_script(tmp_path / "d.sh", "#!/bin/sh\nexit 1\n")
+    s = Settings(elastic=True, min_np=1, host_discovery_script=script,
+                 discovery_interval_s=0.05)
+    d = elastic.ElasticDriver(s, ["true"])
+    try:
+        with pytest.raises(TimeoutError):
+            d.wait_for_available_slots(timeout_s=0.3)
+    finally:
+        d._service.close()
+
+
+def test_driver_classify_feeds_blacklist():
+    s = Settings(elastic=True, min_np=1, host_discovery_script="true")
+    d = elastic.ElasticDriver(s, ["true"])
+    try:
+        assert d._classify({"a": 0, "b": 0}) == "success"
+        assert d._classify({"a": C.RESTART_EXIT_CODE, "b": -15}) == "reset"
+        assert d._classify({"a": C.ABORT_EXIT_CODE}) == "abort"
+        # Two real failures -> blacklist.
+        d._classify({"a": 1})
+        d._classify({"a": 1})
+        assert d._blacklist.is_banned("a")
+        # Teardown signals (negative) and RESTART never count as strikes.
+        assert not d._blacklist.is_banned("b")
+    finally:
+        d._service.close()
+
+
+# --- full elastic integration on localhost ----------------------------------
+
+#: spawned workers need the repo on PYTHONPATH (package is not installed)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER_PYTHONPATH = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+
+WORKER_SCRIPT = textwrap.dedent("""\
+    import os, sys, time
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    marker_dir = os.environ["TEST_MARKER_DIR"]
+    gen = os.environ.get("HOROVOD_ELASTIC_WORLD_VERSION", "?")
+    pid = os.environ.get("HOROVOD_PROCESS_ID", "0")
+
+    hvd.init()
+    state = elastic.ObjectState(steps=0)
+
+    @elastic.run
+    def train(st):
+        crash_at = os.environ.get("TEST_CRASH_AT_STEP")
+        while st.steps < 6:
+            st.steps += 1
+            if (crash_at and st.steps == int(crash_at)
+                    and gen == "1" and pid == "0"):
+                # one-shot fault injection: only generation 1's process 0
+                os._exit(17)
+            st.commit()
+            time.sleep(0.02)
+        with open(os.path.join(marker_dir, f"done.g{gen}.p{pid}"), "w") as f:
+            f.write(str(st.steps))
+        return st.steps
+
+    train(state)
+""")
+
+
+@pytest.mark.integration
+def test_elastic_driver_recovers_from_worker_crash(tmp_path):
+    """Generation 1 crashes (injected); the driver relaunches and the job
+    resumes from the persisted commit and completes. The crashing host is
+    NOT blacklisted into oblivion (strikes=2 > 1 failure)."""
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    s = Settings(elastic=True, min_np=1, max_np=1,
+                 hosts=[], host_discovery_script=None,
+                 discovery_interval_s=0.1, start_timeout_s=60,
+                 env={"TEST_MARKER_DIR": str(marker),
+                      "TEST_CRASH_AT_STEP": "2",
+                      "PYTHONPATH": _WORKER_PYTHONPATH})
+    d = elastic.ElasticDriver(
+        s, [sys.executable, str(script)],
+        discovery=elastic.FixedHostDiscovery({"localhost": 1}))
+    code = d.run()
+    assert code == 0
+    done = sorted(os.listdir(marker))
+    assert any(f.startswith("done.g2") for f in done), done
+    # Persisted commit means the relaunched run continued past step 2
+    # without restarting from zero: final steps == 6 exactly once.
+    contents = {f: (marker / f).read_text() for f in done}
+    assert all(v == "6" for v in contents.values())
+
+
+@pytest.mark.integration
+def test_elastic_driver_grows_on_host_add(tmp_path):
+    """Membership grows mid-run via the discovery-file fixture; workers see
+    the version bump at commit, exit RESTART, and generation 2 runs with
+    np=2 and completes."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost:1\n")
+    dscript = _write_script(tmp_path / "d.sh",
+                            f"#!/bin/sh\ncat {hosts_file}\n")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    s = Settings(elastic=True, min_np=1, max_np=2,
+                 host_discovery_script=dscript,
+                 discovery_interval_s=0.1, start_timeout_s=60,
+                 env={"TEST_MARKER_DIR": str(marker),
+                      "PYTHONPATH": _WORKER_PYTHONPATH})
+    d = elastic.ElasticDriver(s, [sys.executable, str(script)])
+
+    import threading
+    def add_host():
+        time.sleep(1.0)
+        hosts_file.write_text("localhost:1\n127.0.0.1:1\n")
+    t = threading.Thread(target=add_host, daemon=True)
+    t.start()
+    code = d.run()
+    t.join()
+    assert code == 0
+    done = sorted(os.listdir(marker))
+    # The final generation must include a 2-process world completion...
+    assert any(f.endswith("p1") for f in done), done
+    assert all((marker / f).read_text() == "6" for f in done)
+
+
+def test_sampler_epoch_tail_padding_stays_even():
+    """1 remaining example over 4 ranks must still give every rank equal
+    (nonzero) yields — repeated wrap, not a short slice."""
+    ss = [elastic.ElasticSampler(9, shuffle=False, rank=r, num_replicas=4)
+          for r in range(4)]
+    for s in ss:
+        s.record_indices(list(range(8)))   # everything but index 8 done
+        s.reset()
+    lengths = {len(s) for s in ss}
+    assert lengths == {1}
+    assert all(list(s) == [8] for s in ss)
