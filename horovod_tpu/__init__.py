@@ -41,7 +41,7 @@ def __getattr__(name):
     # so importing the top level stays light.
     import importlib
     if name in ("optimizer", "elastic", "models", "parallel", "runner",
-                "tools", "ops", "utils", "train"):
+                "tools", "ops", "utils", "train", "callbacks", "checkpoint"):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

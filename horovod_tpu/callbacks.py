@@ -1,0 +1,263 @@
+"""Training-loop callbacks (keras-parity) + optax-native LR schedules.
+
+Reference parity: ``horovod/_keras/callbacks.py`` (SURVEY.md §2.4) —
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateWarmupCallback``, ``LearningRateScheduleCallback`` — exposed
+framework-neutrally: callbacks hook a :class:`CallbackLoop` adapter around
+any train loop instead of keras ``model.fit``.
+
+TPU note on LR mutation: the reference's LR callbacks assign
+``K.set_value(model.optimizer.lr, ...)`` between steps. Under jit the LR
+must be *data*, not a constant baked into the compiled step, so the adapter
+mutates ``opt_state.hyperparams["learning_rate"]`` — build the optimizer
+with ``optax.inject_hyperparams`` (see :func:`injectable`). For static
+schedules, prefer :func:`warmup_schedule` — a pure optax schedule compiled
+into the step (zero host work; the idiomatic TPU form of the warmup
+callback).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import optax
+
+from .core import context_api as _ctx
+from .core.logging import get_logger
+from .optimizer.functions import broadcast_parameters
+
+
+def injectable(opt_factory: Callable[..., optax.GradientTransformation],
+               learning_rate: float, **kw) -> optax.GradientTransformation:
+    """``optax.inject_hyperparams`` shorthand making ``learning_rate``
+    runtime-mutable for the LR callbacks."""
+    return optax.inject_hyperparams(opt_factory)(
+        learning_rate=learning_rate, **kw)
+
+
+class Callback:
+    """Hook points mirroring the keras callback surface the reference uses."""
+
+    def on_train_begin(self, loop: "CallbackLoop") -> None: ...
+    def on_epoch_begin(self, epoch: int, loop: "CallbackLoop") -> None: ...
+    def on_batch_begin(self, batch: int, loop: "CallbackLoop") -> None: ...
+    def on_batch_end(self, batch: int, loop: "CallbackLoop",
+                     logs: Dict[str, Any]) -> None: ...
+    def on_epoch_end(self, epoch: int, loop: "CallbackLoop",
+                     logs: Dict[str, Any]) -> None: ...
+    def on_train_end(self, loop: "CallbackLoop") -> None: ...
+
+
+class CallbackLoop:
+    """Mutable view of the training loop that callbacks act on.
+
+    ``state`` is the user's TrainState-like NamedTuple (must expose
+    ``params`` / ``opt_state``; ``batch_stats`` optional). The user's loop
+    calls the ``epoch/batch`` hooks and reads ``loop.state`` back each step.
+    """
+
+    def __init__(self, state, callbacks: Sequence[Callback],
+                 steps_per_epoch: Optional[int] = None):
+        self.state = state
+        self.callbacks = list(callbacks)
+        self.steps_per_epoch = steps_per_epoch
+        self.epoch = 0
+        self.batch = 0
+
+    # -- lr plumbing ---------------------------------------------------------
+
+    def get_lr(self) -> Optional[float]:
+        hp = getattr(self.state.opt_state, "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            return None
+        return float(np.asarray(hp["learning_rate"]))
+
+    def set_lr(self, lr: float) -> None:
+        hp = getattr(self.state.opt_state, "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            raise ValueError(
+                "optimizer has no runtime-mutable learning_rate; build it "
+                "with horovod_tpu.callbacks.injectable(...) "
+                "(optax.inject_hyperparams)")
+        hp["learning_rate"] = jax.numpy.asarray(
+            lr, np.asarray(hp["learning_rate"]).dtype)
+
+    # -- hook dispatch -------------------------------------------------------
+
+    def train_begin(self):
+        for c in self.callbacks:
+            c.on_train_begin(self)
+
+    def epoch_begin(self, epoch: int):
+        self.epoch = epoch
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch, self)
+
+    def batch_begin(self, batch: int):
+        self.batch = batch
+        for c in self.callbacks:
+            c.on_batch_begin(batch, self)
+
+    def batch_end(self, batch: int, logs: Optional[Dict[str, Any]] = None):
+        logs = logs if logs is not None else {}
+        for c in self.callbacks:
+            c.on_batch_end(batch, self, logs)
+
+    def epoch_end(self, epoch: int, logs: Optional[Dict[str, Any]] = None):
+        logs = logs if logs is not None else {}
+        for c in self.callbacks:
+            c.on_epoch_end(epoch, self, logs)
+
+    def train_end(self):
+        for c in self.callbacks:
+            c.on_train_end(self)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial params/optimizer state from ``root_rank`` at train
+    start (reference: BroadcastGlobalVariablesCallback on_train_begin)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, loop: CallbackLoop) -> None:
+        st = loop.state
+        st = st._replace(
+            params=broadcast_parameters(st.params, self.root_rank),
+            opt_state=broadcast_parameters(st.opt_state, self.root_rank))
+        if hasattr(st, "batch_stats"):
+            st = st._replace(batch_stats=broadcast_parameters(
+                st.batch_stats, self.root_rank))
+        loop.state = st
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metrics over all worker processes (reference:
+    MetricAverageCallback — allreduce of keras logs). Within one process
+    metrics are already global (in-graph pmean); this averages across
+    hosts."""
+
+    def on_epoch_end(self, epoch: int, loop: CallbackLoop,
+                     logs: Dict[str, Any]) -> None:
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating, np.integer)))
+        if not keys:
+            return
+        vec = np.asarray([float(logs[k]) for k in keys], np.float64)
+        allv = multihost_utils.process_allgather(vec)
+        mean = np.asarray(allv).reshape(jax.process_count(), -1).mean(axis=0)
+        for k, v in zip(keys, mean):
+            logs[k] = float(v)
+
+
+class LearningRateWarmupCallback(Callback):
+    """Ramp LR from ``initial_lr`` to ``initial_lr * size`` over
+    ``warmup_epochs`` (reference: gradual warmup after the linear-scaling
+    rule, Goyal et al. 2017 — 'momentum correction' is unnecessary here
+    because optax momenta are LR-independent)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: float = 5.0,
+                 steps_per_epoch: Optional[int] = None, verbose: bool = False,
+                 size: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._size = size
+
+    @property
+    def size(self) -> int:
+        return self._size if self._size is not None else _ctx.size()
+
+    def _lr_at(self, epoch_float: float) -> float:
+        t = min(1.0, epoch_float / max(self.warmup_epochs, 1e-9))
+        return self.initial_lr * (1.0 + (self.size - 1.0) * t)
+
+    def on_batch_begin(self, batch: int, loop: CallbackLoop) -> None:
+        spe = self.steps_per_epoch or loop.steps_per_epoch
+        if not spe:
+            return              # epoch-granularity fallback below
+        ep = loop.epoch + batch / spe
+        if ep <= self.warmup_epochs:
+            loop.set_lr(self._lr_at(ep))
+
+    def on_epoch_begin(self, epoch: int, loop: CallbackLoop) -> None:
+        if (self.steps_per_epoch or loop.steps_per_epoch) is None \
+                and epoch <= self.warmup_epochs:
+            loop.set_lr(self._lr_at(float(epoch)))
+        if self.verbose and epoch <= self.warmup_epochs:
+            get_logger().info("warmup epoch %d: lr=%.3g", epoch,
+                              self._lr_at(float(epoch)))
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply ``initial_lr`` by ``multiplier`` within
+    ``[start_epoch, end_epoch)`` (reference semantics, incl. callable
+    multipliers and ``staircase``)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: "float | Callable[[float], float]",
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+
+    def _mult(self, epoch_float: float) -> float:
+        if callable(self.multiplier):
+            return self.multiplier(epoch_float)
+        return float(self.multiplier)
+
+    def _maybe_set(self, epoch_float: float, loop: CallbackLoop) -> None:
+        if epoch_float < self.start_epoch:
+            return
+        if self.end_epoch is not None and epoch_float >= self.end_epoch:
+            return
+        e = math.floor(epoch_float) if self.staircase else epoch_float
+        loop.set_lr(self.initial_lr * self._mult(e))
+
+    def on_epoch_begin(self, epoch: int, loop: CallbackLoop) -> None:
+        if self.staircase or not (self.steps_per_epoch
+                                  or loop.steps_per_epoch):
+            self._maybe_set(float(epoch), loop)
+
+    def on_batch_begin(self, batch: int, loop: CallbackLoop) -> None:
+        spe = self.steps_per_epoch or loop.steps_per_epoch
+        if not self.staircase and spe:
+            self._maybe_set(loop.epoch + batch / spe, loop)
+
+
+def warmup_schedule(initial_lr: float, size: Optional[int] = None,
+                    warmup_steps: int = 1000,
+                    after: Optional[optax.Schedule] = None) -> optax.Schedule:
+    """The warmup callback as a pure optax schedule — compiled into the
+    step, zero host involvement (the idiomatic TPU form). Ramps
+    ``initial_lr → initial_lr*size`` over ``warmup_steps`` then follows
+    ``after`` (default: constant at the scaled LR)."""
+    def sched(step):
+        import jax.numpy as jnp
+        n = size if size is not None else _ctx.size()
+        t = jnp.minimum(step / max(warmup_steps, 1), 1.0)
+        warm = initial_lr * (1.0 + (n - 1.0) * t)
+        if after is None:
+            return warm
+        return jnp.where(step < warmup_steps, warm,
+                         after(step - warmup_steps))
+    return sched
+
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback", "Callback", "CallbackLoop",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+    "MetricAverageCallback", "injectable", "warmup_schedule",
+]

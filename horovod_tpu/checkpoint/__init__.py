@@ -1,0 +1,22 @@
+"""horovod_tpu.checkpoint — sharded, async checkpoint/resume.
+
+Reference parity (SURVEY.md §5.4): the reference has NO core checkpoint
+engine — it composes three framework-level mechanisms. All three have
+equivalents here, and the orbax-backed manager is strictly stronger (the
+reference saves whole state on rank 0; we save each shard from the host
+that owns it, asynchronously):
+
+1. elastic ``State`` commits                  → horovod_tpu.elastic.state
+2. rank-0-restores-then-broadcasts pattern    → :func:`restore_and_broadcast`
+   (reference: ``horovod/torch/functions.py`` broadcast_parameters/
+   broadcast_object used after torch.load on rank 0)
+3. Spark estimator Store checkpoints          → :class:`LocalStore` /
+   :class:`Store` registry (reference: ``horovod/spark/common/store.py``)
+"""
+
+from .manager import (CheckpointManager, latest_step, like_of,
+                      restore_and_broadcast)
+from .store import LocalStore, Store, get_store
+
+__all__ = ["CheckpointManager", "LocalStore", "Store", "get_store",
+           "latest_step", "like_of", "restore_and_broadcast"]

@@ -1,0 +1,130 @@
+"""Orbax-backed checkpoint manager + the rank-0-broadcast resume pattern.
+
+Reference parity (SURVEY.md §5.4): the reference's resume idiom is
+
+    if hvd.rank() == 0: state = torch.load(path)
+    hvd.broadcast_parameters(state, root_rank=0)
+
+:func:`restore_and_broadcast` is that idiom verbatim. For sharded/large
+state, :class:`CheckpointManager` is the TPU-native engine the reference
+lacks: every host writes exactly its own shards (orbax/tensorstore,
+async), and restore re-creates arrays under any target sharding — which is
+also what elastic recovery onto a resized mesh needs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from ..core.logging import get_logger
+
+
+class CheckpointManager:
+    """Async sharded checkpointing with retention (orbax under the hood).
+
+    Usage::
+
+        mgr = CheckpointManager("/ckpts", max_to_keep=3)
+        mgr.save(step, {"params": params, "opt_state": opt_state})
+        restored = mgr.restore()              # newest step
+        restored = mgr.restore(step=100, like={"params": p0, ...})
+
+    ``like`` supplies the target pytree (with shardings) so restore places
+    shards directly onto the current mesh — pass it when resuming onto a
+    different topology (elastic reshard).
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def save(self, step: int, items: Any, force: bool = False) -> bool:
+        """Queue an async save of ``items`` (a pytree) at ``step``."""
+        import orbax.checkpoint as ocp
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(items),
+                               force=force)
+        if saved:
+            get_logger().info("checkpoint queued at step %d -> %s", step,
+                              self._dir)
+        return saved
+
+    def restore(self, step: Optional[int] = None,
+                like: Optional[Any] = None) -> Any:
+        """Restore ``step`` (default: newest). ``like`` gives the target
+        structure/shardings for direct-to-device placement."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self._dir}")
+        args = (ocp.args.StandardRestore(like) if like is not None
+                else ocp.args.StandardRestore())
+        return self._mgr.restore(step, args=args)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def like_of(tree: Any) -> Any:
+    """Abstract (shape/dtype/sharding) skeleton of a live pytree — pass as
+    ``restore(like=...)`` to get back the exact structure (NamedTuples,
+    optax states) with shards placed on the current mesh. Without ``like``
+    orbax reconstructs generic nested dicts, which optax will reject."""
+    def leaf(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            sharding = getattr(a, "sharding", None)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding)
+        return a
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpoint step under ``directory`` (None if empty)."""
+    import orbax.checkpoint as ocp
+    try:
+        with ocp.CheckpointManager(os.path.abspath(directory)) as mgr:
+            return mgr.latest_step()
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def restore_and_broadcast(load_fn, root_rank: int = 0) -> Any:
+    """The reference's resume idiom: only ``root_rank``'s PROCESS runs
+    ``load_fn()`` (e.g. reading a file only that host has); the result is
+    broadcast to every process (reference: torch.load on rank 0 +
+    hvd.broadcast_object, SURVEY.md §5.4 item 2).
+    """
+    from ..optimizer.functions import broadcast_object
+    obj = load_fn() if jax.process_index() == root_rank else None
+    return broadcast_object(obj, root_rank=root_rank)

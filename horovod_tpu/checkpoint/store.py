@@ -1,0 +1,122 @@
+"""Store abstraction: where checkpoints/artifacts live.
+
+Reference parity: ``horovod/spark/common/store.py`` (~800 LoC of
+LocalStore/HDFSStore/S3Store/DBFSStore path plumbing). The TPU build keeps
+the same URL-dispatched factory (:func:`get_store`) and the same role —
+resolve logical names (checkpoints, logs) to concrete paths and hand out
+filesystem operations — with LocalStore implemented and remote schemes
+gated on their optional clients, as the reference gates on pyarrow/boto3.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+
+class Store:
+    """Path layout + filesystem ops for one artifact root."""
+
+    def __init__(self, prefix_path: str):
+        self._prefix = prefix_path.rstrip("/")
+
+    # -- layout (reference: Store.get_checkpoint_path etc.) -----------------
+
+    @property
+    def prefix_path(self) -> str:
+        return self._prefix
+
+    def checkpoint_path(self, run_id: str) -> str:
+        return f"{self._prefix}/{run_id}/checkpoints"
+
+    def logs_path(self, run_id: str) -> str:
+        return f"{self._prefix}/{run_id}/logs"
+
+    def runs_path(self) -> str:
+        return self._prefix
+
+    # -- ops (overridden per backend) ---------------------------------------
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def is_remote(self) -> bool:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Local/NFS filesystem store (reference: LocalStore)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.path.join(path, p) for p in os.listdir(path))
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def is_remote(self) -> bool:
+        return False
+
+
+#: scheme -> Store subclass; remote backends register here when their
+#: clients are importable (reference: store.py's matches()/filesystem
+#: dispatch on path prefix).
+_SCHEMES = {}
+
+
+def register_scheme(scheme: str, cls) -> None:
+    _SCHEMES[scheme] = cls
+
+
+def get_store(prefix_path: str) -> Store:
+    """URL-dispatched factory (reference: ``Store.create``).
+
+    ``hdfs://``/``s3://``/``gs://`` require their optional clients; this
+    image has none, so those schemes raise with the same guidance the
+    reference gives when pyarrow/boto3 are missing.
+    """
+    for scheme, cls in _SCHEMES.items():
+        if prefix_path.startswith(scheme + "://"):
+            return cls(prefix_path)
+    if "://" in prefix_path and not prefix_path.startswith("file://"):
+        scheme = prefix_path.split("://", 1)[0]
+        raise ValueError(
+            f"no client available for {scheme}:// stores; install its "
+            f"client and register_scheme({scheme!r}, YourStore) "
+            f"(reference gates HDFS/S3/DBFS the same way)")
+    return LocalStore(prefix_path.removeprefix("file://"))

@@ -218,6 +218,13 @@ class ElasticDriver:
                     return C.ABORT_EXIT_CODE
         finally:
             self._service.close()
+            # Commits hold full model snapshots; don't leak them into /tmp
+            # after the job ends. (Remote hosts' local copies live in THEIR
+            # tmp at the same path; workers are gone, so the next boot's
+            # tmp cleaning reaps them — same lifecycle as the reference's
+            # per-worker scratch.)
+            import shutil
+            shutil.rmtree(commit_dir, ignore_errors=True)
 
     def _watch_membership(self, hosts: Dict[str, int], version: int,
                           stop: threading.Event) -> None:
@@ -233,8 +240,13 @@ class ElasticDriver:
             if stop.is_set():
                 break
             now = self.effective_hosts()
-            lost = [h for h in running if h not in now]
-            gained = [h for h in now if h not in running]
+            # Compare slots too, not just names: a shrunk host lost
+            # capacity the generation is using (hard stop); a grown one is
+            # new capacity (graceful bump).
+            lost = [h for h in running
+                    if h not in now or now[h] < running[h]]
+            gained = [h for h in now
+                      if h not in running or now[h] > running[h]]
             if lost:
                 get_logger().warning("hosts lost mid-generation: %s", lost)
                 self._service.update_world(now, self._target_np(now))

@@ -38,7 +38,14 @@ from .state import State
 
 
 def _mode() -> str:
-    return os.environ.get(C.MODE_ENV, "restart")
+    """Explicit HOROVOD_ELASTIC_MODE wins; otherwise 'restart' only when a
+    driver is actually present to relaunch us (it exports the coordinator
+    address). A standalone run exiting with RESTART_EXIT_CODE would just
+    die — fall back to in-process retry there."""
+    mode = os.environ.get(C.MODE_ENV)
+    if mode:
+        return mode
+    return "restart" if os.environ.get(C.COORD_ADDR_ENV) else "inprocess"
 
 
 def _reset_limit() -> int:
@@ -87,6 +94,11 @@ def run(func: Callable) -> Callable:
                     sys.exit(C.RESTART_EXIT_CODE)
                 state.restore()
                 _reinitialize()
+                # Repair cross-process divergence: peers may have committed
+                # at different steps before the failure, so rolled-back
+                # states can differ — re-sync from rank 0 (the reference's
+                # run_fn also syncs on the retry path).
+                state.sync()
             except HostsUpdatedInterrupt as e:
                 get_logger().info("hosts updated: resetting")
                 if _mode() == "restart":

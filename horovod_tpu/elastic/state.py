@@ -18,11 +18,14 @@ TPU deltas:
 - Snapshots are **host copies** (``jax.device_get``) of array pytrees:
   device buffers die with the mesh on reset, host snapshots do not.
 - When ``HOROVOD_ELASTIC_COMMIT_DIR`` is set (the elastic driver always
-  sets it), ``commit()`` also persists the snapshot to disk atomically on
-  rank 0. This is what makes **process-restart elasticity** (the TPU-true
-  mode — see elastic/run_fn.py) lossless: a relaunched generation restores
-  the latest on-disk commit instead of starting over. The reference keeps
-  commits purely in-memory because its workers survive resets; ours may not.
+  sets it), ``commit()`` also persists the snapshot to disk atomically —
+  on EVERY process, each to its own local disk, so losing any host (even
+  the one that was process 0) leaves survivors a restore point; restores
+  pick the newest commit across the relaunched world. This is what makes
+  **process-restart elasticity** (the TPU-true mode — see
+  elastic/run_fn.py) lossless: a relaunched generation restores the latest
+  commit instead of starting over. The reference keeps commits purely
+  in-memory because its workers survive resets; ours may not.
 - ``JaxState`` is the ``TorchState`` analog holding ``params``/``opt_state``
   pytrees plus arbitrary scalar attrs (epoch, batch, ...).
 """

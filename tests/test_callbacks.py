@@ -1,0 +1,168 @@
+"""Callback tests (reference: test/parallel/test_keras.py callback cases,
+SURVEY.md §2.4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks as cb
+from horovod_tpu.train import TrainState, create_train_state, make_train_step
+from horovod_tpu.models import ResNetTiny
+from horovod_tpu.optimizer import distributed
+
+
+def _state_with_injectable_lr(lr=0.1):
+    opt = cb.injectable(optax.sgd, lr)
+    params = {"w": jnp.ones((2, 2))}
+    return TrainState(jnp.zeros((), jnp.int32), params, opt.init(params),
+                      {}), opt
+
+
+def test_injectable_lr_get_set():
+    state, _ = _state_with_injectable_lr(0.1)
+    loop = cb.CallbackLoop(state, [])
+    assert loop.get_lr() == pytest.approx(0.1)
+    loop.set_lr(0.5)
+    assert loop.get_lr() == pytest.approx(0.5)
+
+
+def test_set_lr_without_inject_raises():
+    params = {"w": jnp.ones(2)}
+    opt = optax.sgd(0.1)
+    state = TrainState(jnp.zeros((), jnp.int32), params, opt.init(params), {})
+    loop = cb.CallbackLoop(state, [])
+    assert loop.get_lr() is None
+    with pytest.raises(ValueError, match="inject_hyperparams"):
+        loop.set_lr(0.2)
+
+
+def test_injected_lr_actually_drives_updates():
+    """The mutated LR must change the next compiled update (LR-as-data)."""
+    state, opt = _state_with_injectable_lr(0.0)   # lr 0: no movement
+    grads = {"w": jnp.ones((2, 2))}
+    upd, new_opt = opt.update(grads, state.opt_state, state.params)
+    assert float(jnp.abs(upd["w"]).max()) == 0.0
+    loop = cb.CallbackLoop(state, [])
+    loop.set_lr(1.0)
+    upd, _ = opt.update(grads, loop.state.opt_state, loop.state.params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -np.ones((2, 2)))
+
+
+def test_warmup_callback_ramps_to_scaled_lr():
+    state, _ = _state_with_injectable_lr(0.1)
+    loop = cb.CallbackLoop(state, [], steps_per_epoch=10)
+    w = cb.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=2,
+                                      size=8)
+    loop.epoch = 0
+    w.on_batch_begin(0, loop)
+    assert loop.get_lr() == pytest.approx(0.1)          # start: initial_lr
+    loop.epoch = 1
+    w.on_batch_begin(0, loop)
+    assert loop.get_lr() == pytest.approx(0.1 * 4.5)    # halfway: 1+(8-1)/2
+    loop.epoch = 2
+    w.on_batch_begin(0, loop)
+    assert loop.get_lr() == pytest.approx(0.8)          # ramped: lr*size
+
+
+def test_warmup_epoch_granularity_without_steps_per_epoch():
+    state, _ = _state_with_injectable_lr(0.1)
+    loop = cb.CallbackLoop(state, [])
+    w = cb.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4,
+                                      size=2)
+    loop.epoch_begin(0)
+    w.on_epoch_begin(0, loop)
+    assert loop.get_lr() == pytest.approx(0.1)
+    w.on_epoch_begin(2, loop)
+    assert loop.get_lr() == pytest.approx(0.15)
+
+
+def test_schedule_callback_staircase_and_window():
+    state, _ = _state_with_injectable_lr(1.0)
+    loop = cb.CallbackLoop(state, [])
+    sc = cb.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e,
+        start_epoch=1, end_epoch=3)
+    sc.on_epoch_begin(0, loop)
+    assert loop.get_lr() == pytest.approx(1.0)   # before window: untouched
+    loop.epoch = 1
+    sc.on_epoch_begin(1, loop)
+    assert loop.get_lr() == pytest.approx(0.1)
+    loop.epoch = 3
+    sc.on_epoch_begin(3, loop)
+    assert loop.get_lr() == pytest.approx(0.1)   # after window: untouched
+
+
+def test_schedule_callback_continuous():
+    state, _ = _state_with_injectable_lr(1.0)
+    loop = cb.CallbackLoop(state, [], steps_per_epoch=4)
+    sc = cb.LearningRateScheduleCallback(
+        initial_lr=2.0, multiplier=lambda e: 1.0 / (1.0 + e),
+        staircase=False)
+    loop.epoch = 1
+    sc.on_batch_begin(2, loop)                   # epoch_float = 1.5
+    assert loop.get_lr() == pytest.approx(2.0 / 2.5)
+
+
+def test_broadcast_callback_single_process_noop_shapes():
+    state, _ = _state_with_injectable_lr(0.1)
+    loop = cb.CallbackLoop(state, [cb.BroadcastGlobalVariablesCallback(0)])
+    loop.train_begin()
+    np.testing.assert_allclose(np.asarray(loop.state.params["w"]),
+                               np.ones((2, 2)))
+
+
+def test_metric_average_single_process_noop():
+    logs = {"loss": 1.5, "acc": 0.5, "name": "x"}
+    cb.MetricAverageCallback().on_epoch_end(0, cb.CallbackLoop(
+        _state_with_injectable_lr()[0], []), logs)
+    assert logs == {"loss": 1.5, "acc": 0.5, "name": "x"}
+
+
+def test_warmup_schedule_pure_optax():
+    sched = cb.warmup_schedule(0.1, size=4, warmup_steps=10)
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(5)) == pytest.approx(0.1 * 2.5)
+    assert float(sched(10)) == pytest.approx(0.4)
+    assert float(sched(100)) == pytest.approx(0.4)
+    after = optax.constant_schedule(0.123)
+    sched2 = cb.warmup_schedule(0.1, size=4, warmup_steps=10, after=after)
+    assert float(sched2(50)) == pytest.approx(0.123)
+
+
+def test_callbacks_in_real_train_loop(mesh8):
+    """Full integration: warmup callback drives an injectable-LR
+    DistributedOptimizer through the jitted train step."""
+    opt = cb.injectable(
+        lambda learning_rate: distributed(optax.sgd(learning_rate)),
+        learning_rate=0.05)
+    model = ResNetTiny(num_classes=10, axis_name=hvd.RANK_AXIS)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(16, 8, 8, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=(16,)))
+    state = create_train_state(model, __import__("jax").random.PRNGKey(0),
+                               images[:1], opt)
+    step = make_train_step(
+        model, opt,
+        lambda lg, y: optax.softmax_cross_entropy_with_integer_labels(
+            lg, y).mean(), donate=False)
+    loop = cb.CallbackLoop(state, [
+        cb.BroadcastGlobalVariablesCallback(),
+        cb.LearningRateWarmupCallback(0.05, warmup_epochs=1, size=8),
+        cb.MetricAverageCallback(),
+    ], steps_per_epoch=2)
+    loop.train_begin()
+    losses = []
+    for epoch in range(2):
+        loop.epoch_begin(epoch)
+        for b in range(2):
+            loop.batch_begin(b)
+            new_state, loss = step(loop.state, images, labels)
+            loop.state = new_state
+            loop.batch_end(b, {"loss": float(loss)})
+            losses.append(float(loss))
+        loop.epoch_end(epoch, {"loss": losses[-1]})
+    loop.train_end()
+    assert losses[-1] < losses[0]            # it actually trained
+    assert loop.get_lr() == pytest.approx(0.4)   # warmup completed: lr*8
