@@ -31,6 +31,7 @@ from horovod_tpu.core import context_api as _ctx
 from ..core.process_sets import ProcessSet
 from .compression import Compression, Compressor
 from . import ops as _ops
+from ..tools import mismatch as _mismatch
 
 
 def _mesh():
@@ -47,6 +48,16 @@ _jit_cache: dict = {}
 def _run(builder, cache_key, tensor, out_replicated: bool):
     ctx = _ctx.context()
     ax = ctx.axis_name
+    if _mismatch.MismatchDetector.enabled():
+        # Debug-mode cross-process divergence check (HOROVOD_MISMATCH_CHECK;
+        # SURVEY.md §5.2): record this collective's signature for verify().
+        # Only PRIMITIVE key parts go into the signature — str() of rich
+        # objects embeds memory addresses that differ per process and would
+        # make every verify() a false mismatch.
+        op = "|".join(str(k) for k in cache_key[1:]
+                      if isinstance(k, (int, float, str, bool, bytes,
+                                        tuple)))
+        _mismatch.maybe_record(str(cache_key[0]), tensor, op=op)
     key = (ctx.mesh, ax, out_replicated) + cache_key
     jitted = _jit_cache.get(key)
     if jitted is None:
@@ -227,7 +238,15 @@ def adasum_allreduce(tensor: Any, **kw) -> Any:
             return _ad(x, **kw)
         return body
 
+    def stable(k, v):
+        # ProcessSet (and anything else rich) must key on stable content:
+        # str() embeds a memory address, which both defeats the jit cache
+        # (permanent retrace) and differs per process (false mismatch).
+        if isinstance(v, ProcessSet):
+            return _ps_key(v)
+        return v if isinstance(v, (int, float, str, type, bool,
+                                   type(None))) else str(v)
+
     key = ("adasum",) + tuple(sorted(
-        (k, v if isinstance(v, (int, float, str, type)) else str(v))
-        for k, v in kw.items()))
+        (k, stable(k, v)) for k, v in kw.items()))
     return _run(builder, key, tensor, out_replicated=True)

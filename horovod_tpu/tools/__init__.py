@@ -1,3 +1,22 @@
+"""Observability & tuning tools (SURVEY.md §5.1/§5.2/§2.1 parity).
+
+- :class:`Timeline` — host-side Chrome-trace writer (HOROVOD_TIMELINE).
+- :mod:`profiler` — device-side xplane traces (jax.profiler wrappers).
+- :class:`StallInspector` — step-progress watchdog (HOROVOD_STALL_CHECK_*).
+- :class:`MismatchDetector` — debug cross-process collective-signature
+  check (HOROVOD_MISMATCH_CHECK).
+- :class:`Autotuner` — GP/EI Bayesian autotuner for combiner/microbatch
+  knobs (HOROVOD_AUTOTUNE_LOG), reference parameter_manager parity.
+"""
+
+from . import profiler
+from .autotune import (Autotuner, CatDim, Dim, GaussianProcess, IntDim,
+                       LogIntDim, expected_improvement)
+from .mismatch import MismatchDetector, MismatchError, detector, maybe_record
+from .stall import StallInspector
 from .timeline import Timeline
 
-__all__ = ["Timeline"]
+__all__ = ["Autotuner", "CatDim", "Dim", "GaussianProcess", "IntDim",
+           "LogIntDim", "MismatchDetector", "MismatchError",
+           "StallInspector", "Timeline", "detector",
+           "expected_improvement", "maybe_record", "profiler"]

@@ -1,0 +1,105 @@
+"""Debug-mode collective-signature mismatch detector.
+
+Reference parity (SURVEY.md §5.2): the reference has no sanitizer harness;
+its only cross-rank divergence tooling is the stall inspector plus the
+controller's shape/dtype mismatch errors raised during negotiation
+(controller.cc builds an error Response when ranks disagree). Under SPMD
+there is no negotiation to catch disagreement, so divergence (different
+shapes fed on different hosts, drifted step counts, different op sequences)
+surfaces as a hang or garbage numerics instead.
+
+This detector is the XLA-world replacement the survey prescribes: each
+process appends a signature per collective/step — ``(name, shape, dtype,
+op)`` — into a rolling digest; :func:`verify` compares digests across all
+processes (one tiny allgather) and raises with the divergent processes
+listed. Enable via ``HOROVOD_MISMATCH_CHECK=1`` (eager ops record
+automatically) and call ``verify()`` at step/epoch boundaries, or use it
+standalone around any suspect region.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.logging import get_logger
+
+
+class MismatchError(RuntimeError):
+    pass
+
+
+class MismatchDetector:
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._digest = hashlib.sha256()
+        self._count = 0
+        self._recent: List[str] = []
+        self._capacity = capacity
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("HOROVOD_MISMATCH_CHECK", "").lower() in (
+            "1", "true", "yes", "on")
+
+    def record(self, name: str, shape: Any = None, dtype: Any = None,
+               op: str = "") -> None:
+        sig = f"{name}|{tuple(shape) if shape is not None else ()}|" \
+              f"{np.dtype(dtype).name if dtype is not None else ''}|{op}"
+        with self._lock:
+            self._digest.update(sig.encode())
+            self._count += 1
+            self._recent.append(sig)
+            if len(self._recent) > self._capacity:
+                del self._recent[: len(self._recent) - self._capacity]
+
+    def record_tree(self, name: str, tree: Any, op: str = "") -> None:
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            self.record(f"{name}.{i}", getattr(leaf, "shape", ()),
+                        getattr(leaf, "dtype", None), op)
+
+    def fingerprint(self) -> bytes:
+        with self._lock:
+            return self._digest.digest() + self._count.to_bytes(8, "little")
+
+    def verify(self, context: str = "") -> None:
+        """Raise :class:`MismatchError` if any process's collective history
+        diverges from process 0's. Cheap: allgathers 40 bytes."""
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+        fp = np.frombuffer(self.fingerprint(), np.uint8)
+        all_fp = np.asarray(multihost_utils.process_allgather(fp))
+        all_fp = all_fp.reshape(jax.process_count(), -1)
+        bad = [p for p in range(all_fp.shape[0])
+               if not np.array_equal(all_fp[p], all_fp[0])]
+        if bad:
+            with self._lock:
+                tail = self._recent[-5:]
+            raise MismatchError(
+                f"collective signature mismatch {context or ''}: processes "
+                f"{bad} diverge from process 0 after {self._count} recorded "
+                f"collectives; this process's last signatures: {tail} "
+                f"(reference analog: controller.cc shape-mismatch error)")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._digest = hashlib.sha256()
+            self._count = 0
+            self._recent.clear()
+
+
+#: process-global instance the eager layer records into when enabled.
+detector = MismatchDetector()
+
+
+def maybe_record(name: str, tensor: Any, op: str = "") -> None:
+    """Hook for the collectives layer: no-op unless
+    ``HOROVOD_MISMATCH_CHECK`` is on."""
+    if MismatchDetector.enabled():
+        detector.record_tree(name, tensor, op)

@@ -1,0 +1,129 @@
+"""Stall inspector — the training-progress watchdog.
+
+Reference parity: ``horovod/common/stall_inspector.cc`` (SURVEY.md §2.1) —
+the reference flags tensors submitted on some ranks but not others for
+>60 s (``HOROVOD_STALL_CHECK_TIME_SECONDS``) and can hard-shutdown after
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+
+Under SPMD there is no per-tensor negotiation to diverge, so the failure
+mode shifts: a lost peer / hung ICI collective freezes the WHOLE step on
+every rank. The TPU-true analog is therefore a step-progress watchdog: the
+loop reports progress (``record`` or the ``wrap`` decorator); a daemon
+thread warns when no step completes within the warning window and invokes
+the shutdown action after the shutdown window (default: raise
+``HorovodInternalError`` in the loop via a poisoned flag, which under
+``@elastic.run`` triggers recovery — the same escalation path the
+reference's shutdown takes).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Optional
+
+from ..core.config import Config
+from ..core.exceptions import HorovodInternalError
+from ..core.logging import get_logger
+
+
+class StallInspector:
+    def __init__(self, warning_sec: float = 60.0,
+                 shutdown_sec: float = 0.0,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 on_shutdown: Optional[Callable[[float], None]] = None,
+                 poll_interval_sec: Optional[float] = None,
+                 enabled: bool = True):
+        self.warning_sec = warning_sec
+        self.shutdown_sec = shutdown_sec
+        self.enabled = enabled
+        self._on_stall = on_stall
+        self._on_shutdown = on_shutdown
+        self._poll = poll_interval_sec or max(0.05, min(warning_sec / 4, 5.0))
+        self._last = time.monotonic()
+        self._step = 0
+        self._warned = False
+        self._poisoned = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_config(cls, config: Optional[Config] = None) -> "StallInspector":
+        cfg = config or Config.from_env()
+        return cls(warning_sec=cfg.stall_check_warning_sec,
+                   shutdown_sec=cfg.stall_check_shutdown_sec,
+                   enabled=not cfg.stall_check_disable)
+
+    # -- progress reporting --------------------------------------------------
+
+    def record(self, step: Optional[int] = None) -> None:
+        """Report that a step completed. Raises HorovodInternalError if the
+        watchdog already declared this worker dead (so the elastic wrapper
+        can recover instead of hanging forever)."""
+        if self._poisoned:
+            self._poisoned = False
+            raise HorovodInternalError(
+                f"stall inspector: no progress for >{self.shutdown_sec:.0f}s")
+        self._last = time.monotonic()
+        self._step = step if step is not None else self._step + 1
+        self._warned = False
+
+    def wrap(self, step_fn: Callable) -> Callable:
+        """Wrap a train-step callable so every completed call records
+        progress (checks the poison flag before dispatch too)."""
+        @functools.wraps(step_fn)
+        def wrapped(*a, **kw):
+            if self._poisoned:
+                self.record()      # raises
+            out = step_fn(*a, **kw)
+            self.record()
+            return out
+        return wrapped
+
+    # -- watchdog thread -----------------------------------------------------
+
+    def start(self) -> "StallInspector":
+        if not self.enabled:
+            # HOROVOD_STALL_CHECK_DISABLE: the reference's kill-switch —
+            # no watchdog, record() still cheap/no-op-safe.
+            return self
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._watch, daemon=True,
+                                            name="hvd-stall-inspector")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll):
+            idle = time.monotonic() - self._last
+            if idle > self.warning_sec and not self._warned:
+                self._warned = True
+                get_logger().warning(
+                    "stall inspector: no step progress for %.0fs "
+                    "(last step %d) — a peer or collective may be hung "
+                    "(reference: stall_inspector.cc warning)", idle,
+                    self._step)
+                if self._on_stall:
+                    self._on_stall(idle)
+            if self.shutdown_sec and idle > self.shutdown_sec:
+                get_logger().error(
+                    "stall inspector: exceeded shutdown window (%.0fs); "
+                    "poisoning the step loop", idle)
+                if self._on_shutdown:
+                    self._on_shutdown(idle)
+                self._poisoned = True
+                self._last = time.monotonic()   # don't re-fire every poll

@@ -1,0 +1,201 @@
+"""Observability/tuning tools tests (SURVEY.md §5.1/§5.2/§2.1)."""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.tools import (Autotuner, GaussianProcess, IntDim, LogIntDim,
+                               CatDim, MismatchDetector, StallInspector,
+                               Timeline, expected_improvement)
+
+
+# --- timeline ----------------------------------------------------------------
+
+def test_timeline_writes_valid_chrome_trace(tmp_path):
+    p = str(tmp_path / "t.json")
+    tl = Timeline(p, mark_cycles=True)
+    tl.activity_start("ALLREDUCE", "DISPATCH", rank=0)
+    tl.activity_end("ALLREDUCE", "DISPATCH", rank=0)
+    tl.marker("EPOCH_END")
+    tl.mark_cycle()
+    with tl.span("CHECKPOINT"):
+        pass
+    tl.close()
+    events = json.load(open(p))
+    phases = [e["ph"] for e in events]
+    assert phases.count("B") == phases.count("E") == 2
+    assert "i" in phases
+    names = {e["name"] for e in events}
+    assert {"DISPATCH", "EPOCHEND" if "EPOCHEND" in names else "EPOCH_END",
+            "CYCLE"} <= names
+
+
+def test_timeline_via_env_records_eager_dispatch(tmp_path, monkeypatch):
+    p = str(tmp_path / "hvd.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", p)
+    hvd.shutdown()
+    hvd.init()
+    hvd.eager.allreduce(jnp.ones((8, 2)))
+    hvd.shutdown()   # closes the timeline
+    events = json.load(open(p))
+    cats = {e.get("cat") for e in events}
+    assert "ALLREDUCE" in cats
+
+
+# --- stall inspector ---------------------------------------------------------
+
+def test_stall_inspector_warns_and_poisons():
+    warned = []
+    si = StallInspector(warning_sec=0.08, shutdown_sec=0.2,
+                        on_stall=lambda idle: warned.append(idle),
+                        poll_interval_sec=0.02)
+    with si:
+        time.sleep(0.45)
+        assert warned, "warning callback never fired"
+        with pytest.raises(HorovodInternalError):
+            si.record()
+    # after the poison is consumed, recording works again
+    si.record(5)
+    assert si._step == 5
+
+
+def test_stall_inspector_wrap_records():
+    si = StallInspector(warning_sec=100)
+    calls = []
+    stepped = si.wrap(lambda x: calls.append(x) or x * 2)
+    assert stepped(3) == 6
+    assert si._step == 1 and calls == [3]
+
+
+def test_stall_inspector_from_config(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "7")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "21")
+    si = StallInspector.from_config()
+    assert si.warning_sec == 7.0 and si.shutdown_sec == 21.0
+
+
+# --- mismatch detector -------------------------------------------------------
+
+def test_mismatch_detector_fingerprint_sensitivity():
+    a, b = MismatchDetector(), MismatchDetector()
+    a.record("allreduce", (4, 4), np.float32, "Average")
+    b.record("allreduce", (4, 4), np.float32, "Average")
+    assert a.fingerprint() == b.fingerprint()
+    b.record("allreduce", (4, 8), np.float32, "Average")   # shape diverges
+    assert a.fingerprint() != b.fingerprint()
+
+
+def test_mismatch_detector_single_process_verify_noop():
+    d = MismatchDetector()
+    d.record("x", (1,), np.float32)
+    d.verify("step 3")          # process_count()==1: never raises
+    d.reset()
+    assert d._count == 0
+
+
+def test_mismatch_records_eager_ops_when_enabled(monkeypatch):
+    from horovod_tpu.tools import detector
+    detector.reset()
+    monkeypatch.setenv("HOROVOD_MISMATCH_CHECK", "1")
+    hvd.eager.allreduce(jnp.ones((8, 2)))
+    assert detector._count >= 1
+    assert any("allreduce" in s for s in detector._recent)
+    detector.reset()
+
+
+# --- autotuner ---------------------------------------------------------------
+
+def test_gp_fits_and_predicts():
+    X = np.linspace(0, 1, 8)[:, None]
+    y = np.sin(3 * X[:, 0])
+    gp = GaussianProcess()
+    gp.fit(X, y)
+    mu, sigma = gp.predict(X)
+    np.testing.assert_allclose(mu, y, atol=0.05)     # interpolates
+    mu2, sigma2 = gp.predict(np.asarray([[0.5]]))
+    assert sigma2[0] < 0.5
+
+
+def test_expected_improvement_prefers_uncertain_high_mean():
+    mu = np.asarray([0.0, 1.0, 1.0])
+    sigma = np.asarray([0.01, 0.01, 0.5])
+    ei = expected_improvement(mu, sigma, best=1.0)
+    assert ei[2] > ei[1] > ei[0] - 1e-12
+
+
+def test_autotuner_finds_optimum_of_quadratic(tmp_path):
+    """BO must beat random warmup on a smooth objective."""
+    log = str(tmp_path / "autotune.csv")
+    tuner = Autotuner({"x": IntDim(0, 100)}, warmup_trials=4, max_trials=20,
+                      log_path=log, seed=3)
+    while not tuner.converged():
+        p = tuner.propose()
+        score = -((p["x"] - 70) / 100.0) ** 2       # peak at x=70
+        tuner.report(p, score)
+    assert abs(tuner.best_params()["x"] - 70) <= 10
+    rows = open(log).read().strip().splitlines()
+    assert rows[0] == "trial,x,score" and len(rows) >= 5
+    tuner.close()
+
+
+def test_autotuner_dims_roundtrip():
+    d = LogIntDim(1 << 20, 1 << 28)
+    assert d.from_unit(0.0) == 1 << 20 and d.from_unit(1.0) == 1 << 28
+    assert d.from_unit(d.to_unit(1 << 24)) == 1 << 24
+    c = CatDim(("none", "minimal", "full"))
+    assert c.from_unit(c.to_unit("minimal")) == "minimal"
+    i = IntDim(1, 16)
+    assert i.from_unit(i.to_unit(7)) == 7
+
+
+def test_autotuner_patience_stops_early():
+    tuner = Autotuner({"x": IntDim(0, 10)}, warmup_trials=2, max_trials=100,
+                      patience=5, seed=0)
+    n = 0
+    while not tuner.converged():
+        tuner.report(tuner.propose(), 0.0)          # flat: never improves
+        n += 1
+    assert n < 100
+
+
+def test_autotuner_empty_space_rejected():
+    with pytest.raises(ValueError):
+        Autotuner({})
+
+
+def test_stall_inspector_disable_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    si = StallInspector.from_config()
+    si.start()
+    assert si._thread is None           # kill-switch honored
+
+
+def test_mismatch_enabled_case_insensitive(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MISMATCH_CHECK", "TRUE")
+    assert MismatchDetector.enabled()
+
+
+def test_autotuner_context_manager(tmp_path):
+    with Autotuner({"x": IntDim(0, 4)}, warmup_trials=1, max_trials=2,
+                   log_path=str(tmp_path / "l.csv")) as t:
+        t.report(t.propose(), 1.0)
+    assert t._log_writer is None        # closed on exit
+
+
+def test_eager_adasum_cache_key_stable_with_process_set():
+    """ProcessSet in the eager adasum key must not embed an address repr
+    (permanent jit-cache miss + false cross-process mismatch)."""
+    from horovod_tpu.collectives import eager as eager_mod
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    before = len(eager_mod._jit_cache)
+    hvd.eager.adasum_allreduce(jnp.ones((8, 2)), process_set=ps)
+    mid = len(eager_mod._jit_cache)
+    hvd.eager.adasum_allreduce(jnp.ones((8, 2)), process_set=ps)
+    after = len(eager_mod._jit_cache)
+    assert mid == before + 1 and after == mid   # second call: cache hit
