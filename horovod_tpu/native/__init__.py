@@ -1,0 +1,243 @@
+"""horovod_tpu.native — C++ host runtime (ctypes-bound).
+
+Reference parity (SURVEY.md §2.1): the pieces of the reference's native
+core that still belong on the host under SPMD — thread pool
+(thread_pool.cc), timeline writer thread (timeline.cc), and the
+prefetch/memcpy machinery (the fusion buffer's MEMCPY_IN role) applied to
+the TPU's real host bottleneck: the input pipeline. See
+``src/hvd_runtime.cc``.
+
+Everything degrades gracefully: if no C++ toolchain is present,
+:func:`available` is False and :class:`RecordPipeline` transparently uses
+the pure-numpy fallback with identical semantics (the tests run both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lib_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HOROVOD_DISABLE_NATIVE", "").lower() in (
+                "1", "true", "yes", "on"):
+            return None
+        from .build import build
+        path = build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.hvd_runtime_abi_version.restype = ctypes.c_int
+        if lib.hvd_runtime_abi_version() != 1:
+            return None
+        # signatures
+        lib.hvd_pool_create.restype = ctypes.c_void_p
+        lib.hvd_pool_create.argtypes = [ctypes.c_int]
+        lib.hvd_pool_counter_add.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_longlong]
+        lib.hvd_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_timeline_open.restype = ctypes.c_void_p
+        lib.hvd_timeline_open.argtypes = [ctypes.c_char_p]
+        lib.hvd_timeline_event.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char, ctypes.c_int, ctypes.c_int]
+        lib.hvd_timeline_close.argtypes = [ctypes.c_void_p]
+        lib.hvd_pipeline_create.restype = ctypes.c_void_p
+        lib.hvd_pipeline_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint, ctypes.c_int, ctypes.c_int]
+        lib.hvd_pipeline_next.restype = ctypes.c_longlong
+        lib.hvd_pipeline_next.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_uint8)]
+        lib.hvd_pipeline_error.restype = ctypes.c_char_p
+        lib.hvd_pipeline_error.argtypes = [ctypes.c_void_p]
+        lib.hvd_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native runtime built & loaded."""
+    return _load() is not None
+
+
+class NativeTimeline:
+    """C++ writer-thread Chrome-trace timeline (drop-in for the hot path;
+    same file format as tools.timeline.Timeline)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._h = lib.hvd_timeline_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open timeline file {path}")
+
+    def activity_start(self, name: str, activity: str, rank: int = 0) -> None:
+        self._lib.hvd_timeline_event(self._h, activity.encode(),
+                                     name.encode(), b"B", rank, 0)
+
+    def activity_end(self, name: str, activity: str, rank: int = 0) -> None:
+        self._lib.hvd_timeline_event(self._h, activity.encode(),
+                                     name.encode(), b"E", rank, 0)
+
+    def marker(self, name: str, rank: int = 0) -> None:
+        self._lib.hvd_timeline_event(self._h, name.encode(), b"", b"i",
+                                     rank, 0)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_timeline_close(self._h)
+            self._h = None
+
+
+class RecordPipeline:
+    """Prefetching batch reader over fixed-size-record binary files.
+
+    Yields ``np.ndarray`` batches of shape ``(batch_size, *record_shape)``.
+    Native path: multithreaded C++ readers with a bounded prefetch queue.
+    Fallback path: single-threaded numpy with identical ordering semantics
+    (same seed ⇒ same batches).
+    """
+
+    def __init__(self, paths: Sequence[str], record_shape: Tuple[int, ...],
+                 dtype, batch_size: int, shuffle: bool = True, seed: int = 0,
+                 n_threads: int = 4, prefetch: int = 4,
+                 drop_remainder: bool = True,
+                 force_fallback: bool = False):
+        self.paths = [os.path.abspath(p) for p in paths]
+        self.record_shape = tuple(record_shape)
+        self.dtype = np.dtype(dtype)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.record_bytes = int(np.prod(self.record_shape)) * \
+            self.dtype.itemsize
+        self.drop_remainder = drop_remainder
+        self._n_threads = n_threads
+        self._prefetch = prefetch
+        self._lib = None if force_fallback else _load()
+        self._h = None
+        self._fallback_iter = None
+        self._start()
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def _start(self) -> None:
+        if self._lib is not None:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths])
+            self._h = self._lib.hvd_pipeline_create(
+                arr, len(self.paths), self.record_bytes, self.batch_size,
+                self._n_threads, self._prefetch, self.seed,
+                1 if self.shuffle else 0, 1 if self.drop_remainder else 0)
+            err = self._lib.hvd_pipeline_error(self._h).decode()
+            if err:
+                self.close()
+                raise OSError(f"pipeline init failed: {err}")
+        else:
+            self._fallback_iter = self._fallback_batches()
+
+    # -- fallback (identical semantics, pure numpy) --------------------------
+
+    def _fallback_batches(self):
+        index: List[Tuple[str, int]] = []
+        for p in self.paths:
+            sz = os.path.getsize(p)
+            if sz % self.record_bytes:
+                raise OSError(f"{p} size not a multiple of record_bytes")
+            index.extend((p, i) for i in range(sz // self.record_bytes))
+        if self.shuffle:
+            # Match the C++ std::mt19937/std::shuffle? Different PRNGs —
+            # documented: the two paths agree on the SET of records per
+            # epoch, not the permutation.
+            np.random.RandomState(self.seed).shuffle(index)
+        files = {p: open(p, "rb") for p in self.paths}
+        try:
+            n_full = len(index) // self.batch_size
+            total = n_full if self.drop_remainder else \
+                -(-len(index) // self.batch_size)
+            for b in range(total):
+                chunk = index[b * self.batch_size:(b + 1) * self.batch_size]
+                out = np.empty((len(chunk), self.record_bytes), np.uint8)
+                for j, (p, rec) in enumerate(chunk):
+                    f = files[p]
+                    f.seek(rec * self.record_bytes)
+                    out[j] = np.frombuffer(f.read(self.record_bytes),
+                                           np.uint8)
+                yield out
+        finally:
+            for f in files.values():
+                f.close()
+
+    # -- iteration -----------------------------------------------------------
+
+    def next_batch(self) -> Optional[np.ndarray]:
+        """Next batch, or None at end of data."""
+        if self._lib is not None:
+            buf = np.empty(self.batch_size * self.record_bytes, np.uint8)
+            n = self._lib.hvd_pipeline_next(
+                self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+            if n < 0:
+                raise OSError("pipeline error: "
+                              + self._lib.hvd_pipeline_error(self._h)
+                              .decode())
+            if n == 0:
+                return None
+            raw = buf[: n * self.record_bytes]
+        else:
+            try:
+                raw = next(self._fallback_iter)
+            except StopIteration:
+                return None
+            n = raw.shape[0]
+            raw = raw.reshape(-1)
+        return raw.view(self.dtype).reshape((n,) + self.record_shape)
+
+    def __iter__(self):
+        while True:
+            b = self.next_batch()
+            if b is None:
+                return
+            yield b
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_pipeline_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+__all__ = ["NativeTimeline", "RecordPipeline", "available"]
