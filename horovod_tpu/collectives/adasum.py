@@ -37,14 +37,28 @@ from .compression import Compression, Compressor
 def _combine(a, b, eps=0.0):
     """The Adasum pairwise operator; symmetric, so both partners compute the
     identical result. Zero-norm inputs degrade gracefully to plain sum."""
+    from ..ops.fused import adasum_coefficients
     dot = jnp.vdot(a, b)
     na = jnp.vdot(a, a)
     nb = jnp.vdot(b, b)
-    ca = jnp.where(na > eps, 1.0 - dot / (2.0 * jnp.where(na > eps, na, 1.0)),
-                   1.0)
-    cb = jnp.where(nb > eps, 1.0 - dot / (2.0 * jnp.where(nb > eps, nb, 1.0)),
-                   1.0)
+    ca, cb = adasum_coefficients(dot, na, nb, eps)
     return ca * a + cb * b
+
+
+_PALLAS_COMBINE_MIN_SIZE = 1 << 16  # below this the pallas dispatch isn't worth it
+
+
+def _combine_dispatch(a, b):
+    """Use the single-pass Pallas combine (ops/fused.py) on TPU for large
+    working vectors — the reference's fused ComputeDotAndNormSqrds property —
+    and plain jnp elsewhere (XLA on CPU, tiny tensors, and the fp64
+    accumulate option, whose extra precision the f32 kernel would defeat)."""
+    if (jax.default_backend() == "tpu"
+            and a.size >= _PALLAS_COMBINE_MIN_SIZE
+            and a.dtype == jnp.float32):
+        from ..ops.fused import fused_combine
+        return fused_combine(a, b)
+    return _combine(a, b)
 
 
 def _butterfly(x, axis: str, ranks=None, compression: Compressor = Compression.none):
@@ -76,7 +90,7 @@ def _butterfly(x, axis: str, ranks=None, compression: Compressor = Compression.n
         send, cctx = compression.compress(x)
         recv = lax.ppermute(send, axis, perm)
         recv = compression.decompress(recv, cctx).astype(x.dtype)
-        x = _combine(x, recv)
+        x = _combine_dispatch(x, recv)
         d *= 2
     return x
 

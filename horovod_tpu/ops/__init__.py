@@ -1,0 +1,24 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference keeps its hand-written device code in
+``horovod/common/ops/cuda/cuda_kernels.cu`` (fused scale-memcpy) and the
+templated Adasum core (``ops/adasum/adasum.h``) — SURVEY.md §2.2. The TPU
+equivalents live here as Pallas kernels; everything else is left to XLA
+fusion, which already covers what most of the reference's CUDA glue does.
+"""
+
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    merge_partials,
+)
+from .fused import (  # noqa: F401
+    fused_combine,
+    fused_norms_dot,
+)
+
+__all__ = [
+    "flash_attention",
+    "merge_partials",
+    "fused_combine",
+    "fused_norms_dot",
+]

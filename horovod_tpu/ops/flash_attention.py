@@ -1,0 +1,262 @@
+"""Pallas TPU flash attention with ring-mergeable softmax residuals.
+
+Capability-NEW vs the reference (SURVEY.md §5.7): the reference never touches
+activations, so it has no attention kernel at all. This is the hot-op half of
+the framework's long-context story (parallel/ring.py is the collective half):
+a blockwise-softmax attention kernel that keeps the [Tq, Tk] score matrix out
+of HBM entirely — each (q-block, k-block) tile is produced in VMEM, folded
+into running (max, denominator, accumulator) state, and discarded. Scores hit
+the MXU as [block_q, D] x [D, block_k] matmuls in fp32.
+
+Two properties matter for the distributed design:
+
+- **Residuals** (``return_residuals=True``): the kernel can return the
+  running max ``m`` and denominator ``l`` alongside the normalised output, so
+  two partial attentions over disjoint key sets can be combined *exactly*
+  with :func:`merge_partials`. That is precisely what ring attention needs —
+  each ppermute step computes a partial against the resident K/V shard and
+  merges it into the carry, so the kernel composes with the ICI ring without
+  any cross-step state inside the kernel.
+- **Causal block skipping**: with ``causal=True`` tiles strictly above the
+  diagonal are predicated off with ``pl.when``, saving ~half the MXU work.
+
+The backward pass recomputes attention from the saved (q, k, v, o, m, l) —
+the standard flash trade of FLOPs for HBM (SURVEY.md §7 lists remat as the
+stock TPU memory lever). It is implemented with the same blockwise jnp math
+(`jax.custom_vjp`), which XLA fuses well; a dedicated backward kernel is a
+further optimisation, not a correctness need.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode, which is how
+the CPU test mesh exercises it (the reference's CPU+Gloo fake-backend trick,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite mask value: exp() underflows cleanly, no NaN algebra
+
+_LANE = 128
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+               acc, m_s, l_s, *, scale, causal, bq, bk, nk, valid_k):
+    """One (batch*head, q-block, k-block) grid step.
+
+    Scratch (persists across the innermost k-block grid dim):
+      acc [bq, D] f32 — unnormalised output accumulator
+      m_s [bq, 128] f32 — running row max (broadcast over lanes)
+      l_s [bq, 128] f32 — running denominator (broadcast over lanes)
+    """
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # Causal: skip tiles strictly above the diagonal (no q position in this
+    # block can see any k position in that block).
+    visible = ((iq + 1) * bq - 1 >= ik * bk) if causal else (ik >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if causal:
+            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if valid_k % bk:  # padded key columns must never win the softmax
+            s = jnp.where(k_pos < valid_k, s, NEG_INF)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Rows still fully masked have m_new == NEG_INF; exp(s - m_new) would
+        # be exp(0) = 1 there, so zero those probabilities explicitly.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:] = jnp.broadcast_to(
+            l_s[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_s.shape)
+        acc[:] = acc[:] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_s[:, :1]
+        o_ref[0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        # Residuals are [BH, Tq, 1] so the block's trailing dims (bq, 1)
+        # satisfy the TPU tiling rule (sublane divisible by 8, lane equal to
+        # the array dim).
+        m_ref[0] = m_s[:, :1]
+        l_ref[0] = l_s[:, :1]
+
+
+def _pad_axis(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), n
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _fa_call(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    """q [BH, Tq, D], k/v [BH, Tk, D] → (o [BH, Tq, D], m, l [BH, Tq])."""
+    BH, Tq0, D = q.shape
+    q, Tq0 = _pad_axis(q, 1, block_q)
+    k, Tk0 = _pad_axis(k, 1, block_k)
+    v, _ = _pad_axis(v, 1, block_k)
+    Tq, Tk = q.shape[1], k.shape[1]
+    nq, nk = Tq // block_q, Tk // block_k
+    kern = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                             bq=block_q, bk=block_k, nk=nk, valid_k=Tk0)
+    o, m, l = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :Tq0], m[:, :Tq0, 0], l[:, :Tq0, 0]
+
+
+def _reference_partial(q, k, v, *, causal, scale):
+    """Blockless jnp oracle with the same (o, m, l) partial semantics.
+
+    Used as the recompute path of the backward pass and by the test suite.
+    q [B, Tq, H, D]; k/v [B, Tk, H, D]; returns o [B,Tq,H,D], m/l [B,H,Tq].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m[..., None]))
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o / jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
+    return o.astype(q.dtype), m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fa_core(q, k, v, causal, scale, block_q, block_k):
+    interpret = _use_interpret()
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, D)
+    o, m, l = _fa_call(fold(q), fold(k), fold(v), causal=causal, scale=scale,
+                       block_q=block_q, block_k=block_k, interpret=interpret)
+    o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    return o, m.reshape(B, H, Tq), l.reshape(B, H, Tq)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _fa_core(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, cts):
+    q, k, v = res
+    do, dm, dl = cts
+    # The m/l residuals carry real cotangents when the caller merges partials
+    # (ring attention weights each partial by exp(m_i - m) * l_i), so the
+    # recompute must differentiate through all three outputs.
+
+    def recompute(q, k, v):
+        return _reference_partial(q, k, v, causal=causal, scale=scale)
+
+    _, vjp = jax.vjp(recompute, q, k, v)
+    return vjp((do.astype(q.dtype), dm.astype(jnp.float32),
+                dl.astype(jnp.float32)))
+
+
+_fa_core.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    return_residuals: bool = False):
+    """Blockwise (flash) attention on [B, T, H, D] tensors.
+
+    Returns the attention output, plus ``(m, l)`` softmax residuals of shape
+    [B, H, Tq] when ``return_residuals`` — feed those to
+    :func:`merge_partials` to combine attention over disjoint key shards
+    (ring attention's per-step merge).
+    """
+    D = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    # Clamp to the sequence length rounded UP to a multiple of 8: block
+    # sublane dims must stay 8-divisible for the TPU tiling rule (padding
+    # covers the remainder).
+    block_q = min(block_q, -(-max(q.shape[1], 1) // 8) * 8)
+    block_k = min(block_k, -(-max(k.shape[1], 1) // 8) * 8)
+    o, m, l = _fa_core(q, k, v, causal, float(scale), block_q, block_k)
+    if return_residuals:
+        return o, (m, l)
+    return o
+
+
+def merge_partials(p1: Tuple, p2: Tuple) -> Tuple:
+    """Exactly combine two attention partials over disjoint key sets.
+
+    Each partial is ``(o [B,T,H,D], m [B,H,T], l [B,H,T])`` with ``o``
+    normalised by its own ``l`` (a partial that saw zero keys has l == 0 and
+    contributes nothing). Returns the combined partial in the same form —
+    associative and commutative, so ring steps can fold in any order.
+    """
+    o1, m1, l1 = p1
+    o2, m2, l2 = p2
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(jnp.maximum(m1 - m, NEG_INF)) * l1
+    a2 = jnp.exp(jnp.maximum(m2 - m, NEG_INF)) * l2
+    l = a1 + a2
+    den = jnp.where(l == 0.0, 1.0, l)
+    w1 = (a1 / den).transpose(0, 2, 1)[..., None]
+    w2 = (a2 / den).transpose(0, 2, 1)[..., None]
+    o = o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2
+    return o.astype(o1.dtype), m, l

@@ -227,7 +227,7 @@ class Autotuner:
         return (n - 1 - best_at) >= self.patience
 
     def close(self) -> None:
-        if self._log_writer:
+        if getattr(self, "_log_writer", None):
             self._log_writer[0].close()
             self._log_writer = None
 
