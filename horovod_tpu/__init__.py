@@ -41,7 +41,8 @@ def __getattr__(name):
     # so importing the top level stays light.
     import importlib
     if name in ("optimizer", "elastic", "models", "parallel", "runner",
-                "tools", "ops", "utils", "train", "callbacks", "checkpoint"):
+                "tools", "ops", "utils", "train", "callbacks", "checkpoint",
+                "ray", "spark"):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

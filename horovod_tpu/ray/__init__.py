@@ -1,0 +1,17 @@
+"""Ray cluster integration.
+
+Reference parity: ``horovod/ray/`` (SURVEY.md §2.5) — ``RayExecutor``
+(placement-group-based actor launch) and ``ElasticRayExecutor`` (Ray
+autoscaler wired into elastic host discovery). Rebuilt for TPU pods: each
+Ray actor owns one *host process* of the pod (the jax.distributed process
+model), not one GPU; slots-per-host defaults to the host's TPU resource.
+
+Ray itself is an optional dependency: importing this package works without
+it, constructing an executor resolves ``ray`` lazily and raises a clear
+error when absent (the reference degrades the same way).
+"""
+
+from .runner import RayExecutor  # noqa: F401
+from .elastic import ElasticRayExecutor, RayHostDiscovery  # noqa: F401
+
+__all__ = ["RayExecutor", "ElasticRayExecutor", "RayHostDiscovery"]

@@ -1,0 +1,87 @@
+"""Elastic training on Ray: autoscaler-driven host discovery.
+
+Reference parity: ``horovod/ray/elastic.py`` (SURVEY.md §2.5) —
+``ElasticRayExecutor`` plugs Ray's node list into the elastic driver's
+``HostDiscovery`` so hosts joining/leaving the Ray cluster (autoscaler
+scale-up, spot preemption) drive the same add/remove/re-rendezvous cycle a
+discovery script does (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..elastic.discovery import HostDiscovery
+from ..elastic.driver import ElasticDriver
+from ..runner.settings import Settings
+from .runner import _TPU_RESOURCE, _RayAdapter
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Discover hosts+slots from live Ray nodes.
+
+    ``use_tpu``: only count nodes advertising a TPU resource; ``slots`` per
+    host = the node's TPU resource count (or ``slots_per_host`` override).
+    The reference's version reads GPU resources the same way.
+    """
+
+    def __init__(self, use_tpu: bool = True,
+                 slots_per_host: Optional[int] = None,
+                 adapter: Optional[_RayAdapter] = None):
+        self.use_tpu = use_tpu
+        self.slots_per_host = slots_per_host
+        self._adapter = adapter or _RayAdapter()
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for node in self._adapter.nodes():
+            res = node.get("Resources", {}) or {}
+            ip = node.get("NodeManagerAddress")
+            if not ip:
+                continue
+            tpus = int(res.get(_TPU_RESOURCE, 0))
+            if self.use_tpu:
+                if tpus <= 0:
+                    continue
+                out[ip] = self.slots_per_host or tpus
+            else:
+                out[ip] = self.slots_per_host or int(res.get("CPU", 1))
+        return out
+
+
+@dataclass
+class ElasticRayExecutor:
+    """Run an elastic horovod_tpu job whose membership follows the Ray
+    cluster. ``run(command)`` blocks until the job finishes (like
+    ``horovodrun --host-discovery-script`` but with Ray as the source of
+    truth); scale events are handled by the shared ElasticDriver.
+    """
+    settings: Settings = field(default_factory=Settings)
+    use_tpu: bool = True
+    slots_per_host: Optional[int] = None
+    min_np: Optional[int] = None
+    max_np: Optional[int] = None
+    _adapter: Any = None
+    _discovery: Optional[HostDiscovery] = None
+
+    def __post_init__(self):
+        self.settings.elastic = True
+        if self.min_np is not None:
+            self.settings.min_np = self.min_np
+        if self.max_np is not None:
+            self.settings.max_np = self.max_np
+
+    def discovery(self) -> HostDiscovery:
+        if self._discovery is None:
+            self._discovery = RayHostDiscovery(
+                use_tpu=self.use_tpu, slots_per_host=self.slots_per_host,
+                adapter=self._adapter or _RayAdapter())
+        return self._discovery
+
+    def run(self, command: Sequence[str]) -> int:
+        """Launch ``command`` elastically over the current Ray nodes;
+        returns the job's exit code."""
+        driver = ElasticDriver(self.settings, command,
+                               discovery=self.discovery())
+        return driver.run()

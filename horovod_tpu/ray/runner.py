@@ -1,0 +1,234 @@
+"""RayExecutor: run horovod_tpu jobs on a Ray cluster.
+
+Reference parity: ``horovod/ray/runner.py`` (SURVEY.md §2.5). The reference
+schedules one actor per GPU inside a placement group and wires the Gloo
+rendezvous through the rank-0 actor. The TPU-native shape differs in one
+deliberate way: the unit of scheduling is the **host process** (one actor
+per TPU-VM host, owning all local chips), because that is jax.distributed's
+process model — `local_size` many chips per process, not one.
+
+The actor protocol mirrors the ssh launcher (runner/exec_run.py): every
+actor receives the same ``HOROVOD_COORDINATOR_ADDR / NUM_PROCESSES /
+PROCESS_ID / ...`` environment the CLI workers get, so ``hvd.init()`` inside
+the actor behaves identically to a CLI-launched worker.
+
+Testability: all Ray API touchpoints go through a small adapter object that
+tests replace with a fake (the reference's test_ray.py needs a live ray;
+SURVEY.md §4's command-construction pattern is the model here).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.logging import get_logger
+from ..runner.hosts import HostAssignment, HostInfo, get_host_assignments
+from ..runner.settings import Settings
+
+_TPU_RESOURCE = "TPU"
+
+
+def _import_ray():
+    try:
+        import ray
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "RayExecutor needs the `ray` package, which is not installed "
+            "in this environment. Install ray, or launch with "
+            "`python -m horovod_tpu.runner` (ssh) instead.") from e
+
+
+class _RayAdapter:
+    """The minimal surface of ray that the executor calls. Tests inject a
+    fake implementing these five methods."""
+
+    def __init__(self, ray=None):
+        self._ray = ray or _import_ray()
+
+    def init(self, **kw):
+        if not self._ray.is_initialized():
+            self._ray.init(**kw)
+
+    def nodes(self) -> List[dict]:
+        return [n for n in self._ray.nodes() if n.get("Alive", False)]
+
+    def make_worker(self, *, num_cpus: float, resources: Optional[dict],
+                    node_ip: Optional[str]):
+        opts: Dict[str, Any] = {"num_cpus": num_cpus}
+        if resources:
+            opts["resources"] = dict(resources)
+        if node_ip:
+            # Pin to a node the way the reference pins via placement groups.
+            opts.setdefault("resources", {})[f"node:{node_ip}"] = 0.001
+        return self._ray.remote(**opts)(_Worker).remote()
+
+    def get(self, refs, timeout: Optional[float] = None):
+        return self._ray.get(refs, timeout=timeout)
+
+    def kill(self, actor):
+        self._ray.kill(actor)
+
+
+class _Worker:
+    """The per-host actor body (wrapped by ``ray.remote`` at runtime)."""
+
+    def hostname(self) -> str:
+        return socket.gethostname()
+
+    def ip_address(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def set_env(self, env: Dict[str, str]) -> None:
+        os.environ.update(env)
+
+    def env(self, key: str) -> Optional[str]:
+        return os.environ.get(key)
+
+    def run(self, payload: bytes) -> bytes:
+        """Unpickle (fn, args, kwargs), run, pickle the result back."""
+        import cloudpickle
+        fn, args, kwargs = cloudpickle.loads(payload)
+        return cloudpickle.dumps(fn(*args, **kwargs))
+
+    def execute(self, fn: Callable) -> Any:
+        return fn()
+
+
+@dataclass
+class RayExecutor:
+    """Launch a horovod_tpu job as Ray actors (one per host process).
+
+    Like the reference's ``RayExecutor(settings, num_workers=...)``:
+    construct, ``start()``, then ``run()``/``execute()`` any number of
+    times, then ``shutdown()``.
+    """
+    settings: Settings = field(default_factory=Settings)
+    num_hosts: Optional[int] = None          # actors (host processes)
+    slots_per_host: int = 1                  # chips per host process
+    use_tpu: bool = True
+    cpus_per_worker: float = 1.0
+    env_vars: Dict[str, str] = field(default_factory=dict)
+    _adapter: Any = None                     # test injection point
+    _workers: List[Any] = field(default_factory=list)
+    _assignments: List[HostAssignment] = field(default_factory=list)
+
+    def _ray(self) -> _RayAdapter:
+        if self._adapter is None:
+            self._adapter = _RayAdapter()
+        return self._adapter
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Create actors, resolve the coordinator, push the env contract."""
+        ray = self._ray()
+        ray.init(ignore_reinit_error=True)
+        nodes = self._placement_nodes(ray)
+        n = len(nodes)
+        hosts = [HostInfo(hostname=ip or f"ray-node-{i}",
+                          slots=self.slots_per_host)
+                 for i, ip in enumerate(nodes)]
+        self._assignments = get_host_assignments(
+            hosts, n * self.slots_per_host)
+        resources = {_TPU_RESOURCE: self.slots_per_host} if self.use_tpu \
+            else None
+        self._workers = [
+            ray.make_worker(num_cpus=self.cpus_per_worker,
+                            resources=resources, node_ip=ip)
+            for ip in nodes]
+        # Coordinator = actor 0's IP (the reference uses the rank-0 actor
+        # for its rendezvous the same way).
+        coord_ip = ray.get(self._workers[0].ip_address.remote())
+        port = int(self.settings.coordinator_port or 29400)
+        coordinator = f"{coord_ip}:{port}"
+        env_refs = []
+        for a, w in zip(self._assignments, self._workers):
+            env = dict(self.env_vars)
+            env.update(self.settings.env)
+            env.update({
+                "HOROVOD_COORDINATOR_ADDR": coordinator,
+                "HOROVOD_START_TIMEOUT": str(self.settings.start_timeout_s),
+                "HOROVOD_NUM_PROCESSES": str(a.num_processes),
+                "HOROVOD_PROCESS_ID": str(a.process_id),
+                "HOROVOD_SIZE": str(a.world_size),
+                "HOROVOD_LOCAL_SIZE": str(a.local_size),
+                "HOROVOD_FIRST_RANK": str(a.first_rank),
+                "HOROVOD_HOSTNAME": a.hostname,
+            })
+            env_refs.append(w.set_env.remote(env))
+        ray.get(env_refs, timeout=self.settings.start_timeout_s)
+        get_logger().info("RayExecutor: %d host actors up, coordinator %s",
+                          len(self._workers), coordinator)
+
+    def _placement_nodes(self, ray: _RayAdapter) -> List[Optional[str]]:
+        """Pick nodes to place host actors on (TPU nodes when use_tpu)."""
+        nodes = ray.nodes()
+        if self.use_tpu:
+            nodes = [nd for nd in nodes
+                     if nd.get("Resources", {}).get(_TPU_RESOURCE, 0) > 0]
+        ips = [nd.get("NodeManagerAddress") for nd in nodes]
+        want = self.num_hosts
+        if want is None:
+            if not ips:
+                raise RuntimeError(
+                    "no eligible Ray nodes found (use_tpu=%s); pass "
+                    "num_hosts or add nodes" % self.use_tpu)
+            return ips
+        if len(ips) >= want:
+            return ips[:want]
+        if not ips:
+            # No resource hints at all — fall back to unpinned actors, Ray
+            # will spread them (matches reference behavior without PGs).
+            return [None] * want
+        raise RuntimeError(
+            f"need {want} hosts but only {len(ips)} eligible Ray nodes")
+
+    def shutdown(self) -> None:
+        ray = self._ray()
+        for w in self._workers:
+            try:
+                ray.kill(w)
+            except Exception:
+                pass
+        self._workers = []
+        self._assignments = []
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run ``fn(*args, **kwargs)`` on every host actor; returns results
+        ordered by process id (the reference's ``run`` contract)."""
+        import cloudpickle
+        if not self._workers:
+            raise RuntimeError("call start() before run()")
+        payload = cloudpickle.dumps((fn, args, kwargs or {}))
+        ray = self._ray()
+        refs = [w.run.remote(payload) for w in self._workers]
+        outs = ray.get(refs, timeout=None)
+        return [cloudpickle.loads(o) for o in outs]
+
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[dict] = None) -> List[Any]:
+        """Async variant: returns the per-actor object refs."""
+        import cloudpickle
+        if not self._workers:
+            raise RuntimeError("call start() before run_remote()")
+        payload = cloudpickle.dumps((fn, args, kwargs or {}))
+        return [w.run.remote(payload) for w in self._workers]
+
+    def execute(self, fn: Callable) -> List[Any]:
+        """Run a zero-arg callable on every actor (reference: execute)."""
+        ray = self._ray()
+        return ray.get([w.execute.remote(fn) for w in self._workers],
+                       timeout=None)
+
+    def execute_single(self, fn: Callable) -> Any:
+        """Run on the rank-0 host actor only."""
+        ray = self._ray()
+        return ray.get([self._workers[0].execute.remote(fn)],
+                       timeout=None)[0]

@@ -1,0 +1,25 @@
+"""Spark cluster integration.
+
+Reference parity: ``horovod/spark/`` (SURVEY.md §2.5, ~8k LoC) — the two
+public surfaces are ``horovod.spark.run(fn, ...)`` (run a function on every
+Spark executor as one Horovod job, over Spark's barrier scheduling) and the
+high-level estimators (``KerasEstimator``/``TorchEstimator``: ``fit(df)``
+materialises the DataFrame via Petastorm, trains, returns a Spark
+Transformer backed by a checkpoint Store).
+
+TPU-native redesign: the per-executor worker is a *host process* of the
+jax.distributed job (same env contract as the ssh and Ray launchers), the
+rendezvous is the barrier stage's ``allGather`` (replacing the reference's
+driver-hosted HTTP KV store), the estimator is JAX/flax+optax
+(``JaxEstimator``), and data materialisation writes numpy shards through
+``checkpoint/store.py`` (the reference's Store subsystem, already
+scheme-pluggable: local/HDFS/S3/DBFS registerable).
+
+pyspark is optional: import works without it; entry points resolve Spark
+lazily and raise a clear error when absent.
+"""
+
+from .runner import run  # noqa: F401
+from .estimator import JaxEstimator, JaxModel  # noqa: F401
+
+__all__ = ["run", "JaxEstimator", "JaxModel"]
