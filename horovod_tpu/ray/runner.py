@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.logging import get_logger
+from ..runner.exec_run import assignment_env
 from ..runner.hosts import HostAssignment, HostInfo, get_host_assignments
 from ..runner.settings import Settings
 
@@ -141,24 +142,27 @@ class RayExecutor:
                             resources=resources, node_ip=ip)
             for ip in nodes]
         # Coordinator = actor 0's IP (the reference uses the rank-0 actor
-        # for its rendezvous the same way).
-        coord_ip = ray.get(self._workers[0].ip_address.remote())
+        # for its rendezvous the same way). Bounded by start_timeout: with
+        # unschedulable actors (e.g. TPU resources requested on a cluster
+        # that has none yet) this ray.get would otherwise block forever.
+        try:
+            coord_ip = ray.get(self._workers[0].ip_address.remote(),
+                               timeout=self.settings.start_timeout_s)
+        except Exception as e:
+            self.shutdown()
+            raise RuntimeError(
+                f"Ray actors failed to schedule within "
+                f"{self.settings.start_timeout_s}s (requested resources: "
+                f"{resources}); is the cluster missing "
+                f"{_TPU_RESOURCE if self.use_tpu else 'CPU'} nodes?") from e
         port = int(self.settings.coordinator_port or 29400)
         coordinator = f"{coord_ip}:{port}"
         env_refs = []
         for a, w in zip(self._assignments, self._workers):
             env = dict(self.env_vars)
             env.update(self.settings.env)
-            env.update({
-                "HOROVOD_COORDINATOR_ADDR": coordinator,
-                "HOROVOD_START_TIMEOUT": str(self.settings.start_timeout_s),
-                "HOROVOD_NUM_PROCESSES": str(a.num_processes),
-                "HOROVOD_PROCESS_ID": str(a.process_id),
-                "HOROVOD_SIZE": str(a.world_size),
-                "HOROVOD_LOCAL_SIZE": str(a.local_size),
-                "HOROVOD_FIRST_RANK": str(a.first_rank),
-                "HOROVOD_HOSTNAME": a.hostname,
-            })
+            env.update(assignment_env(a, coordinator,
+                                      self.settings.start_timeout_s))
             env_refs.append(w.set_env.remote(env))
         ray.get(env_refs, timeout=self.settings.start_timeout_s)
         get_logger().info("RayExecutor: %d host actors up, coordinator %s",
@@ -223,12 +227,16 @@ class RayExecutor:
 
     def execute(self, fn: Callable) -> List[Any]:
         """Run a zero-arg callable on every actor (reference: execute)."""
+        if not self._workers:
+            raise RuntimeError("call start() before execute()")
         ray = self._ray()
         return ray.get([w.execute.remote(fn) for w in self._workers],
                        timeout=None)
 
     def execute_single(self, fn: Callable) -> Any:
         """Run on the rank-0 host actor only."""
+        if not self._workers:
+            raise RuntimeError("call start() before execute_single()")
         ray = self._ray()
         return ray.get([self._workers[0].execute.remote(fn)],
                        timeout=None)[0]

@@ -45,6 +45,23 @@ def find_free_port(bind_host: str = "127.0.0.1") -> int:
         return s.getsockname()[1]
 
 
+def assignment_env(a: HostAssignment, coordinator_addr: str,
+                   start_timeout_s: float) -> Dict[str, str]:
+    """The HOROVOD_* env contract for one host assignment — the single
+    source of truth shared by the ssh, Ray and Spark launchers (the
+    reference spreads the same contract across gloo_run/mpi_run/spark)."""
+    return {
+        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_START_TIMEOUT": str(start_timeout_s),
+        "HOROVOD_NUM_PROCESSES": str(a.num_processes),
+        "HOROVOD_PROCESS_ID": str(a.process_id),
+        "HOROVOD_SIZE": str(a.world_size),
+        "HOROVOD_LOCAL_SIZE": str(a.local_size),
+        "HOROVOD_FIRST_RANK": str(a.first_rank),
+        "HOROVOD_HOSTNAME": a.hostname,
+    }
+
+
 def get_run_env(a: HostAssignment, settings: Settings,
                 coordinator_addr: str, secret_key: Optional[bytes] = None
                 ) -> Dict[str, str]:
@@ -61,16 +78,7 @@ def get_run_env(a: HostAssignment, settings: Settings,
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(BLOCKED_ENV)}
     env.update(settings.env)
-    env.update({
-        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
-        "HOROVOD_START_TIMEOUT": str(settings.start_timeout_s),
-        "HOROVOD_NUM_PROCESSES": str(a.num_processes),
-        "HOROVOD_PROCESS_ID": str(a.process_id),
-        "HOROVOD_SIZE": str(a.world_size),
-        "HOROVOD_LOCAL_SIZE": str(a.local_size),
-        "HOROVOD_FIRST_RANK": str(a.first_rank),
-        "HOROVOD_HOSTNAME": a.hostname,
-    })
+    env.update(assignment_env(a, coordinator_addr, settings.start_timeout_s))
     if secret_key is not None:
         env[secret.ENV_VAR] = secret.encode(secret_key)
     return env

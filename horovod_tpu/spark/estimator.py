@@ -1,0 +1,236 @@
+"""High-level Estimator API: ``fit(data) -> Model``.
+
+Reference parity: ``horovod/spark/keras/KerasEstimator`` and
+``horovod/spark/torch/TorchEstimator`` (SURVEY.md §2.5, ~8k LoC subsystem):
+an sklearn/Spark-ML-style estimator that materialises a DataFrame, trains a
+model with the distributed machinery active, checkpoints through a Store,
+and returns a Transformer holding the trained weights.
+
+TPU-native redesign: the model is a flax Module and the optimizer an optax
+transform; the train step is the in-graph DP step from
+``horovod_tpu.train`` (gradient allreduce compiled into XLA over the mesh,
+replacing the reference's per-executor Horovod processes), and
+materialisation goes DataFrame → numpy host arrays → device shards instead
+of Petastorm parquet streaming. pyspark is optional: numpy/pandas inputs
+take the same path, which is also how the reference's estimator logic is
+unit-tested without a cluster (SURVEY.md §4 test_spark.py fakes).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from ..checkpoint.store import Store
+from ..core.logging import get_logger
+
+
+def _materialize(data, feature_col: str, label_col: str
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """DataFrame/tuple/ndarray-pair → (features, labels) numpy arrays.
+
+    Accepts a pyspark DataFrame (collected; the reference materialises via
+    Petastorm for out-of-core — documented delta), a pandas DataFrame, or a
+    ``(features, labels)`` array tuple.
+    """
+    if isinstance(data, tuple) and len(data) == 2:
+        return np.asarray(data[0]), np.asarray(data[1])
+    # pyspark DataFrame?
+    try:
+        import pyspark  # noqa: F401
+        from pyspark.sql import DataFrame as SparkDF
+        if isinstance(data, SparkDF):
+            rows = data.select(feature_col, label_col).collect()
+            feats = np.asarray([np.asarray(r[0]) for r in rows])
+            labels = np.asarray([r[1] for r in rows])
+            return feats, labels
+    except ImportError:
+        pass
+    # pandas DataFrame (duck-typed to avoid a hard dependency)
+    if hasattr(data, "columns") and hasattr(data, "__getitem__"):
+        feats = np.stack([np.asarray(v) for v in data[feature_col]])
+        labels = np.asarray(data[label_col])
+        return feats, labels
+    raise TypeError(
+        f"cannot materialise {type(data).__name__}; pass a Spark/pandas "
+        f"DataFrame or an (X, y) tuple")
+
+
+class JaxModel:
+    """The fitted Transformer (reference: the estimator's Spark Model).
+
+    Holds the trained params; ``predict`` on numpy, ``transform`` on
+    DataFrames (appends an ``output_col`` column).
+    """
+
+    def __init__(self, model, params, batch_stats=None,
+                 feature_col: str = "features",
+                 output_col: str = "prediction"):
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats or {}
+        self.feature_col = feature_col
+        self.output_col = output_col
+        self._apply_jit = None  # built lazily, reused across predict calls
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        import jax
+
+        variables = {"params": self.params}
+        if len(jax.tree_util.tree_leaves(self.batch_stats)) > 0:
+            variables["batch_stats"] = self.batch_stats
+        if self._apply_jit is None:
+            self._apply_jit = jax.jit(
+                lambda v, x: self.model.apply(v, x, train=False))
+        return np.asarray(self._apply_jit(variables, np.asarray(features)))
+
+    def transform(self, df):
+        """Spark/pandas DataFrame → same DataFrame + prediction column."""
+        try:
+            from pyspark.sql import DataFrame as SparkDF
+            if isinstance(df, SparkDF):
+                feats = np.asarray(
+                    [np.asarray(r[0])
+                     for r in df.select(self.feature_col).collect()])
+                preds = self.predict(feats)
+                spark = df.sparkSession
+                pdf = df.toPandas()
+                pdf[self.output_col] = list(np.asarray(preds))
+                return spark.createDataFrame(pdf)
+        except ImportError:
+            pass
+        feats = np.stack([np.asarray(v) for v in df[self.feature_col]])
+        out = df.copy()
+        out[self.output_col] = list(self.predict(feats))
+        return out
+
+    # -- store round trip ---------------------------------------------------
+
+    def save(self, store: Store, run_id: str) -> str:
+        import jax
+
+        path = os.path.join(store.checkpoint_path(run_id), "model.pkl")
+        payload = pickle.dumps({
+            "params": jax.device_get(self.params),
+            "batch_stats": jax.device_get(self.batch_stats),
+            "feature_col": self.feature_col,
+            "output_col": self.output_col,
+        })
+        store.write(path, payload)
+        return path
+
+    @classmethod
+    def load(cls, store: Store, run_id: str, model) -> "JaxModel":
+        path = os.path.join(store.checkpoint_path(run_id), "model.pkl")
+        blob = pickle.loads(store.read(path))
+        return cls(model, blob["params"], blob["batch_stats"],
+                   feature_col=blob["feature_col"],
+                   output_col=blob["output_col"])
+
+
+class JaxEstimator:
+    """Train a flax model over the device mesh from DataFrame-shaped data.
+
+    Parameters mirror the reference estimator's essentials: ``model`` (flax
+    Module), ``optimizer`` (optax transform), ``loss`` (``(outputs, labels)
+    -> scalar``), ``batch_size`` (GLOBAL batch per step), ``epochs``,
+    ``feature_col``/``label_col``, ``store``+``run_id`` for checkpoints,
+    ``validation`` (fraction held out for per-epoch eval).
+    """
+
+    def __init__(self, model=None, optimizer=None,
+                 loss: Optional[Callable] = None,
+                 feature_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, epochs: int = 1,
+                 validation: Optional[float] = None,
+                 store: Optional[Store] = None, run_id: str = "run",
+                 shuffle: bool = True, seed: int = 0,
+                 output_col: str = "prediction"):
+        if model is None or optimizer is None or loss is None:
+            raise ValueError("model, optimizer and loss are required")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_col = feature_col
+        self.label_col = label_col
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.validation = validation
+        self.store = store
+        self.run_id = run_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.output_col = output_col
+        self.history: list = []
+
+    def fit(self, data) -> JaxModel:
+        import jax
+        import horovod_tpu as hvd
+        from ..optimizer import distributed
+        from ..train import create_train_state, make_train_step
+
+        if not hvd.is_initialized():
+            hvd.init()
+        n = hvd.size()
+        if self.batch_size % n:
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by the "
+                f"mesh size {n} (global batch shards over the rank axis)")
+
+        feats, labels = _materialize(data, self.feature_col, self.label_col)
+        rng = np.random.RandomState(self.seed)
+        if self.validation:
+            n_val = max(1, int(len(feats) * self.validation))
+            idx = rng.permutation(len(feats))
+            val_idx, train_idx = idx[:n_val], idx[n_val:]
+            val = (feats[val_idx], labels[val_idx])
+            feats, labels = feats[train_idx], labels[train_idx]
+        else:
+            val = None
+        if len(feats) < self.batch_size:
+            raise ValueError(
+                f"need at least one global batch ({self.batch_size}) of "
+                f"rows, got {len(feats)}")
+
+        dopt = distributed(self.optimizer)
+        state = create_train_state(
+            self.model, jax.random.PRNGKey(self.seed),
+            feats[:1], dopt)
+        step = make_train_step(self.model, dopt, self.loss, donate=False)
+
+        log = get_logger()
+        steps_per_epoch = len(feats) // self.batch_size
+        for epoch in range(self.epochs):
+            order = rng.permutation(len(feats)) if self.shuffle \
+                else np.arange(len(feats))
+            epoch_loss = 0.0
+            for s in range(steps_per_epoch):
+                sel = order[s * self.batch_size:(s + 1) * self.batch_size]
+                state, loss = step(state, feats[sel], labels[sel])
+                epoch_loss += float(loss)
+            entry = {"epoch": epoch,
+                     "loss": epoch_loss / max(1, steps_per_epoch)}
+            if val is not None:
+                entry["val_loss"] = self._eval(state, val)
+            self.history.append(entry)
+            log.info("JaxEstimator epoch %d: %s", epoch, entry)
+
+        fitted = JaxModel(self.model, state.params, state.batch_stats,
+                          feature_col=self.feature_col,
+                          output_col=self.output_col)
+        if self.store is not None:
+            fitted.save(self.store, self.run_id)
+        return fitted
+
+    def _eval(self, state, val) -> float:
+        import jax
+
+        feats, labels = val
+        variables = {"params": state.params}
+        if len(jax.tree_util.tree_leaves(state.batch_stats)) > 0:
+            variables["batch_stats"] = state.batch_stats
+        out = self.model.apply(variables, feats, train=False)
+        return float(self.loss(out, labels))

@@ -42,24 +42,24 @@ def _import_pyspark():
 
 def _task_env(rank: int, size: int, coordinator: str,
               hostname: str, local_size: int = 1,
-              extra: Optional[dict] = None) -> dict:
-    """The launcher env contract (mirrors runner/exec_run.get_run_env):
-    under Spark each executor hosts exactly one process of the job."""
+              extra: Optional[dict] = None,
+              start_timeout_s: float = 600.0) -> dict:
+    """The launcher env contract via the shared
+    runner/exec_run.assignment_env source of truth: under Spark each
+    executor hosts exactly one process of the job."""
+    from ..runner.exec_run import assignment_env
+    from ..runner.hosts import HostAssignment
+    a = HostAssignment(hostname=hostname, process_id=rank,
+                       num_processes=size, first_rank=rank * local_size,
+                       local_size=local_size, world_size=size * local_size)
     env = dict(extra or {})
-    env.update({
-        "HOROVOD_COORDINATOR_ADDR": coordinator,
-        "HOROVOD_NUM_PROCESSES": str(size),
-        "HOROVOD_PROCESS_ID": str(rank),
-        "HOROVOD_SIZE": str(size * local_size),
-        "HOROVOD_LOCAL_SIZE": str(local_size),
-        "HOROVOD_FIRST_RANK": str(rank * local_size),
-        "HOROVOD_HOSTNAME": hostname,
-    })
+    env.update(assignment_env(a, coordinator, start_timeout_s))
     return env
 
 
 def _run_task(ctx, payload: bytes, extra_env: Optional[dict] = None,
-              local_size: int = 1) -> bytes:
+              local_size: int = 1,
+              start_timeout_s: float = 600.0) -> bytes:
     """Body of one barrier task: rendezvous via allGather, export env, run.
 
     ``ctx`` needs ``partitionId()`` and ``allGather(str) -> list[str]`` —
@@ -72,28 +72,32 @@ def _run_task(ctx, payload: bytes, extra_env: Optional[dict] = None,
     size = len(addrs)
     coordinator = addrs[0]
     env = _task_env(rank, size, coordinator, hostname,
-                    local_size=local_size, extra=extra_env)
+                    local_size=local_size, extra=extra_env,
+                    start_timeout_s=start_timeout_s)
     os.environ.update(env)
     fn, args, kwargs = cloudpickle.loads(payload)
     return cloudpickle.dumps(fn(*args, **kwargs))
 
 
 def _make_barrier_mapper(payload: bytes, extra_env: Optional[dict],
-                         local_size: int) -> Callable:
+                         local_size: int,
+                         start_timeout_s: float = 600.0) -> Callable:
     """Build the closure shipped to ``rdd.barrier().mapPartitions`` —
     references only module-level code so cloudpickle ships it cleanly."""
 
     def mapper(_iterator):
         from pyspark import BarrierTaskContext
         ctx = BarrierTaskContext.get()
-        yield _run_task(ctx, payload, extra_env, local_size)
+        yield _run_task(ctx, payload, extra_env, local_size,
+                        start_timeout_s)
 
     return mapper
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         num_proc: Optional[int] = None, env: Optional[dict] = None,
-        local_size: int = 1, verbose: int = 0) -> List[Any]:
+        local_size: int = 1, verbose: int = 0,
+        start_timeout_s: float = 600.0) -> List[Any]:
     """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark executors as one
     distributed job; returns per-rank results ordered by rank (the
     reference's ``horovod.spark.run`` contract)."""
@@ -106,7 +110,8 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     if verbose:
         get_logger().info("spark.run: %d barrier tasks", num_proc)
     payload = cloudpickle.dumps((fn, args, kwargs or {}))
-    mapper = _make_barrier_mapper(payload, env, local_size)
+    mapper = _make_barrier_mapper(payload, env, local_size,
+                                  start_timeout_s)
     rdd = sc.parallelize(range(num_proc), num_proc)
     outs = rdd.barrier().mapPartitions(mapper).collect()
     return [cloudpickle.loads(o) for o in outs]

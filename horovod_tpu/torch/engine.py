@@ -1,0 +1,634 @@
+"""Process-level collective engines backing the ``horovod_tpu.torch`` API.
+
+Reference parity: the role of ``horovod/common/operations.cc``'s background
+runtime + controller as seen FROM the torch binding
+(``horovod/torch/mpi_ops_v2.cc``, SURVEY.md §2.3, §3.2): every process calls
+an op with its own tensor; the runtime matches the op across processes by
+name and executes the collective. Here that runtime is a small pluggable
+*engine* working on host numpy buffers:
+
+- :class:`SingleProcessEngine` — world size 1 (the degenerate case the
+  reference also special-cases); every op is a local identity/reduction.
+- :class:`JaxProcessEngine` — multi-host TPU pods: rank = JAX process
+  index, transport = the jax.distributed coordination service + XLA
+  collectives via ``multihost_utils`` (the DCN path that replaces the
+  reference's MPI/Gloo control+data planes).
+- :class:`ThreadSimEngine` — N simulated ranks as threads in one process,
+  rendezvousing by op name. This is the test backend, playing the role the
+  reference's CPU/Gloo path plays in its parallel test tier (SURVEY.md §4:
+  "CPU+Gloo as the universal fake backend").
+
+Engines speak numpy so they stay framework-neutral; the torch layer
+(``mpi_ops.py``) owns torch<->numpy adaptation and async handles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Reduction op names — same strings as the in-graph layer
+# (collectives/ops.py) so user code can share constants.
+Sum = "sum"
+Average = "average"
+Min = "min"
+Max = "max"
+Product = "product"
+Adasum = "adasum"
+
+_ELEMENTWISE = {
+    Sum: lambda xs: np.sum(xs, axis=0),
+    Average: lambda xs: np.sum(xs, axis=0),  # divisor applied by caller
+    Min: lambda xs: np.min(xs, axis=0),
+    Max: lambda xs: np.max(xs, axis=0),
+    Product: lambda xs: np.prod(xs, axis=0),
+}
+
+
+def _adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Pairwise Adasum combine; same coefficient formula as
+    ops/fused.py:adasum_coefficients so host and device paths agree."""
+    af = a.astype(np.float64, copy=False)
+    bf = b.astype(np.float64, copy=False)
+    dot = float(np.vdot(af, bf))
+    na = float(np.vdot(af, af))
+    nb = float(np.vdot(bf, bf))
+    ca = 1.0 if na <= 0.0 else 1.0 - dot / (2.0 * na)
+    cb = 1.0 if nb <= 0.0 else 1.0 - dot / (2.0 * nb)
+    return (ca * af + cb * bf).astype(a.dtype, copy=False)
+
+
+def _adasum_tree(chunks: List[np.ndarray]) -> np.ndarray:
+    """Recursive-halving combine over the rank dimension (reference:
+    ops/adasum/adasum.h tree; collectives/adasum.py butterfly — identical
+    result for power-of-two counts, graceful for any count here)."""
+    xs = list(chunks)
+    while len(xs) > 1:
+        nxt = []
+        for i in range(0, len(xs) - 1, 2):
+            nxt.append(_adasum_combine(xs[i], xs[i + 1]))
+        if len(xs) % 2:
+            nxt.append(xs[-1])
+        xs = nxt
+    return xs[0]
+
+
+def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:
+    """Reduce per-rank arrays (joined ranks already excluded by caller)."""
+    xs = np.stack([np.asarray(a) for a in arrays])
+    if op == Adasum:
+        return _adasum_tree([xs[i] for i in range(xs.shape[0])])
+    if op not in _ELEMENTWISE:
+        raise ValueError(f"unknown reduction op: {op!r}")
+    out = _ELEMENTWISE[op](xs)
+    if op == Average:
+        out = out / len(arrays)
+    return out.astype(arrays[0].dtype, copy=False)
+
+
+class CollectiveEngine:
+    """Abstract process-collective transport (numpy payloads)."""
+
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def local_rank(self) -> int:
+        return self.rank()
+
+    def local_size(self) -> int:
+        return self.size()
+
+    def cross_rank(self) -> int:
+        return 0
+
+    def cross_size(self) -> int:
+        return 1
+
+    # Collectives. ``name`` identifies the op across ranks (the reference's
+    # tensor-name negotiation key, SURVEY.md §2.1 controller).
+    def allreduce(self, name: str, arr: np.ndarray, op: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def allgather(self, name: str, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def broadcast(self, name: str, arr: Optional[np.ndarray],
+                  root_rank: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def alltoall(self, name: str, arr: np.ndarray,
+                 splits: Optional[np.ndarray]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def reducescatter(self, name: str, arr: np.ndarray,
+                      op: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def barrier(self, name: str = "barrier") -> None:
+        raise NotImplementedError
+
+    def join(self) -> int:
+        """Mark this rank as out of data; block until all ranks joined;
+        return the last rank to join (reference ``hvd.join`` contract)."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+def _alltoall_chunks(arr: np.ndarray, splits: Optional[np.ndarray],
+                     n: int) -> List[np.ndarray]:
+    if splits is None:
+        if arr.shape[0] % n:
+            raise ValueError(
+                f"alltoall first dim {arr.shape[0]} not divisible by "
+                f"size {n} and no splits given")
+        return list(np.split(arr, n))
+    splits = np.asarray(splits, dtype=np.int64)
+    if splits.shape != (n,) or int(splits.sum()) != arr.shape[0]:
+        raise ValueError("splits must have one entry per rank summing to "
+                         "the first dimension")
+    idx = np.cumsum(splits)[:-1]
+    return list(np.split(arr, idx))
+
+
+class SingleProcessEngine(CollectiveEngine):
+    """World size 1: ops are local (what the reference degenerates to when
+    launched with -np 1)."""
+
+    def rank(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return 1
+
+    def allreduce(self, name, arr, op):
+        if op == Adasum:  # combine with nothing = identity (tree of one)
+            return np.array(arr, copy=True)
+        return reduce_arrays([arr], op)
+
+    def allgather(self, name, arr):
+        return np.array(arr, copy=True)
+
+    def broadcast(self, name, arr, root_rank):
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return np.array(arr, copy=True)
+
+    def alltoall(self, name, arr, splits):
+        n_recv = np.asarray([arr.shape[0]], dtype=np.int64)
+        return np.array(arr, copy=True), n_recv
+
+    def reducescatter(self, name, arr, op):
+        return reduce_arrays([arr], Sum if op == Average else op)
+
+    def barrier(self, name="barrier"):
+        return None
+
+    def join(self) -> int:
+        return 0
+
+
+class _Rendezvous:
+    """Name-keyed meeting point for ThreadSimEngine ranks.
+
+    Plays the controller's role (SURVEY.md §2.1: "rank 0 waits until a
+    tensor is ready on ALL ranks"): an op completes once every *active*
+    (non-joined) rank has contributed under the same key; joined ranks are
+    represented by the compute callback as zero/absent contributions, which
+    is exactly the reference JoinOp behavior. An op some rank never issues
+    raises on the waiting ranks after ``stall_timeout_s`` — the reference's
+    stall inspector (SURVEY.md §2.1) turned from a log line into an error.
+    """
+
+    def __init__(self, n: int, stall_timeout_s: float = 60.0):
+        self.n = n
+        self.stall_timeout_s = stall_timeout_s
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.pending: Dict[str, dict] = {}
+        self.joined: set = set()
+        self.generation: Dict[str, int] = {}
+
+    def run(self, key: str, rank: int, payload, compute):
+        import time as _time
+        with self.cv:
+            gen = self.generation.get(key, 0)
+            slot_key = (key, gen) if (key, gen) not in self.pending or \
+                rank not in self.pending[(key, gen)]["contrib"] else None
+            if slot_key is None:
+                # This rank already contributed to generation `gen` — it is
+                # re-issuing the op before others consumed; start next gen.
+                gen += 1
+                slot_key = (key, gen)
+            slot = self.pending.setdefault(
+                slot_key, {"contrib": {}, "result": None, "done": 0,
+                           "computed": False, "error": None})
+            slot["contrib"][rank] = payload
+            self._maybe_compute(key, gen, slot, compute)
+            deadline = _time.monotonic() + self.stall_timeout_s
+            while not slot["computed"] and slot["error"] is None:
+                self.cv.wait(timeout=min(1.0, self.stall_timeout_s))
+                self._maybe_compute(key, gen, slot, compute)
+                if (not slot["computed"] and slot["error"] is None
+                        and _time.monotonic() > deadline):
+                    slot["error"] = RuntimeError(
+                        f"collective {key!r} stalled for "
+                        f"{self.stall_timeout_s}s: ranks "
+                        f"{sorted(slot['contrib'])} of {self.n} arrived "
+                        "(reference stall_inspector analog)")
+                    self.cv.notify_all()
+            if slot["error"] is not None:
+                raise slot["error"]
+            result = slot["result"]
+            slot["done"] += 1
+            if slot["done"] == len(slot["contrib"]):
+                del self.pending[(key, gen)]
+                self.generation[key] = gen + 1
+            return result
+
+    def _maybe_compute(self, key, gen, slot, compute):
+        active = set(range(self.n)) - self.joined
+        if not slot["computed"] and slot["error"] is None \
+                and active <= set(slot["contrib"]):
+            try:
+                slot["result"] = compute(slot["contrib"],
+                                         sorted(self.joined))
+                slot["computed"] = True
+            except BaseException as e:  # propagate to every waiter
+                slot["error"] = e
+            self.cv.notify_all()
+
+    def join(self, rank: int) -> int:
+        import time as _time
+        with self.cv:
+            self.joined.add(rank)
+            # A joining rank may unblock pending collectives that were
+            # waiting only on it; waiters recompute on wake.
+            self.cv.notify_all()
+            deadline = _time.monotonic() + self.stall_timeout_s
+            while len(self.joined) < self.n:
+                self.cv.wait(timeout=min(1.0, self.stall_timeout_s))
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"join() stalled: ranks {sorted(self.joined)} of "
+                        f"{self.n} joined within {self.stall_timeout_s}s")
+            return max(self.joined)
+
+    def reset_join(self):
+        with self.cv:
+            self.joined.clear()
+
+
+class ThreadSimEngine(CollectiveEngine):
+    """N ranks as threads in one process — the test backend (reference
+    analog: CPU/Gloo multi-process test tier, SURVEY.md §4). Use with
+    :func:`horovod_tpu.torch.testing.run_parallel`, which registers each
+    thread's rank in ``self._tls``."""
+
+    def __init__(self, n: int, stall_timeout_s: float = 60.0):
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self._n = n
+        self._tls = threading.local()
+        self._rv = _Rendezvous(n, stall_timeout_s)
+
+    # -- rank registration (testing harness) --------------------------------
+
+    def set_rank(self, rank: int) -> None:
+        self._tls.rank = rank
+
+    def rank(self) -> int:
+        r = getattr(self._tls, "rank", None)
+        if r is None:
+            raise RuntimeError(
+                "calling thread has no rank; run inside "
+                "horovod_tpu.torch.testing.run_parallel")
+        return r
+
+    def size(self) -> int:
+        return self._n
+
+    # -- collectives ---------------------------------------------------------
+
+    def allreduce(self, name, arr, op):
+        def compute(contrib, joined):
+            ranks = sorted(contrib)
+            arrays = [contrib[r] for r in ranks]
+            # Joined ranks contribute zeros; Average divides by the ACTIVE
+            # count (reference join_allreduce semantics, collectives/join.py).
+            return reduce_arrays(arrays, op)
+        out = self._rv.run(f"allreduce.{name}", self.rank(),
+                           np.asarray(arr), compute)
+        return np.array(out, copy=True)
+
+    def allgather(self, name, arr):
+        def compute(contrib, joined):
+            return np.concatenate([contrib[r] for r in sorted(contrib)])
+        out = self._rv.run(f"allgather.{name}", self.rank(),
+                           np.asarray(arr), compute)
+        return np.array(out, copy=True)
+
+    def broadcast(self, name, arr, root_rank):
+        def compute(contrib, joined):
+            if root_rank not in contrib:
+                raise RuntimeError(f"broadcast root {root_rank} joined/absent")
+            return contrib[root_rank]
+        payload = None if arr is None else np.asarray(arr)
+        out = self._rv.run(f"broadcast.{name}", self.rank(), payload, compute)
+        return np.array(out, copy=True)
+
+    def alltoall(self, name, arr, splits):
+        me = self.rank()
+
+        def compute(contrib, joined):
+            chunks = {}
+            for r, (a, sp) in contrib.items():
+                chunks[r] = _alltoall_chunks(a, sp, self._n)
+            out = {}
+            for dst in contrib:
+                parts = [chunks[src][dst] for src in sorted(contrib)]
+                out[dst] = (np.concatenate(parts),
+                            np.asarray([p.shape[0] for p in parts],
+                                       dtype=np.int64))
+            return out
+        payload = (np.asarray(arr), None if splits is None
+                   else np.asarray(splits))
+        out = self._rv.run(f"alltoall.{name}", me, payload, compute)
+        recv, recv_splits = out[me]
+        return np.array(recv, copy=True), np.array(recv_splits, copy=True)
+
+    def reducescatter(self, name, arr, op):
+        me = self.rank()
+
+        def compute(contrib, joined):
+            ranks = sorted(contrib)
+            red = reduce_arrays([contrib[r] for r in ranks],
+                                Sum if op == Average else op)
+            if op == Average:
+                red = (red / len(ranks)).astype(red.dtype, copy=False)
+            n = self._n
+            if red.shape[0] % n:
+                raise ValueError(
+                    f"reducescatter first dim {red.shape[0]} not divisible "
+                    f"by size {n}")
+            return {r: c for r, c in zip(range(n), np.split(red, n))}
+        out = self._rv.run(f"reducescatter.{name}", me, np.asarray(arr),
+                           compute)
+        return np.array(out[me], copy=True)
+
+    def barrier(self, name="barrier"):
+        self._rv.run(f"barrier.{name}", self.rank(), None,
+                     lambda contrib, joined: True)
+
+    def join(self) -> int:
+        return self._rv.join(self.rank())
+
+    def reset_join(self) -> None:
+        self._rv.reset_join()
+
+
+class JaxProcessEngine(CollectiveEngine):
+    """Multi-host engine: rank = JAX process index, transport = the
+    jax.distributed coordination service + XLA DCN collectives
+    (``multihost_utils``). This is the production path on TPU pods — the
+    TPU-native replacement for the reference's MPI/Gloo transports
+    (SURVEY.md §2.7): ``jax.distributed.initialize`` is the rendezvous,
+    and the data plane rides the same ICI/DCN fabric as the training step.
+
+    Cross-process matching protocol: the underlying XLA collectives match
+    by **program order**, not by name, so every op here is one *round* —
+    a small header allgather (op kind, name, shape, joined flag) followed
+    by the payload collective. The header round is the reference
+    controller's negotiation (SURVEY.md §2.1) shrunk to its TPU-necessary
+    core: it (a) verifies all active ranks are executing the SAME op and
+    raises a mismatch error instead of silently cross-pairing collectives,
+    and (b) lets ranks that called :meth:`join` answer with zero
+    contributions (the reference JoinOp). Rounds are serialized per
+    process by a lock; the torch layer additionally submits ops from a
+    single worker thread for this engine so program order is well-defined.
+    """
+
+    def __init__(self):
+        import jax
+        self._jax = jax
+        if jax.process_count() == 1:
+            raise RuntimeError(
+                "JaxProcessEngine needs jax.distributed (process_count > 1); "
+                "use SingleProcessEngine")
+        self._lock = threading.RLock()
+        self._joined = False
+
+    #: mpi_ops keys on this to serialize submission (program order).
+    requires_ordered_submission = True
+
+    def rank(self) -> int:
+        return self._jax.process_index()
+
+    def size(self) -> int:
+        return self._jax.process_count()
+
+    def local_rank(self) -> int:
+        return 0
+
+    def local_size(self) -> int:
+        return 1
+
+    def cross_rank(self) -> int:
+        # One engine process per host (local_size 1), so the cross-host
+        # topology is the process topology (reference basics.py semantics:
+        # cross_rank = node index, cross_size = node count).
+        return self.rank()
+
+    def cross_size(self) -> int:
+        return self.size()
+
+    # -- primitives (overridden by the test fake) ---------------------------
+
+    def _allgather_fixed(self, arr: np.ndarray) -> np.ndarray:
+        """[...]-shaped array from each process → [size, ...] stack. The
+        ONLY transport primitive; everything else is protocol."""
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            np.asarray(arr), tiled=False))
+
+    # -- protocol helpers ----------------------------------------------------
+
+    def _gather_obj(self, obj) -> list:
+        """Small-object allgather via pickle + pad-to-max (the reference's
+        RequestList serialization role, flatbuffers → pickle)."""
+        import pickle
+        blob = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8).copy()
+        sizes = self._allgather_fixed(
+            np.asarray([blob.shape[0]], dtype=np.int64))
+        m = int(sizes.max())
+        padded = np.zeros(m, dtype=np.uint8)
+        padded[:blob.shape[0]] = blob
+        g = self._allgather_fixed(padded)
+        return [pickle.loads(g[i, :int(sizes[i, 0])].tobytes())
+                for i in range(g.shape[0])]
+
+    def _gather_var(self, arr: np.ndarray, shape1, dtype) -> List[np.ndarray]:
+        """Variable-first-dim payload gather (pad to max rows)."""
+        arr = np.asarray(arr, dtype=dtype).reshape((-1,) + tuple(shape1))
+        sizes = self._allgather_fixed(
+            np.asarray([arr.shape[0]], dtype=np.int64))
+        m = max(1, int(sizes.max()))
+        padded = np.zeros((m,) + tuple(shape1), dtype=dtype)
+        padded[:arr.shape[0]] = arr
+        g = self._allgather_fixed(padded)
+        return [g[i, :int(sizes[i, 0])] for i in range(g.shape[0])]
+
+    def _round(self, header: dict, payload: np.ndarray):
+        """One negotiated round: header exchange → payload gather.
+
+        Returns (headers, per_rank_payloads). Active ranks must all carry
+        the same (kind, name) — otherwise every rank raises the mismatch
+        error the silent cross-pairing would have hidden.
+        """
+        with self._lock:
+            headers = self._gather_obj(header)
+            active = [r for r, h in enumerate(headers) if not h["joined"]]
+            ops = {(h["kind"], h["name"])
+                   for h in headers if not h["joined"]}
+            if len(ops) > 1:
+                raise RuntimeError(
+                    f"collective mismatch across processes: {sorted(ops)} "
+                    "(each process must issue the same op; reference "
+                    "controller would stall here)")
+            if not active:
+                return headers, None
+            ref = next(h for h in headers if not h["joined"])
+            shape1 = tuple(ref["shape"][1:])
+            if header["joined"]:
+                payload = np.zeros((0,) + shape1, dtype=ref["dtype"])
+            payloads = self._gather_var(payload, shape1, ref["dtype"])
+            return headers, payloads
+
+    # -- collectives ---------------------------------------------------------
+
+    def _header(self, kind, name, arr, extra=None):
+        h = {"kind": kind, "name": name, "joined": self._joined,
+             "shape": tuple(np.asarray(arr).shape) if arr is not None
+             else (0,),
+             "dtype": str(np.asarray(arr).dtype) if arr is not None
+             else "float32"}
+        h.update(extra or {})
+        return h
+
+    def allreduce(self, name, arr, op):
+        arr = np.asarray(arr)
+        flat = arr.reshape(1, -1)
+        headers, payloads = self._round(
+            self._header("allreduce", name, flat, {"op": op}), flat)
+        arrays = [payloads[r][0] for r, h in enumerate(headers)
+                  if not h["joined"] and len(payloads[r])]
+        return reduce_arrays(arrays, op).reshape(arr.shape)
+
+    def allgather(self, name, arr):
+        arr = np.asarray(arr)
+        headers, payloads = self._round(
+            self._header("allgather", name, arr), arr)
+        return np.concatenate([p for p in payloads if p.shape[0]]
+                              if any(p.shape[0] for p in payloads)
+                              else [arr[:0]])
+
+    def broadcast(self, name, arr, root_rank):
+        arr = None if arr is None else np.asarray(arr)
+        payload = arr[None] if arr is not None else None
+        headers, payloads = self._round(
+            self._header("broadcast", name, payload,
+                         {"root": root_rank}), payload)
+        if headers[root_rank]["joined"]:
+            raise RuntimeError(
+                f"broadcast root {root_rank} has already joined")
+        return payloads[root_rank][0]
+
+    def alltoall(self, name, arr, splits):
+        arr = np.asarray(arr)
+        n = self.size()
+        me = self.rank()
+        sp = None if splits is None else np.asarray(splits, dtype=np.int64)
+        if sp is None:
+            if arr.shape[0] % n:
+                raise ValueError(
+                    f"alltoall first dim {arr.shape[0]} not divisible by "
+                    f"size {n} and no splits given")
+            sp = np.asarray([arr.shape[0] // n] * n, dtype=np.int64)
+        headers, payloads = self._round(
+            self._header("alltoall", name, arr,
+                         {"splits": sp.tolist()}), arr)
+        parts = []
+        for src, h in enumerate(headers):
+            if h["joined"]:
+                continue
+            ssp = np.asarray(h["splits"], dtype=np.int64)
+            lo = int(ssp[:me].sum())
+            parts.append(payloads[src][lo:lo + int(ssp[me])])
+        return (np.concatenate(parts) if parts else arr[:0],
+                np.asarray([p.shape[0] for p in parts], dtype=np.int64))
+
+    def reducescatter(self, name, arr, op):
+        arr = np.asarray(arr)
+        flat = arr.reshape(1, -1)
+        headers, payloads = self._round(
+            self._header("reducescatter", name, flat, {"op": op}), flat)
+        arrays = [payloads[r][0] for r, h in enumerate(headers)
+                  if not h["joined"] and len(payloads[r])]
+        red = reduce_arrays(arrays, Sum if op == Average else op)
+        if op == Average:
+            red = (red / len(arrays)).astype(red.dtype, copy=False)
+        red = red.reshape(arr.shape)
+        n = self.size()
+        if red.shape[0] % n:
+            raise ValueError(
+                f"reducescatter first dim {red.shape[0]} not divisible by "
+                f"size {n}")
+        return np.split(red, n)[self.rank()].copy()
+
+    def barrier(self, name="barrier"):
+        self._round(self._header("barrier", name, None),
+                    np.zeros((1, 0), dtype=np.float32))
+
+    def join(self) -> int:
+        """Reference JoinOp over rounds: keep answering active ranks'
+        collectives with zero contributions until every process has
+        joined; returns the highest-ranked last joiner."""
+        self._joined = True
+        try:
+            while True:
+                headers = self._gather_obj(
+                    {"kind": "join_poll", "name": "join", "joined": True,
+                     "rank": self.rank()})
+                active = [h for h in headers if not h.get("joined", False)]
+                if not active:
+                    return max(h.get("rank", 0) if h.get("joined") else -1
+                               for h in headers)
+                # An active rank is mid-collective: its header for the op
+                # round will follow; participate via the op path. The
+                # active rank's _round treats our header as joined and
+                # excludes our zero payload.
+                ops = {(h["kind"], h["name"]) for h in active}
+                if len(ops) > 1:
+                    # Active ranks raised a mismatch and will not issue the
+                    # payload round — raise here too instead of hanging.
+                    raise RuntimeError(
+                        f"collective mismatch across processes: "
+                        f"{sorted(ops)}")
+                ref = active[0]
+                if ref["kind"] == "join_poll":
+                    continue  # it will re-enter; loop again
+                shape1 = tuple(ref["shape"][1:])
+                self._gather_var(
+                    np.zeros((0,) + shape1, dtype=ref["dtype"]),
+                    shape1, ref["dtype"])
+        finally:
+            self._joined = False

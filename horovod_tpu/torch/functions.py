@@ -1,0 +1,107 @@
+"""Parameter/object broadcast helpers for the torch API.
+
+Reference parity: ``horovod/torch/functions.py`` (SURVEY.md §2.4, §5.4):
+``broadcast_parameters`` (state_dict or named_parameters),
+``broadcast_optimizer_state`` and ``broadcast_object`` — the
+rank-0-restores-then-broadcasts pattern used for checkpoint resume.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+import torch
+
+from . import mpi_ops as _ops
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast model parameters from ``root_rank`` to every rank.
+
+    ``params`` is a ``model.state_dict()`` or a ``named_parameters``
+    iterable, as in the reference.
+    """
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not torch.is_tensor(p):
+            continue  # non-tensor state_dict entries are broadcast_object's job
+        handles.append(_ops.broadcast_async_(p, root_rank, name=name))
+    for h in handles:
+        _ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast the optimizer's state (momenta etc.) from ``root_rank``.
+
+    Mirrors the reference's approach: state tensors are broadcast in
+    place; scalar hyper-state goes through :func:`broadcast_object` so all
+    ranks agree bit-exactly.
+    """
+    state = optimizer.state_dict()
+    # Root describes the full structure first (param_groups, scalar state,
+    # tensor shapes/dtypes) so ranks with EMPTY state — the
+    # rank-0-restores-then-broadcasts resume pattern — can allocate
+    # placeholders and participate in every tensor broadcast instead of
+    # deadlocking the name-keyed rendezvous.
+    meta = None
+    if _ops.rank() == root_rank:
+        meta = {
+            "param_groups": state["param_groups"],
+            "scalar_state": {
+                pid: {k: v for k, v in pstate.items()
+                      if not torch.is_tensor(v)}
+                for pid, pstate in state["state"].items()
+            },
+            "tensors": {
+                pid: {k: (tuple(v.shape), v.dtype)
+                      for k, v in pstate.items() if torch.is_tensor(v)}
+                for pid, pstate in state["state"].items()
+            },
+        }
+    meta = broadcast_object(meta, root_rank, name="optimizer.state.meta")
+    handles, tensors = [], {}
+    for pid, entries in meta["tensors"].items():
+        tensors[pid] = {}
+        for k, (shape, dtype) in entries.items():
+            local = state["state"].get(pid, {}).get(k)
+            if not torch.is_tensor(local) or tuple(local.shape) != shape:
+                local = torch.zeros(shape, dtype=dtype)
+            tensors[pid][k] = local
+            handles.append(_ops.broadcast_async_(
+                local, root_rank, name=f"optimizer.state.{pid}.{k}"))
+    for h in handles:
+        _ops.synchronize(h)
+    new_state = {
+        pid: {**meta["scalar_state"].get(pid, {}), **tensors[pid]}
+        for pid in meta["tensors"]
+    }
+    optimizer.load_state_dict(
+        {"state": new_state, "param_groups": meta["param_groups"]})
+
+
+def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast_object"):
+    """Pickle-broadcast an arbitrary Python object from ``root_rank``
+    (reference ``hvd.broadcast_object``: size first, then payload)."""
+    if _ops.rank() == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+        sz = np.asarray([payload.shape[0]], dtype=np.int64)
+    else:
+        payload = None
+        sz = np.zeros(1, dtype=np.int64)
+    rt = _ops._rt()
+    sz = rt.engine.broadcast(f"{name}.size", sz, root_rank)
+    if payload is None:
+        payload = np.zeros(int(sz[0]), dtype=np.uint8)
+    payload = rt.engine.broadcast(f"{name}.data", payload, root_rank)
+    return pickle.loads(payload.tobytes())

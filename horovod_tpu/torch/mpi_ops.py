@@ -1,0 +1,418 @@
+"""Torch tensor collectives with async handles.
+
+Reference parity: ``horovod/torch/mpi_ops.py`` + the C++ binding
+``horovod/torch/mpi_ops_v2.cc`` / ``handle_manager.cc`` (SURVEY.md §2.3,
+§2.4): sync and async variants of every op, in-place ``*_`` forms, integer
+handles resolved by ``synchronize``/``poll``, name-keyed matching across
+ranks, prescale/postscale factors and wire compression.
+
+The transport is a :class:`~.engine.CollectiveEngine`; async execution uses
+a per-rank worker pool, so ranks may submit differently-ordered op sets and
+the name-keyed rendezvous still matches them — the job the reference's
+controller negotiation does (SURVEY.md §2.1). Like the reference, a name may
+not be in flight twice ("duplicate tensor name" error).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+import torch
+
+from . import engine as _engine
+from .engine import (Adasum, Average, Max, Min, Product, Sum)  # noqa: F401
+from .compression import Compression
+
+# --- module state -----------------------------------------------------------
+
+_lock = threading.Lock()
+_state: Optional["_TorchRuntime"] = None
+
+
+class _TorchRuntime:
+    """Per-process runtime: engine + handle table + ordered async worker."""
+
+    def __init__(self, eng: _engine.CollectiveEngine):
+        self.engine = eng
+        self.handles: Dict[int, Future] = {}
+        self.next_handle = 0
+        self.hlock = threading.Lock()
+        self._executors: Dict[int, ThreadPoolExecutor] = {}
+        self._name_counters: Dict[int, Dict[str, int]] = {}
+        self._inflight: set = set()
+
+    def executor(self) -> ThreadPoolExecutor:
+        # A worker POOL per rank: ops run concurrently so ranks may submit
+        # op sets in different orders and the name-keyed rendezvous still
+        # matches them (the reference controller's negotiation role).
+        # Engines whose transport matches by PROGRAM ORDER (JaxProcessEngine
+        # over XLA collectives) get a single worker instead: submission
+        # order defines the cross-process pairing, and the engine's header
+        # round turns any residual divergence into an error.
+        r = self.engine.rank()
+        workers = 1 if getattr(self.engine, "requires_ordered_submission",
+                               False) else 16
+        with self.hlock:
+            ex = self._executors.get(r)
+            if ex is None:
+                if isinstance(self.engine, _engine.ThreadSimEngine):
+                    ex = ThreadPoolExecutor(
+                        max_workers=workers,
+                        initializer=self.engine.set_rank, initargs=(r,))
+                else:
+                    ex = ThreadPoolExecutor(max_workers=workers)
+                self._executors[r] = ex
+            return ex
+
+    def submit(self, kind: str, name: Optional[str], fn) -> int:
+        """Autoname, reject duplicate in-flight names (reference
+        "Duplicate tensor name" error), run ``fn(name)`` on the rank's
+        pool, return a handle."""
+        name = self.autoname(kind, name)
+        key = (self.engine.rank(), kind, name)
+        with self.hlock:
+            if key in self._inflight:
+                raise ValueError(
+                    f"duplicate name {name!r}: a {kind} with this name is "
+                    "already in flight (reference controller restriction)")
+            self._inflight.add(key)
+
+        def run():
+            try:
+                return fn(name)
+            finally:
+                with self.hlock:
+                    self._inflight.discard(key)
+        return self.alloc(self.executor().submit(run))
+
+    def alloc(self, fut: Future) -> int:
+        with self.hlock:
+            h = self.next_handle
+            self.next_handle += 1
+            self.handles[h] = fut
+            return h
+
+    def autoname(self, kind: str, name: Optional[str]) -> str:
+        if name is not None:
+            return name
+        r = self.engine.rank()
+        with self.hlock:
+            c = self._name_counters.setdefault(r, {})
+            i = c.get(kind, 0)
+            c[kind] = i + 1
+        return f"{kind}.noname.{i}"
+
+    def shutdown(self):
+        for ex in self._executors.values():
+            ex.shutdown(wait=True)
+        self.engine.shutdown()
+
+
+def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
+    """Initialize the torch API. Engine selection mirrors the reference's
+    transport priority (SURVEY.md §2.2 op manager): an explicit engine wins
+    (tests inject ThreadSimEngine); otherwise JaxProcessEngine on multi-host
+    pods; otherwise single-process."""
+    global _state
+    with _lock:
+        if _state is not None:
+            return
+        if engine is None:
+            import jax
+            if jax.process_count() > 1:
+                engine = _engine.JaxProcessEngine()
+            else:
+                engine = _engine.SingleProcessEngine()
+        _state = _TorchRuntime(engine)
+
+
+def shutdown() -> None:
+    global _state
+    with _lock:
+        if _state is not None:
+            _state.shutdown()
+            _state = None
+
+
+def is_initialized() -> bool:
+    return _state is not None
+
+
+def _rt() -> _TorchRuntime:
+    if _state is None:
+        raise RuntimeError(
+            "horovod_tpu.torch not initialized; call hvd.init() first")
+    return _state
+
+
+def rank() -> int:
+    return _rt().engine.rank()
+
+
+def size() -> int:
+    return _rt().engine.size()
+
+
+def local_rank() -> int:
+    return _rt().engine.local_rank()
+
+
+def local_size() -> int:
+    return _rt().engine.local_size()
+
+
+def cross_rank() -> int:
+    return _rt().engine.cross_rank()
+
+
+def cross_size() -> int:
+    return _rt().engine.cross_size()
+
+
+# --- numpy adaptation -------------------------------------------------------
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    return t.detach().cpu().contiguous().numpy()
+
+
+def _from_np(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    return torch.from_numpy(np.ascontiguousarray(a)).to(
+        device=like.device, dtype=like.dtype)
+
+
+# --- allreduce --------------------------------------------------------------
+
+def _allreduce_impl(tensor: torch.Tensor, op: str, name: Optional[str],
+                    compression, prescale_factor: float,
+                    postscale_factor: float,
+                    output: Optional[torch.Tensor]) -> torch.Tensor:
+    rt = _rt()
+    compressed, ctx = compression.compress(tensor)
+    arr = _to_np(compressed)
+    if prescale_factor != 1.0:
+        arr = arr * prescale_factor
+    out = rt.engine.allreduce(name, arr, op)
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    res = compression.decompress(_from_np(out, compressed), ctx)
+    res = res.to(tensor.dtype)
+    if output is not None:
+        output.copy_(res)
+        return output
+    return res
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    compression=Compression.none, op: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
+    op = _op_from_average(average, op)
+    return _rt().submit("allreduce", name, lambda nm: _allreduce_impl(
+        tensor, op, nm, compression, prescale_factor, postscale_factor,
+        None))
+
+
+def allreduce_async_(tensor: torch.Tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     compression=Compression.none, op: Optional[str] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
+    op = _op_from_average(average, op)
+    return _rt().submit("allreduce", name, lambda nm: _allreduce_impl(
+        tensor, op, nm, compression, prescale_factor, postscale_factor,
+        tensor))
+
+
+def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[str] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> torch.Tensor:
+    return synchronize(allreduce_async(
+        tensor, average, name, compression, op, prescale_factor,
+        postscale_factor))
+
+
+def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, compression=Compression.none,
+               op: Optional[str] = None, prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0) -> torch.Tensor:
+    return synchronize(allreduce_async_(
+        tensor, average, name, compression, op, prescale_factor,
+        postscale_factor))
+
+
+def grouped_allreduce_async(tensors, average=None, name=None,
+                            compression=Compression.none, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0):
+    """One handle for a list of tensors, reduced atomically (reference:
+    grouped ops via group_table.cc, SURVEY.md §2.1)."""
+    op = _op_from_average(average, op)
+    return _rt().submit("grouped_allreduce", name, lambda nm: [
+        _allreduce_impl(t, op, f"{nm}.{i}", compression,
+                        prescale_factor, postscale_factor, None)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_allreduce(tensors, **kw):
+    return synchronize(grouped_allreduce_async(tensors, **kw))
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None,
+                             compression=Compression.none, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0):
+    op = _op_from_average(average, op)
+    return _rt().submit("grouped_allreduce", name, lambda nm: [
+        _allreduce_impl(t, op, f"{nm}.{i}", compression,
+                        prescale_factor, postscale_factor, t)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_allreduce_(tensors, **kw):
+    return synchronize(grouped_allreduce_async_(tensors, **kw))
+
+
+def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
+    if average is not None and op is not None:
+        raise ValueError("specify either average or op, not both "
+                         "(reference mpi_ops.py contract)")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# --- allgather --------------------------------------------------------------
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    rt = _rt()
+    return rt.submit("allgather", name, lambda nm: _from_np(
+        rt.engine.allgather(nm, _to_np(tensor)), tensor))
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None
+              ) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name))
+
+
+def grouped_allgather_async(tensors, name: Optional[str] = None) -> int:
+    rt = _rt()
+    return rt.submit("grouped_allgather", name, lambda nm: [
+        _from_np(rt.engine.allgather(f"{nm}.{i}", _to_np(t)), t)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_allgather(tensors, name: Optional[str] = None):
+    return synchronize(grouped_allgather_async(tensors, name))
+
+
+# --- broadcast --------------------------------------------------------------
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    rt = _rt()
+    return rt.submit("broadcast", name, lambda nm: _from_np(
+        rt.engine.broadcast(nm, _to_np(tensor), root_rank), tensor))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    rt = _rt()
+
+    def run(nm):
+        out = rt.engine.broadcast(nm, _to_np(tensor), root_rank)
+        tensor.copy_(_from_np(out, tensor))
+        return tensor
+    return rt.submit("broadcast", name, run)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# --- alltoall ---------------------------------------------------------------
+
+def alltoall_async(tensor: torch.Tensor,
+                   splits: Optional[torch.Tensor] = None,
+                   name: Optional[str] = None) -> int:
+    rt = _rt()
+    want_splits = splits is not None
+
+    def run(nm):
+        sp = None if splits is None else _to_np(splits)
+        out, recv = rt.engine.alltoall(nm, _to_np(tensor), sp)
+        res = _from_np(out, tensor)
+        if want_splits:
+            return res, torch.from_numpy(recv.astype(np.int64))
+        return res
+    return rt.submit("alltoall", name, run)
+
+
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name: Optional[str] = None):
+    """Returns the received tensor, or ``(tensor, received_splits)`` when
+    ``splits`` is given (reference mpi_ops.py contract)."""
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+# --- reducescatter ----------------------------------------------------------
+
+def reducescatter_async(tensor: torch.Tensor, op: str = Sum,
+                        name: Optional[str] = None) -> int:
+    rt = _rt()
+    return rt.submit("reducescatter", name, lambda nm: _from_np(
+        rt.engine.reducescatter(nm, _to_np(tensor), op), tensor))
+
+
+def reducescatter(tensor: torch.Tensor, op: str = Sum,
+                  name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, op, name))
+
+
+# --- handles ----------------------------------------------------------------
+
+def synchronize(handle: int):
+    """Block until the async op behind ``handle`` completes; return its
+    output (reference: handle_manager.cc wait + exception rethrow)."""
+    rt = _rt()
+    with rt.hlock:
+        fut = rt.handles.pop(handle, None)
+    if fut is None:
+        raise ValueError(f"unknown or already-synchronized handle {handle}")
+    return fut.result()
+
+
+def poll(handle: int) -> bool:
+    """True if the op behind ``handle`` has completed (sync would not
+    block)."""
+    rt = _rt()
+    with rt.hlock:
+        fut = rt.handles.get(handle)
+    if fut is None:
+        raise ValueError(f"unknown or already-synchronized handle {handle}")
+    return fut.done()
+
+
+# --- join / barrier ---------------------------------------------------------
+
+def join(device: int = -1) -> int:
+    """Block until every rank has called join; return the last rank to join
+    (reference ``hvd.join``; the device argument is accepted for signature
+    parity and ignored — there is no per-GPU buffer to pin)."""
+    rt = _rt()
+    return rt.executor().submit(rt.engine.join).result()
+
+
+def barrier() -> None:
+    rt = _rt()
+    rt.executor().submit(rt.engine.barrier).result()

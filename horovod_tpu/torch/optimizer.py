@@ -1,0 +1,150 @@
+"""``DistributedOptimizer`` for torch.
+
+Reference parity: ``horovod/torch/optimizer.py`` (SURVEY.md §2.4, §3.2 hot
+path): wraps any ``torch.optim.Optimizer``; registers per-parameter hooks
+that fire as gradients become ready and launch an async allreduce;
+``step()`` synchronizes all outstanding handles before applying updates.
+Supports ``backward_passes_per_step`` local aggregation (allreduce every
+k-th backward, dividing by k), sum/average/Adasum reduction ops,
+``gradient_predivide_factor`` and wire compression.
+
+The dynamic-subclass construction (a new class deriving from the wrapped
+optimizer's own class) matches the reference, so ``isinstance`` checks and
+LR schedulers keep working.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import torch
+
+from . import mpi_ops as _ops
+from .compression import Compression
+from .engine import Adasum, Average, Sum
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step, op, gradient_predivide_factor):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+            self._param_names = {v: k for k, v in named_parameters}
+        else:
+            self._param_names = {
+                p: f"allreduce.grad.{i}"
+                for i, p in enumerate(
+                    p for g in self.param_groups for p in g["params"])}
+
+        self._handles = {}
+        self._passes = {}
+        self._should_synchronize = True
+        self._synchronized = False
+        if _ops.size() > 1:
+            self._register_hooks()
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._passes[p] = 0
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p):
+            self._passes[p] += 1
+            if self._passes[p] == self.backward_passes_per_step:
+                self._passes[p] = 0
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._param_names.get(p)
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad.div_(self.backward_passes_per_step)
+        if self._op == Average and self._gradient_predivide_factor != 1.0:
+            # Reference trick: predivide locally, postdivide by the rest,
+            # summing on the wire — same mean, better-conditioned fp16.
+            f = self._gradient_predivide_factor
+            return _ops.allreduce_async_(
+                grad, op=Sum, name=name, compression=self._compression,
+                prescale_factor=1.0 / f,
+                postscale_factor=f / _ops.size())
+        return _ops.allreduce_async_(
+            grad, op=self._op, name=name, compression=self._compression)
+
+    # -- synchronization -----------------------------------------------------
+
+    def synchronize(self):
+        """Wait for all outstanding gradient allreduces. Parameters whose
+        hook never fired (unused this step) are reduced here with a zero
+        gradient so every rank issues the same collective set — the
+        reference's missing-handle path in ``synchronize()``."""
+        if _ops.size() > 1:
+            for group in self.param_groups:
+                for p in group["params"]:
+                    if p.requires_grad and p not in self._handles:
+                        if self._passes.get(p, 0) != 0:
+                            continue  # mid local aggregation: not due yet
+                        if p.grad is None:
+                            p.grad = torch.zeros_like(p)
+                        self._handles[p] = self._allreduce_grad_async(p)
+            for p, handle in list(self._handles.items()):
+                _ops.synchronize(handle)
+            self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Use when calling ``synchronize()`` manually before ``step()``
+        (reference contract: avoids double-sync)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(); "
+                "this is prohibited as it can cause a race condition "
+                "(reference optimizer.py message)")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: str = Average,
+                         gradient_predivide_factor: float = 1.0):
+    """Wrap ``optimizer`` so gradients are allreduced across ranks during
+    ``loss.backward()`` (reference ``hvd.DistributedOptimizer``)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op == Adasum and backward_passes_per_step > 1:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported with Adasum "
+            "(reference restriction)")
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor)

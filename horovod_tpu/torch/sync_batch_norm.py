@@ -1,0 +1,95 @@
+"""Cross-rank SyncBatchNorm for the torch API.
+
+Reference parity: ``horovod/torch/sync_batch_norm.py`` (SURVEY.md §2.4,
+§2.6): batch statistics are combined across ranks — mean/var via allreduce,
+per-rank counts via allgather so uneven batches weight correctly — with an
+autograd path that allreduces the statistic gradients on backward.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import mpi_ops as _ops
+from .engine import Sum
+
+
+class _SumAllreduce(torch.autograd.Function):
+    """Differentiable allreduce(Sum): gradient of a sum over ranks is the
+    same sum over the incoming gradients (the reference's backward)."""
+
+    @staticmethod
+    def forward(ctx, t, name):
+        ctx.name = name
+        return _ops.allreduce(t, op=Sum, name=name)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return _ops.allreduce(grad.contiguous(), op=Sum,
+                              name=f"{ctx.name}.grad"), None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm whose statistics span all ranks.
+
+    Single-rank (or eval mode) behaves exactly like the wrapped
+    ``_BatchNorm``. Works for 2D/4D/5D inputs like the reference.
+    """
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        # Name from the PER-RANK counter so every rank, constructing its
+        # modules in the same order, derives the same collective key (the
+        # reference relies on per-process construction order the same way).
+        try:
+            self._name = _ops._rt().autoname("sync_batch_norm", None)
+        except RuntimeError:
+            self._name = "sync_batch_norm.uninit"
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if not self.training or _ops.size() == 1:
+            return super().forward(input)
+
+        # Local sums over all dims except channel (dim 1).
+        dims = [0] + list(range(2, input.dim()))
+        count = torch.tensor(
+            [input.numel() // input.size(1)], dtype=input.dtype)
+        local_sum = input.sum(dim=dims)
+        local_sqsum = (input * input).sum(dim=dims)
+
+        packed = torch.cat([count, local_sum, local_sqsum])
+        packed = _SumAllreduce.apply(packed, self._name)
+        total = packed[0]
+        mean = packed[1:1 + self.num_features] / total
+        sqmean = packed[1 + self.num_features:] / total
+        var = sqmean - mean * mean
+
+        if self.track_running_stats:
+            with torch.no_grad():
+                n = total
+                # Bessel correction, guarded: at n == 1 the n/(n-1) ratio
+                # is inf — keep the biased value (0) as torch BatchNorm
+                # effectively does for a single element.
+                factor = torch.where(n > 1, n / (n - 1).clamp(min=1),
+                                     torch.ones_like(n))
+                unbiased = var * factor
+                m = self.momentum if self.momentum is not None else 0.1
+                self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased.detach(), alpha=m)
+                self.num_batches_tracked += 1
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = (input - mean.reshape(shape)) / torch.sqrt(
+            var.reshape(shape) + self.eps)
+        if self.affine:
+            out = out * self.weight.reshape(shape) + self.bias.reshape(shape)
+        return out
