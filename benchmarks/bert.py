@@ -1,0 +1,70 @@
+"""BASELINE config 2: BERT-Large MLM pretraining throughput.
+
+The reference's recipe is fp16 wire compression + tensor-fusion allreduce
+of ~400 gradient tensors (SURVEY.md §6). Here the whole gradient pytree
+fuses into the compiled step (docs/tensor-fusion.md) with bf16 compression
+on the allreduce payload; metric is tokens/sec/chip.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import emit, on_tpu, slope_time, sync
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.collectives import Compression
+    from horovod_tpu.models.bert import Bert, bert_large, bert_tiny
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    hvd.init()
+    n = hvd.size()
+    tpu = on_tpu()
+    cfg = bert_large() if tpu else bert_tiny()
+    per_chip, seq = (8, 512) if tpu else (2, 32)
+    batch = per_chip * n
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    raw = rng.randint(0, cfg.vocab_size, (batch, seq))
+    mask = rng.rand(batch, seq) < 0.15
+    # Labels carry their own mask (-1 = unmasked position) so they shard
+    # with the batch like any other per-example tensor.
+    labels = jnp.asarray(np.where(mask, raw, -1))
+
+    model = Bert(cfg)
+    dopt = distributed(optax.adamw(1e-4), compression=Compression.bf16)
+    state = create_train_state(model, jax.random.PRNGKey(0), tokens[:1],
+                               dopt)
+
+    def loss_fn(logits, y):
+        valid = y >= 0
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(y, 0))
+        return (ce * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
+                                donate=False)
+             for k in (2, 8)}
+
+    def run(k):
+        _, loss = steps[k](state, tokens, labels)
+        sync(loss)
+
+    tps = batch * seq / slope_time(run, 2, 8)
+    emit("bert_tokens_per_sec_per_chip", tps / n,
+         f"tokens/sec/chip ({'large' if tpu else 'tiny'}, seq {seq}, "
+         f"bf16-compressed fused allreduce, {n} devices)")
+
+
+if __name__ == "__main__":
+    main()

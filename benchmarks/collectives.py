@@ -1,0 +1,116 @@
+"""Collective bus-bandwidth microbenchmark.
+
+BASELINE north star: ≥90% ICI bus-bandwidth utilization. Sweeps message
+sizes through in-graph allreduce / allgather / alltoall / reducescatter
+over the mesh rank axis and reports **bus bandwidth** with the standard
+ring-algorithm formulas (NCCL-tests convention, so numbers compare
+directly to the reference's GPU reports):
+
+    allreduce:      busBW = 2(n-1)/n · bytes / t
+    allgather:      busBW = (n-1)/n · total_bytes / t
+    reducescatter:  busBW = (n-1)/n · in_bytes / t
+    alltoall:       busBW = (n-1)/n · bytes / t
+
+Each op is timed as a DEPENDENT chain inside ``lax.scan`` (output feeds the
+next input) so XLA cannot hoist or overlap away the transfers; wall time
+comes from the slope between two chain lengths (common.py).
+
+Set ``HOROVOD_BENCH_ICI_PEAK_GBPS`` (per-chip bidirectional ICI, GB/s) to
+also report utilization as ``vs_baseline``; hardware peaks differ per TPU
+generation, so none is assumed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from common import emit, on_tpu, slope_time, sync
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.collectives import ops
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+    axis = hvd.RANK_AXIS
+    peak = float(os.environ.get("HOROVOD_BENCH_ICI_PEAK_GBPS", "0")) or None
+    if n == 1:
+        # Bus-bandwidth formulas are 0 at n=1; nothing rides the wire.
+        emit("collectives_busbw", 0.0,
+             "GB/s (1 rank — run on a multi-chip mesh)")
+        return
+
+    sizes_mb = [1, 8, 64] if on_tpu() else [1]
+
+    def time_chain(body, shard_elems, k_short=2, k_long=8):
+        """Seconds per op for body: (shard,) -> (shard,) chained k times."""
+        x = jnp.ones((n * shard_elems,), jnp.float32)
+
+        def make(k):
+            def chained(v):
+                def one(c, _):
+                    return body(c), ()
+                c, _ = lax.scan(one, v, None, length=k)
+                return c
+            return jax.jit(shard_map(chained, mesh=mesh, in_specs=P(axis),
+                                     out_specs=P(axis), check_vma=False))
+
+        fns = {k: make(k) for k in (k_short, k_long)}
+
+        def run(k):
+            sync(fns[k](x))
+        return slope_time(run, k_short, k_long)
+
+    for mb in sizes_mb:
+        elems = mb * (1 << 20) // 4          # per-shard payload elements
+        bytes_ = elems * 4
+
+        # allreduce: (elems,) -> (elems,), dependent by construction.
+        t = time_chain(lambda v: ops.allreduce(v, ops.Sum), elems)
+        bw = 2 * (n - 1) / n * bytes_ / t / 1e9
+        emit(f"allreduce_busbw_{mb}mb", bw, f"GB/s ({n} ranks)",
+             None if peak is None else bw / peak)
+
+        # allgather: gather to (n*elems,), keep own chunk -> (elems,).
+        def ag_body(v):
+            g = ops.allgather(v)
+            i = lax.axis_index(axis)
+            return lax.dynamic_slice(g, (i * v.shape[0],), (v.shape[0],))
+        t = time_chain(ag_body, elems)
+        bw = (n - 1) / n * bytes_ * n / t / 1e9
+        emit(f"allgather_busbw_{mb}mb", bw, f"GB/s ({n} ranks)",
+             None if peak is None else bw / peak)
+
+        # alltoall: (elems,) -> (elems,) when elems % n == 0.
+        a2a_elems = (elems // n) * n
+        t = time_chain(lambda v: ops.alltoall(v), a2a_elems)
+        bw = (n - 1) / n * a2a_elems * 4 / t / 1e9
+        emit(f"alltoall_busbw_{mb}mb", bw, f"GB/s ({n} ranks)",
+             None if peak is None else bw / peak)
+
+        # reducescatter: (elems,) -> (elems/n,), tiled back up to keep the
+        # chain shape-stable (adds one cheap HBM pass vs the transfer).
+        def rs_body(v):
+            r = ops.reducescatter(v, ops.Sum)
+            return jnp.tile(r, n)[:v.shape[0]]
+        t = time_chain(rs_body, a2a_elems)
+        bw = (n - 1) / n * a2a_elems * 4 / t / 1e9
+        emit(f"reducescatter_busbw_{mb}mb", bw, f"GB/s ({n} ranks)",
+             None if peak is None else bw / peak)
+
+
+if __name__ == "__main__":
+    main()

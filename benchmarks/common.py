@@ -1,0 +1,63 @@
+"""Shared benchmark machinery.
+
+Reference analog: the reference's ``horovod/benchmarks``-style scripts +
+`docs/benchmarks.rst` methodology (SURVEY.md §6). All scripts here:
+
+- print one JSON line per metric: ``{"metric", "value", "unit",
+  "vs_baseline"}`` (the bench.py schema);
+- time device work by the SLOPE between a short and a long ``lax.scan``
+  (two chained-dispatch lengths), so constant host-dispatch/tunnel latency
+  cancels — required on remote-tunnel TPU setups where per-step
+  ``block_until_ready`` is dominated by round-trips;
+- auto-size DOWN on CPU meshes so the suite doubles as a shape/correctness
+  check in CI (SURVEY.md §4 universal-fake-backend discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# The session image pre-imports jax with the axon TPU plugin; an env var
+# alone doesn't switch backends (see .claude/skills/verify). Honor an
+# explicit CPU request before any computation runs.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import numpy as np
+
+S_SHORT, S_LONG = 4, 16
+
+
+def on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def sync(x) -> None:
+    np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
+
+
+def slope_time(run, s_short: int = S_SHORT, s_long: int = S_LONG) -> float:
+    """Seconds per unit from two chained-scan lengths (latency cancelled).
+
+    ``run(k)`` must execute k units ending in a device->host sync.
+    """
+    run(s_short)  # warm both compiles
+    run(s_long)
+    t0 = time.perf_counter()
+    run(s_short)
+    t1 = time.perf_counter()
+    run(s_long)
+    t2 = time.perf_counter()
+    return max((t2 - t1) - (t1 - t0), 1e-9) / (s_long - s_short)
+
+
+def emit(metric: str, value: float, unit: str,
+         vs_baseline: float | None = None) -> None:
+    line = {"metric": metric, "value": round(float(value), 3), "unit": unit}
+    if vs_baseline is not None:
+        line["vs_baseline"] = round(float(vs_baseline), 4)
+    print(json.dumps(line), flush=True)

@@ -1,0 +1,97 @@
+"""BASELINE config 5: DLRM throughput with sharded embedding exchange.
+
+The reference path is sparse allgather/allreduce of embedding gradients
+(SURVEY.md §6). Here embedding tables shard over the ``ep`` axis and XLA
+inserts the gather/exchange from the sharding annotations (GSPMD); metric
+is examples/sec/chip.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import emit, on_tpu, slope_time, sync
+
+
+def main():
+    import flax.linen as nn
+    from flax.linen import partitioning as nn_partitioning
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.dlrm import DLRM, bce_loss, dlrm_criteo, dlrm_tiny
+    from horovod_tpu.models.llama import LOGICAL_RULES
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import rules_for_mesh
+
+    hvd.init()
+    n = hvd.size()
+    tpu = on_tpu()
+    cfg = dlrm_criteo() if tpu else dlrm_tiny()
+    per_chip = 2048 if tpu else 16
+    B = per_chip * n
+
+    ep = min(8, n)
+    mesh = create_mesh({"dp": n // ep, "ep": ep}) if n > 1 \
+        else create_mesh({"dp": 1})
+    rules = rules_for_mesh(mesh, LOGICAL_RULES)
+
+    rng = np.random.RandomState(0)
+    dense = jnp.asarray(rng.randn(B, cfg.dense_features).astype(np.float32))
+    sparse = jnp.asarray(rng.randint(0, cfg.rows_per_table,
+                                     (B, cfg.num_tables)))
+    labels = jnp.asarray((rng.rand(B) < 0.3).astype(np.float32))
+
+    model = DLRM(cfg)
+    opt = optax.adagrad(1e-2)
+
+    with nn_partitioning.axis_rules(rules):
+        abs_vars = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                                  dense, sparse)
+    sharding = nn.logical_to_mesh_sharding(
+        nn.get_partition_spec(abs_vars["params"]), mesh, rules)
+
+    def init_all(rng_):
+        with nn_partitioning.axis_rules(rules):
+            variables = model.init(rng_, dense, sparse)
+        return variables["params"]
+
+    with jax.sharding.set_mesh(mesh):
+        params = jax.jit(init_all, out_shardings=sharding)(
+            jax.random.PRNGKey(0))
+    params = nn.meta.unbox(params)
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, d, s, y):
+        def loss_of(p):
+            with nn_partitioning.axis_rules(rules):
+                out = model.apply({"params": p}, d, s)
+            return bce_loss(out, y)
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    jitted = jax.jit(step)
+
+    def run(k):
+        nonlocal params, opt_state
+        loss = None
+        with jax.sharding.set_mesh(mesh):
+            for _ in range(k):
+                params, opt_state, loss = jitted(params, opt_state, dense,
+                                                 sparse, labels)
+        sync(loss)
+
+    eps = B / slope_time(run, 2, 8)
+    emit("dlrm_examples_per_sec_per_chip", eps / n,
+         f"examples/sec/chip ({cfg.num_tables} tables x "
+         f"{cfg.rows_per_table} rows, {n} devices)")
+
+
+if __name__ == "__main__":
+    main()

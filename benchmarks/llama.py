@@ -1,0 +1,73 @@
+"""BASELINE config 3: Llama fine-tune throughput with Adasum allreduce.
+
+The reference recipe: grad allreduce + Adasum over rings. Here the model
+trains through the DP shard_map path with ``op=Adasum`` on the gradient
+combine — the ICI XOR-butterfly of collectives/adasum.py with the Pallas
+fused combine on TPU. Metric: tokens/sec/chip; also reports plain-Average
+throughput so the Adasum butterfly's cost is visible.
+
+Sizing: one chip can't hold 8B params + Adam state, so the TPU config is a
+mid-sized decoder (~350M) with the 8B architecture's shape ratios; CPU
+meshes use llama_tiny. The parallelism mechanics are identical at any size.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import emit, on_tpu, slope_time, sync
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models.llama import Llama, LlamaConfig, llama_tiny
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import (create_train_state, make_train_step,
+                                   next_token_loss)
+
+    hvd.init()
+    n = hvd.size()
+    tpu = on_tpu()
+    if tpu:
+        cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24,
+                          n_heads=16, n_kv_heads=8, hidden_dim=4096,
+                          max_seq_len=2048)
+        per_chip, seq = 4, 1024
+    else:
+        cfg = llama_tiny()
+        per_chip, seq = 2, 32
+    batch = per_chip * n
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    model = Llama(cfg)
+
+    def loss_fn(logits, y):
+        return next_token_loss(logits, y)
+
+    for op_name, op in (("adasum", hvd.Adasum), ("average", hvd.Average)):
+        dopt = distributed(optax.adamw(1e-4), op=op)
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   tokens[:1], dopt)
+        steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
+                                    donate=False) for k in (2, 8)}
+
+        def run(k):
+            _, loss = steps[k](state, tokens, tokens)
+            sync(loss)
+
+        tps = batch * seq / slope_time(run, 2, 8)
+        emit(f"llama_tokens_per_sec_per_chip_{op_name}", tps / n,
+             f"tokens/sec/chip (dim {cfg.dim} x {cfg.n_layers}L, seq "
+             f"{seq}, op={op_name}, {n} devices)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""BASELINE config 1: ResNet-50 DP throughput + scaling efficiency.
+
+Same measurement as the headline bench.py (slope-timed device-side scan)
+plus the reference's own headline metric: scaling efficiency = per-chip
+throughput with the full mesh active ÷ plain single-device throughput
+(`docs/benchmarks.rst` reports this at 512 GPUs; here it is exact on
+whatever mesh is present).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import emit, on_tpu, slope_time, sync, S_SHORT, S_LONG
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50, ResNetTiny
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    hvd.init()
+    n = hvd.size()
+    tpu = on_tpu()
+    per_chip, image = (64, 224) if tpu else (4, 32)
+    model_cls = ResNet50 if tpu else ResNetTiny
+    batch = per_chip * n
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, image, image, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    model = model_cls(axis_name=hvd.RANK_AXIS,
+                      dtype=jnp.bfloat16 if tpu else jnp.float32)
+    dopt = distributed(optax.sgd(0.1, momentum=0.9))
+    state = create_train_state(model, jax.random.PRNGKey(0), images[:1],
+                               dopt)
+    steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
+                                donate=False)
+             for k in (S_SHORT, S_LONG)}
+
+    def run(k):
+        _, loss = steps[k](state, images, labels)
+        sync(loss)
+
+    ips = batch / slope_time(run)
+    emit("resnet50_images_per_sec_per_chip", ips / n,
+         f"images/sec/chip (batch {per_chip}/chip, {n} devices)")
+
+    # single-device plain baseline for scaling efficiency
+    model1 = model_cls(axis_name=None,
+                       dtype=jnp.bfloat16 if tpu else jnp.float32)
+    opt1 = optax.sgd(0.1, momentum=0.9)
+    x1, y1 = images[:per_chip], labels[:per_chip]
+    variables = model1.init(jax.random.PRNGKey(0), x1[:1], train=False)
+    pstate = (variables["params"], variables.get("batch_stats", {}),
+              opt1.init(variables["params"]))
+
+    def plain(k):
+        def one(st, _):
+            params, stats, opt_state = st
+
+            def loss_of(p):
+                out, mut = model1.apply(
+                    {"params": p, "batch_stats": stats}, x1, train=True,
+                    mutable=["batch_stats"])
+                return loss_fn(out, y1), mut["batch_stats"]
+            (l, stats2), grads = jax.value_and_grad(loss_of,
+                                                    has_aux=True)(params)
+            updates, opt_state = opt1.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), stats2,
+                    opt_state), l
+        return jax.jit(lambda st: jax.lax.scan(one, st, None,
+                                               length=k)[1][-1])
+
+    plains = {k: plain(k) for k in (S_SHORT, S_LONG)}
+
+    def run1(k):
+        sync(plains[k](pstate))
+
+    ips1 = per_chip / slope_time(run1)
+    emit("resnet50_scaling_efficiency", (ips / n) / ips1,
+         f"per-chip throughput vs 1-device plain JAX ({n} devices)",
+         (ips / n) / ips1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,5 @@
 from .config import Config
-from .context_api import (RANK_AXIS, add_process_set, context, cross_rank,
+from .context_api import (RANK_AXIS, add_process_set, global_process_set, context, cross_rank,
                       cross_size, gloo_enabled, init, is_homogeneous,
                       is_initialized, local_rank, local_size, mesh,
                       mpi_enabled, nccl_built, rank, remove_process_set,
@@ -9,7 +9,7 @@ from .exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
 from .process_sets import ProcessSet, ProcessSetTable
 
 __all__ = [
-    "Config", "RANK_AXIS", "add_process_set", "context", "cross_rank",
+    "Config", "RANK_AXIS", "add_process_set", "global_process_set", "context", "cross_rank",
     "cross_size", "gloo_enabled", "init", "is_homogeneous", "is_initialized",
     "local_rank", "local_size", "mesh", "mpi_enabled", "nccl_built", "rank",
     "remove_process_set", "shutdown", "size", "xla_built",

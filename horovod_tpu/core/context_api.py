@@ -228,3 +228,10 @@ def add_process_set(ranks: Sequence[int]) -> ProcessSet:
 
 def remove_process_set(ps: "ProcessSet | int") -> None:
     context().process_sets.remove(ps)
+
+
+def global_process_set() -> ProcessSet:
+    """The id-0 set over all ranks (parity: ``hvd.global_process_set``,
+    common/process_sets.py — there a module attribute, here a function since
+    world size is only known after ``init()``)."""
+    return context().process_sets.global_set

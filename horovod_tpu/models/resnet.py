@@ -17,6 +17,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..collectives.ops import static_axis_size
+
 ModuleDef = Any
 
 
@@ -87,9 +89,15 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        # Cross-replica stat sync is pointless on a 1-member axis, and XLA
+        # keeps (not elides) single-participant all-reduces — resolve the
+        # axis at trace time so ~50 BN psums vanish on one device.
+        bn_axis = self.axis_name if train else None
+        if bn_axis is not None and static_axis_size(bn_axis) == 1:
+            bn_axis = None
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       axis_name=self.axis_name if train else None)
+                       axis_name=bn_axis)
         x = x.astype(self.dtype)
         if self.small_images:
             x = conv(self.width, (3, 3), name="conv_init")(x)

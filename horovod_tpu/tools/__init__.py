@@ -14,9 +14,10 @@ from .autotune import (Autotuner, CatDim, Dim, GaussianProcess, IntDim,
                        LogIntDim, expected_improvement)
 from .mismatch import MismatchDetector, MismatchError, detector, maybe_record
 from .stall import StallInspector
-from .timeline import Timeline
+from .timeline import Timeline, merge_chrome_traces
 
 __all__ = ["Autotuner", "CatDim", "Dim", "GaussianProcess", "IntDim",
            "LogIntDim", "MismatchDetector", "MismatchError",
            "StallInspector", "Timeline", "detector",
-           "expected_improvement", "maybe_record", "profiler"]
+           "expected_improvement", "maybe_record", "merge_chrome_traces",
+           "profiler"]

@@ -9,7 +9,7 @@ On TPU the device-side story is better served by ``jax.profiler`` (xplane →
 TensorBoard/Perfetto); this writer covers the HOST-side lifecycle that the
 XLA trace does not show — eager-op dispatch, elastic events, autotune trials,
 checkpoint commits — in the same Chrome-trace format so both can be loaded
-side by side. ``horovod_tpu.tools.profiler`` merges them.
+side by side. ``merge_chrome_traces`` below merges them.
 
 Thread model mirrors the reference: events are queued from any thread and a
 single writer thread drains to disk (crash-safe incremental JSON array).
@@ -107,3 +107,77 @@ class Timeline:
         with self._lock:
             self._file.write("\n]\n")
             self._file.close()
+
+
+def merge_chrome_traces(paths, out_path, labels=None):
+    """Merge chrome-trace JSON files into one (the hvd timeline + a
+    ``jax.profiler`` chrome export, or several hosts' timelines — parity with
+    the reference's single merged timeline from ``timeline.cc``, which wrote
+    one file because all activity flowed through rank-0's controller; here
+    each source writes independently and is merged after the fact).
+
+    Each input's events keep their timestamps but get a distinct ``pid``
+    namespace plus a process_name metadata row, so tracks stay separated in
+    the viewer. Inputs may be ``[...]`` arrays or ``{"traceEvents": [...]}``
+    (both chrome-trace flavors); gzipped files are handled; ``stackFrames``
+    tables are carried over with ids renamed to stay unambiguous.
+    """
+    import gzip
+    import json as _json
+
+    merged, stack_frames, extra = [], {}, {}
+    for i, p in enumerate(paths):
+        opener = gzip.open if str(p).endswith(".gz") else open
+        with opener(p, "rt") as f:
+            data = _json.load(f)
+        if isinstance(data, dict):
+            if "traceEvents" not in data:
+                raise ValueError(
+                    f"{p}: not a chrome trace (object without 'traceEvents')")
+            events = data["traceEvents"]
+        else:
+            data, events = {}, data
+        label = (labels[i] if labels and i < len(labels)
+                 else os.path.basename(str(p)))
+        for k, frame in (data.get("stackFrames") or {}).items():
+            frame = dict(frame)
+            if "parent" in frame:
+                frame["parent"] = f"t{i}:{frame['parent']}"
+            stack_frames[f"t{i}:{k}"] = frame
+        for k, v in data.items():
+            if k not in ("traceEvents", "stackFrames"):
+                extra.setdefault(k, v)  # e.g. displayTimeUnit: first wins
+        base = (i + 1) * 100000
+        pid_map, labeled = {}, set()
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            orig = ev.get("pid", 0)
+            if orig not in pid_map:
+                # Dense remap (not modulo) so distinct source pids can never
+                # collide into one track.
+                pid_map[orig] = base + len(pid_map)
+            ev["pid"] = pid_map[orig]
+            for sf_key in ("sf", "esf"):
+                if sf_key in ev:
+                    ev[sf_key] = f"t{i}:{ev[sf_key]}"
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                # Prefix the input's own track names with our label so the
+                # merged inputs stay distinguishable in the viewer.
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{label}/{args.get('name', orig)}"
+                ev["args"] = args
+                labeled.add(ev["pid"])
+            merged.append(ev)
+        for orig, pid in pid_map.items():
+            if pid not in labeled:
+                name = label if len(pid_map) == 1 else f"{label}/p{orig}"
+                merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                               "args": {"name": name}})
+    out = {"traceEvents": merged, **extra}
+    if stack_frames:
+        out["stackFrames"] = stack_frames
+    with open(out_path, "w") as f:
+        _json.dump(out, f)
+    return out_path

@@ -89,13 +89,16 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         updates, opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
-        loss = jax.lax.pmean(loss, axis)
-        # TrainState is declared replicated (out_specs P()); if the model's
-        # BatchNorm does not itself sync (axis_name=None), per-device stats
-        # would silently diverge — pmean makes them truly replicated (a
-        # no-op when the model already synced them).
-        new_stats = jax.tree_util.tree_map(
-            lambda s: jax.lax.pmean(s, axis), new_stats)
+        if jax.lax.axis_size(axis) > 1:  # size known at trace time
+            loss = jax.lax.pmean(loss, axis)
+            # TrainState is declared replicated (out_specs P()); if the
+            # model's BatchNorm does not itself sync (axis_name=None),
+            # per-device stats would silently diverge — pmean makes them
+            # truly replicated (a no-op when the model already synced
+            # them). Skipped on a 1-member axis: XLA does not reliably
+            # elide single-participant all-reduces.
+            new_stats = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, axis), new_stats)
         return TrainState(state.step + 1, params, opt_state,
                           new_stats), loss
 

@@ -322,3 +322,104 @@ def test_getattr_missing_submodule_is_attribute_error():
     with _pytest.raises(AttributeError):
         hvd.__getattr__("definitely_not_a_module")
     assert hasattr(hvd, "models") and hasattr(hvd, "optimizer")
+
+
+# ---------------- 1-member-axis fast path / validation ----------------
+
+def _one_mesh():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:1]), ("one",))
+
+
+def test_one_member_axis_elides_collectives():
+    """On a size-1 axis every global-set op is identity and the compiled HLO
+    contains NO collectives (XLA does not elide single-participant ones)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    def body(x):
+        a = hvd.allreduce(x, op=hvd.Sum, axis_name="one", prescale_factor=2.0,
+                          process_set=hvd.global_process_set())
+        b = hvd.allgather(a, axis_name="one")
+        c = hvd.broadcast(b, 0, axis_name="one")
+        d = hvd.alltoall(c, axis_name="one")
+        e = hvd.reducescatter(d, op=hvd.Sum, axis_name="one")
+        (g,) = hvd.grouped_allreduce([e], op=hvd.Sum, axis_name="one")
+        return g
+
+    f = jax.jit(shard_map(body, mesh=_one_mesh(), in_specs=P(), out_specs=P()))
+    hlo = f.lower(jnp.ones((4, 3))).compile().as_text()
+    for bad in ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+                "collective-permute"):
+        assert bad not in hlo
+    np.testing.assert_allclose(np.asarray(f(jnp.ones((4, 3)))),
+                               2.0 * np.ones((4, 3)))
+
+
+def test_broadcast_root_out_of_range_raises():
+    with pytest.raises(ValueError, match="out of range"):
+        eager.broadcast(jnp.asarray(stacked()), root_rank=N)
+
+
+def test_allreduce_invalid_op_raises_even_on_one_device():
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    with pytest.raises(ValueError, match="unsupported reduce op"):
+        jax.jit(shard_map(lambda x: hvd.allreduce(x, op="mean",
+                                                  axis_name="one"),
+                          mesh=_one_mesh(), in_specs=P(),
+                          out_specs=P()))(jnp.ones(3))
+
+
+def test_one_member_average_promotes_int_like_multi_device():
+    """Average must promote int dtypes the same on a 1-member axis as the
+    psum/divide path does on N members."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    multi = eager.allreduce(jnp.ones((N, 3), jnp.int32), op=hvd.Average)
+    one = jax.jit(shard_map(lambda x: hvd.allreduce(x, op=hvd.Average,
+                                                    axis_name="one"),
+                            mesh=_one_mesh(), in_specs=P(),
+                            out_specs=P()))(jnp.ones((3,), jnp.int32))
+    assert multi.dtype == one.dtype == jnp.float32
+
+
+def test_merge_chrome_traces_labels_and_stackframes(tmp_path):
+    import json
+    from horovod_tpu.tools import merge_chrome_traces
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps([
+        {"name": "x", "ph": "X", "ts": 0, "dur": 1, "pid": 5, "tid": 0},
+        {"name": "x", "ph": "X", "ts": 0, "dur": 1, "pid": 100005, "tid": 0},
+    ]))
+    b.write_text(json.dumps({
+        "displayTimeUnit": "ns",
+        "stackFrames": {"3": {"name": "f", "parent": "1"},
+                        "1": {"name": "root"}},
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "dev"}},
+            {"name": "y", "ph": "X", "ts": 2, "dur": 1, "pid": 0, "tid": 0,
+             "sf": "3"},
+        ]}))
+    out = tmp_path / "merged.json"
+    merge_chrome_traces([a, b], out, labels=["host", "tpu"])
+    m = json.loads(out.read_text())
+    evs = m["traceEvents"]
+    # distinct source pids stay distinct (no modulo collision)
+    xs = [e["pid"] for e in evs if e.get("name") == "x"]
+    assert len(set(xs)) == 2
+    names = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert "tpu/dev" in names and any(n.startswith("host") for n in names)
+    # stackFrames carried over, ids+parents renamed consistently, sf rewritten
+    (y,) = [e for e in evs if e.get("name") == "y"]
+    assert y["sf"] == "t1:3"
+    assert m["stackFrames"]["t1:3"]["parent"] == "t1:1"
+    assert m["displayTimeUnit"] == "ns"
+    # non-trace dict input is rejected
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"foo": 1}))
+    with pytest.raises(ValueError, match="traceEvents"):
+        merge_chrome_traces([bad], tmp_path / "out2.json")
