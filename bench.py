@@ -20,30 +20,23 @@ latency of remote-tunnel TPU setups where block_until_ready is unreliable.
 """
 
 import json
-import time
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+from common import slope_time as _slope_time  # single timing implementation
+
 S_SHORT, S_LONG = 4, 24
 
 
 def _sync(x):
     return np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
-
-
-def _slope_time(run, s_short=S_SHORT, s_long=S_LONG):
-    """Seconds per step from two chained-scan lengths (latency cancelled)."""
-    run(s_short)  # warm both compiles
-    run(s_long)
-    t0 = time.perf_counter()
-    run(s_short)
-    t1 = time.perf_counter()
-    run(s_long)
-    t2 = time.perf_counter()
-    return max((t2 - t1) - (t1 - t0), 1e-9) / (s_long - s_short)
 
 
 def main():
@@ -81,7 +74,7 @@ def main():
         _, loss = steps[k](state0, images, labels)
         _sync(loss)
 
-    sec_per_step = _slope_time(run_hvd)
+    sec_per_step = _slope_time(run_hvd, S_SHORT, S_LONG)
     ips_hvd = batch / sec_per_step
 
     # --- plain-JAX baseline: same model/optimizer, one device, no mesh ---
@@ -121,7 +114,7 @@ def main():
     def run_plain(k):
         _sync(plain[k](pstate0))
 
-    ips_plain = per_chip_batch / _slope_time(run_plain)
+    ips_plain = per_chip_batch / _slope_time(run_plain, S_SHORT, S_LONG)
 
     per_chip = ips_hvd / n
     print(json.dumps({

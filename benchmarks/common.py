@@ -40,19 +40,27 @@ def sync(x) -> None:
     np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
 
 
-def slope_time(run, s_short: int = S_SHORT, s_long: int = S_LONG) -> float:
+def slope_time(run, s_short: int = S_SHORT, s_long: int = S_LONG,
+               repeats: int = 5) -> float:
     """Seconds per unit from two chained-scan lengths (latency cancelled).
 
     ``run(k)`` must execute k units ending in a device->host sync.
+    Tunnel jitter is additive per measurement, so each absolute time is
+    estimated as min-over-repeats before the slope is taken (a min of
+    per-pair slopes would bias low — slope noise is two-sided).
     """
     run(s_short)  # warm both compiles
     run(s_long)
-    t0 = time.perf_counter()
-    run(s_short)
-    t1 = time.perf_counter()
-    run(s_long)
-    t2 = time.perf_counter()
-    return max((t2 - t1) - (t1 - t0), 1e-9) / (s_long - s_short)
+
+    def best(k):
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run(k)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    return max(best(s_long) - best(s_short), 1e-9) / (s_long - s_short)
 
 
 def emit(metric: str, value: float, unit: str,

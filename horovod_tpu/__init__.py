@@ -29,8 +29,8 @@ from .collectives import (Adasum, Average, Compression, Max, Min, Product,
 from .core import (Config, HorovodInternalError, HostsUpdatedInterrupt,
                    ProcessSet, RANK_AXIS, add_process_set, global_process_set, cross_rank,
                    cross_size, gloo_enabled, init, is_homogeneous,
-                   is_initialized, local_rank, local_size, mesh, mpi_enabled,
-                   nccl_built, rank, remove_process_set, shutdown, size,
+                   is_initialized, local_rank, local_size, mesh, mpi_enabled, mpi_threads_supported,
+                   nccl_built, rank, remove_process_set, shutdown, size, start_timeline, stop_timeline,
                    xla_built)
 
 __version__ = "0.1.0"

@@ -24,6 +24,8 @@ eager per-rank wrappers live in ``collectives/eager.py``.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
+import threading as _threading
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -98,6 +100,43 @@ def static_axis_size(axis: str) -> Optional[int]:
         return None
 
 
+_forced_size1 = _threading.local()
+
+
+@_contextlib.contextmanager
+def force_axis_size1(*axes: str):
+    """Trace-time declaration that ``axes`` have exactly one member.
+
+    Used by ``make_train_step``'s 1-device fast path, which traces the step
+    WITHOUT ``shard_map`` (the SPMD partitioner costs real layout copies on
+    TPU even for one device): inside this context every hvd collective on a
+    listed axis collapses to identity instead of failing on the unbound
+    axis name."""
+    prev = getattr(_forced_size1, "axes", frozenset())
+    _forced_size1.axes = prev | frozenset(axes)
+    try:
+        yield
+    finally:
+        _forced_size1.axes = prev
+
+
+def effective_axis_size(axis: str) -> Optional[int]:
+    """``static_axis_size`` with two extra resolution steps for unbound
+    axes: a ``force_axis_size1`` declaration wins, else the context world
+    size when the axis IS the context's rank axis. This makes a 1-device
+    world behave like the reference's 1-process run — train steps need no
+    ``shard_map`` wrapper at all, and every collective inside still
+    collapses to identity."""
+    n = static_axis_size(axis)
+    if n is not None:
+        return n
+    if axis in getattr(_forced_size1, "axes", ()):
+        return 1
+    if _ctx.is_initialized() and axis == _ctx.context().axis_name:
+        return _ctx.context().size
+    return None
+
+
 def _is_global(process_set: Optional[ProcessSet]) -> bool:
     """The explicit global set (id 0) is equivalent to passing None."""
     return process_set is None or process_set.process_set_id == 0
@@ -167,7 +206,7 @@ def allreduce(tensor: Any, op: str = Average, *,
     if op not in _REDUCE_OPS:
         raise ValueError(f"unsupported reduce op: {op}")
     axis = _axis(axis_name)
-    if _is_global(process_set) and static_axis_size(axis) == 1:
+    if _is_global(process_set) and effective_axis_size(axis) == 1:
         return _identity_reduce(tensor, op, prescale_factor,
                                 postscale_factor)
     groups = _groups(process_set, axis)
@@ -212,7 +251,7 @@ def grouped_allreduce(tensors: Any, op: str = Average, *,
     if op not in _REDUCE_OPS:
         raise ValueError(f"unsupported reduce op: {op}")
     axis = _axis(axis_name)
-    if _is_global(process_set) and static_axis_size(axis) == 1:
+    if _is_global(process_set) and effective_axis_size(axis) == 1:
         return _identity_reduce(tensors, op, prescale_factor,
                                 postscale_factor)
     groups = _groups(process_set, axis)
@@ -255,7 +294,7 @@ def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
     SURVEY.md §7 "hard parts").
     """
     axis = _axis(axis_name)
-    if _is_global(process_set) and static_axis_size(axis) == 1:
+    if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
     groups = _groups(process_set, axis, require_equal=True)
 
@@ -281,7 +320,7 @@ def broadcast(tensor: Any, root_rank: int = 0, *,
     """
     axis = _axis(axis_name)
     if _is_global(process_set):
-        world = static_axis_size(axis)
+        world = effective_axis_size(axis)
         if world is not None and not 0 <= root_rank < world:
             # Without this, keep=(idx==root) is False everywhere and the
             # masked psum silently broadcasts zeros.
@@ -329,7 +368,7 @@ def alltoall(tensor: Any, splits: Optional[Sequence[int]] = None, *,
         return alltoall_v(tensor, splits, process_set=process_set,
                           axis_name=axis_name)
     axis = _axis(axis_name)
-    if _is_global(process_set) and static_axis_size(axis) == 1:
+    if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
     groups = _groups(process_set, axis, require_equal=True)
 
@@ -357,7 +396,7 @@ def reducescatter(tensor: Any, op: str = Sum, *,
     if op not in (Sum, Average):
         raise ValueError("reducescatter supports Sum and Average")
     axis = _axis(axis_name)
-    if _is_global(process_set) and static_axis_size(axis) == 1:
+    if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
     groups = _groups(process_set, axis, require_equal=True)
     n = _set_size(process_set, axis)

@@ -235,3 +235,30 @@ def global_process_set() -> ProcessSet:
     common/process_sets.py — there a module attribute, here a function since
     world size is only known after ``init()``)."""
     return context().process_sets.global_set
+
+
+def mpi_threads_supported() -> bool:
+    """Parity: ``hvd.mpi_threads_supported()`` (basics.py). Always False —
+    there is no MPI in this build; scripts probing it fall back correctly."""
+    return False
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Begin writing the host-side Chrome-trace timeline to ``file_path``.
+
+    Parity: ``hvd.start_timeline`` (basics.py → timeline.cc ActivityStart
+    plumbing). Device-side activity is better captured by jax.profiler; use
+    ``tools.merge_chrome_traces`` to combine both views."""
+    from ..tools.timeline import Timeline
+    ctx = context()
+    if ctx.timeline is not None:
+        ctx.timeline.close()
+    ctx.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    """Parity: ``hvd.stop_timeline`` — flush and close the timeline."""
+    ctx = context()
+    if ctx.timeline is not None:
+        ctx.timeline.close()
+        ctx.timeline = None

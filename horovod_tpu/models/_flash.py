@@ -1,0 +1,48 @@
+"""Shared flash-attention auto-resolution for the model families."""
+
+import os
+
+import jax
+
+# Auto crossover, measured on v5e BERT-Large (seq 512): XLA's materialised
+# attention reaches ~47k tok/s/chip vs ~32k for the Pallas kernel, because
+# XLA's AD reuses the saved softmax while the flash backward recomputes.
+# The kernel wins once the [T, T] score matrix stops fitting cache-friendly
+# HBM traffic — at/above ~2k tokens — and is mandatory for ring attention
+# (which calls it explicitly with residuals, bypassing this heuristic).
+AUTO_MIN_SEQ = 2048
+
+
+def _manual_or_single_device() -> bool:
+    """True when a ``pallas_call`` is safe without partitioning rules:
+    either we are tracing per-device code (the context rank axis is bound,
+    i.e. inside the DP ``shard_map``) or there is only one device. Under
+    GSPMD (pjit with sharded operands, no bound axis) XLA cannot partition
+    a custom kernel — auto resolution must refuse there; GSPMD users opt in
+    explicitly with ``use_flash=True`` after wrapping attention in
+    ``shard_map`` themselves."""
+    from ..collectives.ops import static_axis_size
+    from ..core import context_api as _ctx
+    if _ctx.is_initialized() \
+            and static_axis_size(_ctx.context().axis_name) is not None:
+        return True
+    return len(jax.devices()) == 1
+
+
+def resolve_flash(use_flash, seq_len=None):
+    """None = auto: the Pallas kernel on TPU for sequences >= AUTO_MIN_SEQ
+    in manual/single-device mode; materialised softmax otherwise (short
+    sequences are faster through XLA, interpret-mode Pallas is orders of
+    magnitude slower on CPU meshes, and GSPMD cannot partition the kernel).
+    ``HOROVOD_FLASH_ATTENTION=0/1`` overrides the auto choice (config-system
+    parity: explicit config beats env beats default)."""
+    if use_flash is not None:
+        return bool(use_flash)
+    env = os.environ.get("HOROVOD_FLASH_ATTENTION")
+    if env is not None:
+        return env not in ("0", "false", "False", "")
+    if jax.default_backend() != "tpu":
+        return False
+    if seq_len is not None and seq_len < AUTO_MIN_SEQ:
+        return False
+    return _manual_or_single_device()

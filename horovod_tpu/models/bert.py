@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
 
 from .llama import _part
+from ._flash import resolve_flash as _resolve_flash
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,6 +33,9 @@ class BertConfig:
     norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # None = auto: Pallas flash attention on TPU, materialised softmax
+    # elsewhere (interpret-mode Pallas is too slow for CPU test meshes).
+    use_flash: "bool | None" = None
 
 
 def bert_large() -> BertConfig:
@@ -66,11 +70,18 @@ class EncoderBlock(nn.Module):
         q = q.reshape(B, T, c.n_heads, head_dim)
         k = k.reshape(B, T, c.n_heads, head_dim)
         v = v.reshape(B, T, c.n_heads, head_dim)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        s = s / jnp.sqrt(head_dim)
-        s = jnp.where(attn_mask[:, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, c.dim)
+        if _resolve_flash(c.use_flash, T):
+            from ..ops.flash_attention import flash_attention
+            o = flash_attention(q, k, v, causal=False,
+                                kv_mask=attn_mask,
+                                scale=float(1.0 / head_dim ** 0.5))
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            s = s / jnp.sqrt(head_dim)
+            s = jnp.where(attn_mask[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        o = o.reshape(B, T, c.dim)
         o = dense(c.dim, ("heads", "embed"), "wo")(o)
         x = nn.LayerNorm(epsilon=c.norm_eps, dtype=c.dtype,
                          name="attn_norm")(x + o)

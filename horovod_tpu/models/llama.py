@@ -28,6 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
+from ._flash import resolve_flash as _resolve_flash
 
 # Logical → mesh axis rules (see parallel/mesh.py for axis vocabulary).
 LOGICAL_RULES = (
@@ -60,6 +61,9 @@ class LlamaConfig:
     remat: bool = True
     scan_layers: bool = True
     tie_embeddings: bool = False
+    # None = auto: Pallas flash attention on TPU, materialised softmax
+    # elsewhere (interpret-mode Pallas is too slow for CPU test meshes).
+    use_flash: "bool | None" = None
 
 
 def llama3_8b() -> LlamaConfig:
@@ -133,12 +137,17 @@ class Attention(nn.Module):
         rep = c.n_heads // c.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-        scale = 1.0 / jnp.sqrt(head_dim)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        scale = 1.0 / head_dim ** 0.5  # python float: static for the kernel
+        if _resolve_flash(c.use_flash, T):
+            from ..ops.flash_attention import flash_attention
+            o = flash_attention(q, k, v, causal=True, scale=scale)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(
+                jnp.float32) * scale
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         o = o.reshape(B, T, c.n_heads * head_dim)
         out = nn.Dense(
             c.dim, use_bias=False, dtype=c.dtype, name="wo",

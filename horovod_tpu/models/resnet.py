@@ -17,7 +17,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ..collectives.ops import static_axis_size
+from ..collectives.ops import effective_axis_size
 
 ModuleDef = Any
 
@@ -93,7 +93,7 @@ class ResNet(nn.Module):
         # keeps (not elides) single-participant all-reduces — resolve the
         # axis at trace time so ~50 BN psums vanish on one device.
         bn_axis = self.axis_name if train else None
-        if bn_axis is not None and static_axis_size(bn_axis) == 1:
+        if bn_axis is not None and effective_axis_size(bn_axis) == 1:
             bn_axis = None
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,

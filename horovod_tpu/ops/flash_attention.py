@@ -51,8 +51,8 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-               acc, m_s, l_s, *, scale, causal, bq, bk, nk, valid_k):
+def _fa_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
+               valid_k, has_bias):
     """One (batch*head, q-block, k-block) grid step.
 
     Scratch (persists across the innermost k-block grid dim):
@@ -60,6 +60,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
       m_s [bq, 128] f32 — running row max (broadcast over lanes)
       l_s [bq, 128] f32 — running denominator (broadcast over lanes)
     """
+    if has_bias:
+        bias_ref, o_ref, m_ref, l_ref, acc, m_s, l_s = refs
+    else:
+        o_ref, m_ref, l_ref, acc, m_s, l_s = refs
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -75,12 +79,18 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # Keep the input dtype (bf16 on TPU) for both MXU dots and accumulate
+        # in f32 via preferred_element_type — casting up first would force
+        # fp32 MXU passes, ~4x the matmul cost for no accuracy the f32
+        # accumulation doesn't already give.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -96,7 +106,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             l_s[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_s.shape)
         acc[:] = acc[:] * corr + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
 
@@ -123,8 +133,10 @@ def _pad_axis(x, axis, mult):
 
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "block_q", "block_k", "interpret"))
-def _fa_call(q, k, v, *, causal, scale, block_q, block_k, interpret):
-    """q [BH, Tq, D], k/v [BH, Tk, D] → (o [BH, Tq, D], m, l [BH, Tq])."""
+def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
+             interpret):
+    """q [BH, Tq, D], k/v [BH, Tk, D], optional additive score bias
+    [BH, Tk] → (o [BH, Tq, D], m, l [BH, Tq])."""
     BH, Tq0, D = q.shape
     q, Tq0 = _pad_axis(q, 1, block_q)
     k, Tk0 = _pad_axis(k, 1, block_k)
@@ -132,15 +144,23 @@ def _fa_call(q, k, v, *, causal, scale, block_q, block_k, interpret):
     Tq, Tk = q.shape[1], k.shape[1]
     nq, nk = Tq // block_q, Tk // block_k
     kern = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                             bq=block_q, bk=block_k, nk=nk, valid_k=Tk0)
+                             bq=block_q, bk=block_k, nk=nk, valid_k=Tk0,
+                             has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if bias is not None:
+        bias, _ = _pad_axis(bias, 1, block_k)  # pad 0: valid_k masks the rest
+        operands.append(bias[:, None, :])
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)))
     o, m, l = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -157,18 +177,21 @@ def _fa_call(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANE), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o[:, :Tq0], m[:, :Tq0, 0], l[:, :Tq0, 0]
 
 
-def _reference_partial(q, k, v, *, causal, scale):
+def _reference_partial(q, k, v, bias=None, *, causal, scale):
     """Blockless jnp oracle with the same (o, m, l) partial semantics.
 
     Used as the recompute path of the backward pass and by the test suite.
-    q [B, Tq, H, D]; k/v [B, Tk, H, D]; returns o [B,Tq,H,D], m/l [B,H,Tq].
+    q [B, Tq, H, D]; k/v [B, Tk, H, D]; optional additive score bias
+    [B, Tk]; returns o [B,Tq,H,D], m/l [B,H,Tq].
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)[:, None, None, :]
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
@@ -181,34 +204,40 @@ def _reference_partial(q, k, v, *, causal, scale):
     return o.astype(q.dtype), m, l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _fa_core(q, k, v, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fa_core(q, k, v, bias, causal, scale, block_q, block_k):
     interpret = _use_interpret()
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, -1, D)
-    o, m, l = _fa_call(fold(q), fold(k), fold(v), causal=causal, scale=scale,
-                       block_q=block_q, block_k=block_k, interpret=interpret)
+    fbias = None
+    if bias is not None:
+        # [B, Tk] → [BH, Tk] to match the folded batch*head leading dim.
+        fbias = jnp.broadcast_to(bias[:, None, :], (B, H, Tk)).reshape(
+            B * H, Tk)
+    o, m, l = _fa_call(fold(q), fold(k), fold(v), fbias, causal=causal,
+                       scale=scale, block_q=block_q, block_k=block_k,
+                       interpret=interpret)
     o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     return o, m.reshape(B, H, Tq), l.reshape(B, H, Tq)
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _fa_core(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+def _fa_fwd(q, k, v, bias, causal, scale, block_q, block_k):
+    out = _fa_core(q, k, v, bias, causal, scale, block_q, block_k)
+    return out, (q, k, v, bias)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, cts):
-    q, k, v = res
+    q, k, v, bias = res
     do, dm, dl = cts
     # The m/l residuals carry real cotangents when the caller merges partials
     # (ring attention weights each partial by exp(m_i - m) * l_i), so the
     # recompute must differentiate through all three outputs.
 
-    def recompute(q, k, v):
-        return _reference_partial(q, k, v, causal=causal, scale=scale)
+    def recompute(q, k, v, bias):
+        return _reference_partial(q, k, v, bias, causal=causal, scale=scale)
 
-    _, vjp = jax.vjp(recompute, q, k, v)
+    _, vjp = jax.vjp(recompute, q, k, v, bias)
     return vjp((do.astype(q.dtype), dm.astype(jnp.float32),
                 dl.astype(jnp.float32)))
 
@@ -217,10 +246,14 @@ _fa_core.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
+                    kv_mask=None,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     return_residuals: bool = False):
     """Blockwise (flash) attention on [B, T, H, D] tensors.
+
+    ``kv_mask`` is an optional [B, Tk] bool array marking real (attendable)
+    keys — the BERT-style padding mask; masked keys never win the softmax.
 
     Returns the attention output, plus ``(m, l)`` softmax residuals of shape
     [B, H, Tq] when ``return_residuals`` — feed those to
@@ -230,12 +263,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
     D = q.shape[-1]
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    bias = None
+    if kv_mask is not None:
+        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
     # Clamp to the sequence length rounded UP to a multiple of 8: block
     # sublane dims must stay 8-divisible for the TPU tiling rule (padding
     # covers the remainder).
     block_q = min(block_q, -(-max(q.shape[1], 1) // 8) * 8)
     block_k = min(block_k, -(-max(k.shape[1], 1) // 8) * 8)
-    o, m, l = _fa_core(q, k, v, causal, float(scale), block_q, block_k)
+    o, m, l = _fa_core(q, k, v, bias, causal, float(scale), block_q, block_k)
     if return_residuals:
         return o, (m, l)
     return o

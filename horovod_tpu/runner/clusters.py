@@ -1,0 +1,152 @@
+"""Cluster-manager detection: derive the host list from the scheduler.
+
+Reference parity: ``horovod/runner/common/util/lsf.py`` (``LSFUtils``) plus
+the launcher's "no ``-H``/``--hostfile`` given → ask the cluster manager"
+fallback in ``runner/launch.py``. The reference only sniffs LSF; Slurm is the
+scheduler actually found on TPU pods' neighbours, so both are covered here.
+Detection is env-var based and side-effect free — safe to call anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from typing import Dict, List, Optional
+
+
+class LSFUtils:
+    """Parity with the reference class of the same name."""
+
+    @staticmethod
+    def using_lsf() -> bool:
+        return "LSB_JOBID" in os.environ and (
+            "LSB_HOSTS" in os.environ or "LSB_MCPU_HOSTS" in os.environ)
+
+    @staticmethod
+    def get_compute_hosts() -> List[str]:
+        """Ordered unique hosts of this LSF job (batch host excluded the way
+        the reference does it: it is listed first and runs no workers only
+        when LSB_BATCH_EXCLUDE is set; default keeps reference behavior of
+        using every listed host)."""
+        mcpu = os.environ.get("LSB_MCPU_HOSTS")
+        if mcpu:
+            toks = mcpu.split()
+            return [toks[i] for i in range(0, len(toks), 2)]
+        hosts, seen = [], set()
+        for h in os.environ.get("LSB_HOSTS", "").split():
+            if h not in seen:
+                seen.add(h)
+                hosts.append(h)
+        return hosts
+
+    @staticmethod
+    def get_num_processes() -> int:
+        mcpu = os.environ.get("LSB_MCPU_HOSTS")
+        if mcpu:
+            toks = mcpu.split()
+            return sum(int(toks[i]) for i in range(1, len(toks), 2))
+        return len(os.environ.get("LSB_HOSTS", "").split())
+
+    @staticmethod
+    def get_num_threads() -> int:
+        return int(os.environ.get("LSB_DJOB_NUMPROC", "1"))
+
+
+def _expand_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand a Slurm compressed nodelist like ``tpu-[001-003,005],head``.
+
+    Uses ``scontrol show hostnames`` when available (authoritative), falling
+    back to a pure-python expansion of the bracket syntax.
+    """
+    try:
+        out = subprocess.run(["scontrol", "show", "hostnames", nodelist],
+                             capture_output=True, text=True, timeout=5)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.split()
+    except (OSError, subprocess.SubprocessError):
+        pass
+
+    hosts: List[str] = []
+    # split on commas not inside brackets
+    for part in re.split(r",(?![^\[]*\])", nodelist):
+        m = re.fullmatch(r"([^\[\]]+)\[([^\]]+)\]", part.strip())
+        if not m:
+            if part.strip():
+                hosts.append(part.strip())
+            continue
+        prefix, ranges = m.groups()
+        for r in ranges.split(","):
+            if "-" in r:
+                lo, hi = r.split("-")
+                width = len(lo)
+                hosts.extend(f"{prefix}{i:0{width}d}"
+                             for i in range(int(lo), int(hi) + 1))
+            else:
+                hosts.append(f"{prefix}{r}")
+    return hosts
+
+
+class SlurmUtils:
+    """Slurm counterpart (capability-extension; the reference never ran on
+    Slurm but its LSF sniffing plays the same role)."""
+
+    @staticmethod
+    def using_slurm() -> bool:
+        return "SLURM_JOB_ID" in os.environ and (
+            "SLURM_JOB_NODELIST" in os.environ
+            or "SLURM_NODELIST" in os.environ)
+
+    @staticmethod
+    def get_compute_hosts() -> List[str]:
+        nodelist = os.environ.get("SLURM_JOB_NODELIST",
+                                  os.environ.get("SLURM_NODELIST", ""))
+        return _expand_slurm_nodelist(nodelist) if nodelist else []
+
+    @staticmethod
+    def get_tasks_per_node() -> Dict[str, int]:
+        """Map host → slot count from SLURM_TASKS_PER_NODE (e.g. '4(x2),2')."""
+        hosts = SlurmUtils.get_compute_hosts()
+        spec = os.environ.get("SLURM_TASKS_PER_NODE", "")
+        counts: List[int] = []
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            m = re.fullmatch(r"(\d+)\(x(\d+)\)", tok)
+            if m:
+                counts.extend([int(m.group(1))] * int(m.group(2)))
+            else:
+                counts.append(int(tok))
+        if len(counts) < len(hosts):
+            counts.extend([counts[-1] if counts else 1]
+                          * (len(hosts) - len(counts)))
+        return dict(zip(hosts, counts))
+
+    @staticmethod
+    def get_num_processes() -> int:
+        ntasks = os.environ.get("SLURM_NTASKS")
+        if ntasks:
+            return int(ntasks)
+        return sum(SlurmUtils.get_tasks_per_node().values()) or 0
+
+
+def detect_hosts() -> Optional[str]:
+    """If running under a recognised cluster manager and no explicit host
+    list was given, return a ``host:slots,...`` string; else None."""
+    if SlurmUtils.using_slurm():
+        per = SlurmUtils.get_tasks_per_node()
+        if per:
+            return ",".join(f"{h}:{n}" for h, n in per.items())
+    if LSFUtils.using_lsf():
+        hosts = LSFUtils.get_compute_hosts()
+        if hosts:
+            mcpu = os.environ.get("LSB_MCPU_HOSTS")
+            if mcpu:
+                toks = mcpu.split()
+                return ",".join(f"{toks[i]}:{toks[i + 1]}"
+                                for i in range(0, len(toks), 2))
+            from collections import Counter
+            c = Counter(os.environ.get("LSB_HOSTS", "").split())
+            return ",".join(f"{h}:{c[h]}" for h in hosts)
+    return None

@@ -44,6 +44,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--check-build", action="store_true",
                    help="print framework build info and exit")
+    p.add_argument("--config-file", dest="config_file",
+                   help="YAML file of flag defaults (reference "
+                        "config_parser.py; explicit CLI flags win)")
     # Elastic (reference: _run_elastic)
     p.add_argument("--min-np", type=int, dest="min_np")
     p.add_argument("--max-np", type=int, dest="max_np")
@@ -81,14 +84,87 @@ Available features:
 """, file=file)
 
 
+# Launcher flags that take NO value — the pre-scan below needs this to know
+# where the launcher's flags end and the user command begins.
+_NO_VALUE_FLAGS = {"--check-build", "-v", "--verbose", "-h", "--help"}
+
+
+def _own_config_file(argv: List[str]) -> Optional[str]:
+    """Find ``--config-file`` among the LAUNCHER's own flags only — the scan
+    stops at the first positional (the user command), so a ``--config-file``
+    belonging to the launched training script is never hijacked."""
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok == "--":
+            return None
+        if tok == "--config-file":
+            return argv[i + 1] if i + 1 < len(argv) else None
+        if tok.startswith("--config-file="):
+            return tok.split("=", 1)[1]
+        if tok.startswith("-"):
+            i += 1 if ("=" in tok or tok in _NO_VALUE_FLAGS) else 2
+        else:
+            return None  # first positional: the command starts here
+    return None
+
+
+def _apply_config_file(parser: argparse.ArgumentParser,
+                       argv: List[str]) -> dict:
+    """Reference parity: ``--config-file`` YAML defaults
+    (runner/common/util/config_parser.py). Nested sections are flattened;
+    keys use either dash or underscore form; explicit CLI flags win because
+    the file only changes parser *defaults*. Count-style flags (``-v``)
+    cannot be expressed as defaults without stacking onto explicit CLI
+    occurrences, so they are returned for post-parse merging instead."""
+    path = _own_config_file(argv)
+    if not path:
+        return {}
+    import yaml
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    flat = {}
+
+    def walk(d):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                walk(v)
+            else:
+                flat[str(k).replace("-", "_")] = v
+
+    walk(raw)
+    valid = {a.dest for a in parser._actions}
+    unknown = set(flat) - valid
+    if unknown:
+        raise SystemExit(f"--config-file: unknown keys {sorted(unknown)}")
+    post = {}
+    for action in parser._actions:
+        if isinstance(action, argparse._CountAction) \
+                and action.dest in flat:
+            post[action.dest] = (flat.pop(action.dest),
+                                 action.default or 0)
+    parser.set_defaults(**flat)
+    return post
+
+
 def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    count_defaults = _apply_config_file(parser, argv)
+    args = parser.parse_args(argv)
+    for dest, (value, default) in count_defaults.items():
+        if getattr(args, dest) == default:  # flag absent from the CLI
+            setattr(args, dest, value)
     if args.check_build:
         check_build()
         raise SystemExit(0)
     hosts_str = args.hosts
     if args.hostfile:
         hosts_str = parse_host_files(args.hostfile)
+    if not hosts_str:
+        # No -H/--hostfile: ask the cluster manager (LSF/Slurm), parity with
+        # the reference's lsf fallback in launch.py.
+        from .clusters import detect_hosts
+        hosts_str = detect_hosts()
     hosts = parse_hosts(hosts_str) if hosts_str else []
     elastic = bool(args.host_discovery_script or args.min_np or args.max_np)
     s = Settings(num_proc=args.np, hosts=hosts,

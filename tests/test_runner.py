@@ -239,3 +239,107 @@ def test_launch_job_surfaces_spawn_failure(tmp_path):
     code = launch_job(a, ["/nonexistent/binary-xyz"], Settings(),
                       coordinator_addr="127.0.0.1:1")
     assert code != 0
+
+
+# ---------------- cluster detection + config file ----------------
+
+def test_slurm_nodelist_expansion(monkeypatch):
+    from horovod_tpu.runner import clusters
+    monkeypatch.setattr(clusters.subprocess, "run",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError()))
+    assert clusters._expand_slurm_nodelist("tpu-[001-003,005],head") == [
+        "tpu-001", "tpu-002", "tpu-003", "tpu-005", "head"]
+
+
+def test_slurm_detect_hosts(monkeypatch):
+    from horovod_tpu.runner import clusters
+    monkeypatch.setenv("SLURM_JOB_ID", "42")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "n[1-3]")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "4(x2),2")
+    monkeypatch.setattr(clusters.subprocess, "run",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError()))
+    assert clusters.detect_hosts() == "n1:4,n2:4,n3:2"
+
+
+def test_lsf_detect_hosts(monkeypatch):
+    from horovod_tpu.runner import clusters
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    monkeypatch.setenv("LSB_JOBID", "7")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "a 4 b 2")
+    assert clusters.LSFUtils.using_lsf()
+    assert clusters.LSFUtils.get_num_processes() == 6
+    assert clusters.detect_hosts() == "a:4,b:2"
+
+
+def test_launch_uses_scheduler_hosts(monkeypatch):
+    from horovod_tpu.runner.launch import parse_settings
+    monkeypatch.setenv("SLURM_JOB_ID", "42")
+    monkeypatch.setenv("SLURM_JOB_NODELIST", "n[1-2]")
+    monkeypatch.setenv("SLURM_TASKS_PER_NODE", "4(x2)")
+    from horovod_tpu.runner import clusters
+    monkeypatch.setattr(clusters.subprocess, "run",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError()))
+    s, cmd = parse_settings(["-np", "8", "python", "train.py"])
+    assert [(h.hostname, h.slots) for h in s.hosts] == [("n1", 4), ("n2", 4)]
+    assert cmd == ["python", "train.py"]
+
+
+def test_config_file_defaults_cli_wins(tmp_path):
+    from horovod_tpu.runner.launch import parse_settings
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("np: 4\nverbose: 2\nstart-timeout: 33\n")
+    s, cmd = parse_settings(["--config-file", str(cfg), "-np", "8",
+                             "-H", "localhost:8", "python", "t.py"])
+    assert s.num_proc == 8          # CLI beats file
+    assert s.verbose == 2           # file supplies default
+    assert s.start_timeout_s == 33
+    assert cmd == ["python", "t.py"]
+
+
+def test_config_file_unknown_key_rejected(tmp_path):
+    import pytest
+    from horovod_tpu.runner.launch import parse_settings
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("nonsense_knob: 1\n")
+    with pytest.raises(SystemExit, match="unknown keys"):
+        parse_settings(["--config-file", str(cfg), "-np", "2", "x"])
+
+
+def test_timeline_start_stop(tmp_path):
+    import json
+    import horovod_tpu as hvd
+    path = tmp_path / "tl.json"
+    hvd.start_timeline(str(path), mark_cycles=True)
+    ctx = hvd.core.context()
+    ctx.timeline.activity_start("t0", "ALLREDUCE")
+    ctx.timeline.activity_end("t0", "ALLREDUCE")
+    hvd.stop_timeline()
+    assert ctx.timeline is None
+    evs = json.loads(path.read_text())
+    assert [e["ph"] for e in evs] == ["B", "E"]
+    assert not hvd.mpi_threads_supported()
+
+
+def test_config_file_not_hijacked_from_command(tmp_path):
+    """A --config-file flag belonging to the launched training script must
+    reach that script, not the launcher."""
+    from horovod_tpu.runner.launch import parse_settings
+    s, cmd = parse_settings(["-np", "2", "-H", "localhost:2",
+                             "python", "train.py",
+                             "--config-file", "training.yaml"])
+    assert cmd == ["python", "train.py", "--config-file", "training.yaml"]
+    assert s.num_proc == 2
+
+
+def test_config_file_count_flag_merges_not_stacks(tmp_path):
+    from horovod_tpu.runner.launch import parse_settings
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("verbose: 2\n")
+    # explicit -v on the CLI wins outright (no 2+1 stacking)
+    s, _ = parse_settings(["--config-file", str(cfg), "-v", "-np", "2",
+                           "python", "x.py"])
+    assert s.verbose == 1
+    # absent from the CLI: the file value applies
+    s2, _ = parse_settings(["--config-file", str(cfg), "-np", "2",
+                            "python", "x.py"])
+    assert s2.verbose == 2
