@@ -105,6 +105,16 @@ def adasum_allreduce(tensor: Any, *, process_set: Optional[ProcessSet] = None,
     from . import ops as _ops
     from horovod_tpu.core import context_api as _ctx
     axis = _ops._axis(axis_name)
+    if _ops._is_global(process_set) and _ops.effective_axis_size(axis) == 1:
+        # Adasum of a single contribution is that contribution (scaled) —
+        # same trace-time collapse as every other op on a 1-member axis.
+        # The multi-device path scales in accumulate dtype and casts back
+        # to each leaf's dtype at the end; mirror that so output dtypes are
+        # world-size invariant.
+        def leaf(x):
+            f = prescale_factor * postscale_factor
+            return x if f == 1.0 else (x * f).astype(x.dtype)
+        return jax.tree_util.tree_map(leaf, tensor)
     if accumulate_dtype is None:
         accumulate_dtype = jnp.float32
         if _ctx.is_initialized() and \

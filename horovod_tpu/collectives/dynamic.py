@@ -34,13 +34,17 @@ def allgather_v(tensor, valid_size, *, axis_name: Optional[str] = None,
     host to obtain the reference's densely-concatenated result.
     """
     axis = _ops._axis(axis_name)
-    groups = _ops._groups(process_set, axis, require_equal=True)
+    one = _ops._is_global(process_set) and _ops.effective_axis_size(axis) == 1
+    groups = None if one else _ops._groups(process_set, axis,
+                                           require_equal=True)
     max_rows = tensor.shape[0]
     # Zero out the padding so downstream reductions over the padded layout
     # are safe regardless of caller garbage.
     mask_shape = (max_rows,) + (1,) * (tensor.ndim - 1)
     row_ids = jnp.arange(max_rows).reshape(mask_shape)
     tensor = jnp.where(row_ids < valid_size, tensor, jnp.zeros_like(tensor))
+    if one:
+        return tensor, jnp.asarray(valid_size, jnp.int32)[None]
     gathered = lax.all_gather(tensor, axis, axis=0, tiled=True,
                               axis_index_groups=groups)
     sizes = lax.all_gather(jnp.asarray(valid_size, jnp.int32)[None], axis,
@@ -76,8 +80,10 @@ def alltoall_v(tensor, splits, *, max_split: Optional[int] = None,
     host with :func:`compact_gathered`.
     """
     axis = _ops._axis(axis_name)
-    groups = _ops._groups(process_set, axis, require_equal=True)
-    n = _ops._set_size(process_set, axis)
+    one = _ops._is_global(process_set) and _ops.effective_axis_size(axis) == 1
+    groups = None if one else _ops._groups(process_set, axis,
+                                           require_equal=True)
+    n = 1 if one else _ops._set_size(process_set, axis)
     splits = jnp.asarray(splits, jnp.int32)
     if max_split is None:
         max_split = tensor.shape[0]
@@ -101,6 +107,9 @@ def alltoall_v(tensor, splits, *, max_split: Optional[int] = None,
         return jnp.where(row_ids < count, chunk, jnp.zeros_like(chunk))
 
     chunks = jax.vmap(take_chunk)(offsets, splits)  # [n, max_split, ...]
+    if one:
+        # 1-member axis: the exchange is identity on the padded layout.
+        return chunks.reshape((n * max_split,) + tensor.shape[1:]), splits
     received = lax.all_to_all(chunks, axis, split_axis=0, concat_axis=0,
                               axis_index_groups=groups)
     recv_splits = lax.all_to_all(splits[:, None], axis, split_axis=0,

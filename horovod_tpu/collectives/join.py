@@ -35,12 +35,14 @@ from jax import lax
 
 from horovod_tpu.core import context_api as _ctx
 from .compression import Compression, Compressor
-from .ops import Average, Sum, _axis
+from .ops import Average, Sum, _axis, effective_axis_size
 
 
 def join_count(active, *, axis_name: Optional[str] = None):
     """Traced number of not-yet-joined ranks (int32 scalar, replicated)."""
     axis = _axis(axis_name)
+    if effective_axis_size(axis) == 1:
+        return jnp.asarray(active, jnp.int32)
     return lax.psum(jnp.asarray(active, jnp.int32), axis)
 
 
@@ -58,6 +60,9 @@ def join(active, *, axis_name: Optional[str] = None):
     """
     axis = _axis(axis_name)
     n = join_count(active, axis_name=axis)
+    if effective_axis_size(axis) == 1:
+        act = jnp.asarray(active, jnp.bool_)
+        return n > 0, jnp.where(act, jnp.int32(0), jnp.int32(-1))
     idx = lax.axis_index(axis)
     mine = jnp.where(jnp.asarray(active, jnp.bool_), idx.astype(jnp.int32),
                      jnp.int32(-1))
@@ -82,10 +87,12 @@ def join_allreduce(tensor: Any, active, op: str = Average, *,
     denom = jnp.maximum(n_active, 1)
     act = jnp.asarray(active, jnp.bool_)
 
+    one = effective_axis_size(axis) == 1
+
     def leaf(x):
         cx, cctx = compression.compress(x)
         contrib = jnp.where(act, cx, jnp.zeros_like(cx))
-        y = lax.psum(contrib, axis)
+        y = contrib if one else lax.psum(contrib, axis)
         if op == Average:
             y = y / denom.astype(y.dtype if jnp.issubdtype(y.dtype, jnp.floating)
                                  else jnp.float32)

@@ -4,13 +4,13 @@ import os
 
 import jax
 
-# Auto crossover, measured on v5e BERT-Large (seq 512): XLA's materialised
-# attention reaches ~47k tok/s/chip vs ~32k for the Pallas kernel, because
-# XLA's AD reuses the saved softmax while the flash backward recomputes.
-# The kernel wins once the [T, T] score matrix stops fitting cache-friendly
-# HBM traffic — at/above ~2k tokens — and is mandatory for ring attention
-# (which calls it explicitly with residuals, bypassing this heuristic).
-AUTO_MIN_SEQ = 2048
+# Auto crossover, measured on v5e BERT-Large (seq 512): with the dedicated
+# blockwise backward kernels and 512-token blocks the Pallas kernel reaches
+# ~54k tok/s/chip vs ~47k for XLA's materialised attention, and the gap
+# grows with sequence length (~5x fwd+bwd at T=4096, D=64). Below ~512
+# tokens the grid is too small to amortise kernel overhead. Ring attention
+# calls the kernel explicitly with residuals, bypassing this heuristic.
+AUTO_MIN_SEQ = 512
 
 
 def _manual_or_single_device() -> bool:

@@ -229,3 +229,63 @@ def test_flash_kv_mask_grads_flow():
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_kernel_matches_reference(causal):
+    """The dedicated blockwise backward (no-residual path) must match the
+    materialised-softmax vjp, including causal tile skipping and padding in
+    BOTH sequence dims (T=100/84 are not block multiples)."""
+    B, H, D = 2, 2, 32
+    Tq, Tk = (100, 100) if causal else (100, 84)
+    q = _rand((B, Tq, H, D), 20)
+    k = _rand((B, Tk, H, D), 21)
+    v = _rand((B, Tk, H, D), 22)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, block_q=32,
+                                block_k=32) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        o, _, _ = _reference_partial(q, k, v, causal=causal,
+                                     scale=D ** -0.5)
+        return (o ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_residual_path_still_differentiable():
+    """return_residuals=True keeps the recompute vjp (m/l cotangents from
+    merge_partials must flow)."""
+    B, T, H, D = 1, 32, 2, 16
+    q = _rand((B, T, H, D), 23)
+    k1 = _rand((B, T, H, D), 24)
+    v1 = _rand((B, T, H, D), 25)
+    k2 = _rand((B, T, H, D), 26)
+    v2 = _rand((B, T, H, D), 27)
+
+    def loss(q, k1, v1, k2, v2):
+        o1, (m1, l1) = flash_attention(q, k1, v1, causal=False,
+                                       return_residuals=True,
+                                       block_q=16, block_k=16)
+        o2, (m2, l2) = flash_attention(q, k2, v2, causal=False,
+                                       return_residuals=True,
+                                       block_q=16, block_k=16)
+        o, _, _ = merge_partials((o1, m1, l1), (o2, m2, l2))
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    def ref_loss(q, k1, v1, k2, v2):
+        k = jnp.concatenate([k1, k2], axis=1)
+        v = jnp.concatenate([v1, v2], axis=1)
+        o, _, _ = _reference_partial(q, k, v, causal=False, scale=D ** -0.5)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(q, k1, v1, k2, v2)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2, 3, 4))(q, k1, v1, k2, v2)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
