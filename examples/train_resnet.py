@@ -18,6 +18,9 @@ CPU smoke test (8 virtual devices):
 import argparse
 import time
 
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -84,14 +87,14 @@ def main():
           f"global_batch={args.batch_size} model={args.model}")
     for i in range(args.warmup):
         state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
+    float(loss)  # value fetch: a real sync even on remote-tunnel backends
     t0 = time.perf_counter()
     for i in range(args.steps):
         state, loss = step(state, images, labels)
-    jax.block_until_ready(loss)
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
     ips = args.batch_size * args.steps / dt
-    print(f"loss={float(loss):.4f} images/sec={ips:.1f} "
+    print(f"loss={final_loss:.4f} images/sec={ips:.1f} "
           f"images/sec/chip={ips / n:.1f} step_ms={dt / args.steps * 1e3:.2f}")
 
 

@@ -1,0 +1,18 @@
+"""Backend-override helper for scripts (examples, benchmarks, entry points).
+
+Some images pre-register an accelerator plugin at interpreter start, where
+``JAX_PLATFORMS=cpu`` in the environment alone does not switch jax's
+backend. Calling this before any computation makes the env var authoritative
+again. Safe to call multiple times and when the env var is unset.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats.split(",")[0].strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
