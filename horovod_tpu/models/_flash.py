@@ -13,16 +13,17 @@ import jax
 AUTO_MIN_SEQ = 512
 
 
-def _manual_or_single_device() -> bool:
-    """True when a ``pallas_call`` is safe without partitioning rules:
-    either we are tracing per-device code (the context rank axis is bound,
-    i.e. inside the DP ``shard_map``) or there is only one device. Under
-    GSPMD (pjit with sharded operands, no bound axis) XLA cannot partition
-    a custom kernel — auto resolution must refuse there; GSPMD users opt in
-    explicitly with ``use_flash=True`` after wrapping attention in
-    ``shard_map`` themselves."""
+def _gspmd_safe() -> bool:
+    """A ``pallas_call`` is safe when one of: the kernels' own
+    ``custom_partitioning`` wrappers are active (batch*head sharded,
+    sequence/depth replicated — the default on TPU), we are tracing
+    per-device code (the context rank axis is bound, i.e. inside the DP
+    ``shard_map``), or there is only one device."""
     from ..collectives.ops import static_axis_size
     from ..core import context_api as _ctx
+    from ..ops.flash_attention import _partition_enabled
+    if _partition_enabled():
+        return True
     if _ctx.is_initialized() \
             and static_axis_size(_ctx.context().axis_name) is not None:
         return True
@@ -31,11 +32,11 @@ def _manual_or_single_device() -> bool:
 
 def resolve_flash(use_flash, seq_len=None):
     """None = auto: the Pallas kernel on TPU for sequences >= AUTO_MIN_SEQ
-    in manual/single-device mode; materialised softmax otherwise (short
-    sequences are faster through XLA, interpret-mode Pallas is orders of
-    magnitude slower on CPU meshes, and GSPMD cannot partition the kernel).
-    ``HOROVOD_FLASH_ATTENTION=0/1`` overrides the auto choice (config-system
-    parity: explicit config beats env beats default)."""
+    (short sequences are faster through XLA and interpret-mode Pallas is
+    orders of magnitude slower on CPU meshes). GSPMD composition is handled
+    by the kernels' custom_partitioning wrappers. ``HOROVOD_FLASH_ATTENTION
+    =0/1`` overrides the auto choice (config-system parity: explicit config
+    beats env beats default)."""
     if use_flash is not None:
         return bool(use_flash)
     env = os.environ.get("HOROVOD_FLASH_ATTENTION")
@@ -45,4 +46,4 @@ def resolve_flash(use_flash, seq_len=None):
         return False
     if seq_len is not None and seq_len < AUTO_MIN_SEQ:
         return False
-    return _manual_or_single_device()
+    return _gspmd_safe()

@@ -55,7 +55,7 @@ def _use_interpret() -> bool:
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
-               valid_k, has_bias):
+               has_bias):
     """One (batch*head, q-block, k-block) grid step.
 
     Scratch (persists across the innermost k-block grid dim):
@@ -97,8 +97,6 @@ def _fa_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk, nk,
         if causal:
             q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if valid_k % bk:  # padded key columns must never win the softmax
-            s = jnp.where(k_pos < valid_k, s, NEG_INF)
         m_prev = m_s[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # Rows still fully masked have m_new == NEG_INF; exp(s - m_new) would
@@ -134,33 +132,27 @@ def _pad_axis(x, axis, mult):
     return jnp.pad(x, widths), n
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "block_q", "block_k", "interpret"))
-def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
-             interpret):
-    """q [BH, Tq, D], k/v [BH, Tk, D], optional additive score bias
-    [BH, Tk] → (o [BH, Tq, D], m, l [BH, Tq])."""
-    BH, Tq0, D = q.shape
-    q, Tq0 = _pad_axis(q, 1, block_q)
-    k, Tk0 = _pad_axis(k, 1, block_k)
-    v, _ = _pad_axis(v, 1, block_k)
-    Tq, Tk = q.shape[1], k.shape[1]
+def _fa_pallas(q, k, v, bias3, *, causal, scale, block_q, block_k,
+               interpret):
+    """Forward pallas_call on PADDED folded shapes. q [BH, Tq, D],
+    k/v [BH, Tk, D], bias3 None or [BH, 1, Tk]; Tq/Tk block multiples."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
     nq, nk = Tq // block_q, Tk // block_k
     kern = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                             bq=block_q, bk=block_k, nk=nk, valid_k=Tk0,
-                             has_bias=bias is not None)
+                             bq=block_q, bk=block_k, nk=nk,
+                             has_bias=bias3 is not None)
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
     ]
     operands = [q, k, v]
-    if bias is not None:
-        bias, _ = _pad_axis(bias, 1, block_k)  # pad 0: valid_k masks the rest
-        operands.append(bias[:, None, :])
+    if bias3 is not None:
+        operands.append(bias3)
         in_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)))
-    o, m, l = pl.pallas_call(
+    return pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
         in_specs=in_specs,
@@ -181,12 +173,134 @@ def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
         ],
         interpret=interpret,
     )(*operands)
+
+
+def _pad_bias3(bias, BH, Tk0, Tk):
+    """Build the [BH, 1, Tk] additive-bias operand, or None when there is
+    neither a mask nor key padding. Padded key columns get NEG_INF here —
+    as DATA, not a kernel constant, so the kernels never capture a
+    sequence-length scalar (interpret-mode custom_partitioning
+    closure-converts captured constants into tracers)."""
+    if bias is None:
+        if Tk == Tk0:
+            return None
+        bias = jnp.zeros((BH, Tk0), jnp.float32)
+    pad = Tk - bias.shape[1]
+    if pad:
+        bias = jnp.pad(bias, ((0, 0), (0, pad)), constant_values=NEG_INF)
+    return bias[:, None, :]
+
+
+def _partition_enabled() -> bool:
+    """Whether to wrap the pallas calls in ``custom_partitioning`` so they
+    compose with GSPMD/jit sharding (batch*head dim partitioned, sequence
+    and depth replicated). On by default on TPU; CPU meshes opt in via
+    ``HOROVOD_FLASH_PARTITION=1`` (tests use this with interpret mode)."""
+    import os
+    env = os.environ.get("HOROVOD_FLASH_PARTITION")
+    if env is not None:
+        return env not in ("0", "false", "False", "")
+    return jax.default_backend() == "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_wrapper(kind: str, has_bias: bool):
+    """Build the ``custom_partitioning`` wrapper for ``kind`` in
+    {"fwd", "bwd"}: dim 0 (batch*head) is partitioned, sequence/depth are
+    replicated. Static config travels as ``static_argnums`` —
+    custom_partitioning closure-converts, so closed-over ints feeding jax
+    ops would come back as tracers. One wrapper per ``has_bias`` so the
+    no-mask path never materialises a zeros bias.
+
+    The Shardy rule declares need-replication factors; the ``partition``
+    callback additionally FORCES dim-1/2 replication on its returned
+    shardings so the legacy (non-Shardy) GSPMD path reshards rather than
+    running the kernel on silently-wrong local sequence blocks."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n_arrays = (3 if kind == "fwd" else 7) + int(has_bias)
+    bias_term = ", b s k" if has_bias else ""
+    if kind == "fwd":
+        rule = f"b q d, b k d, b k d{bias_term} -> b q d, b q s, b q s"
+    else:
+        rule = (f"b q d, b k d, b k d, b q d, b q s, b q s, b q s"
+                f"{bias_term} -> b q d, b k d, b k d")
+
+    def run(arrays, causal, scale, block_q, block_k, interpret):
+        bias3 = arrays[-1] if has_bias else None
+        core = arrays[:n_arrays - 1] if has_bias else arrays
+        if kind == "fwd":
+            return _fa_pallas(*core, bias3, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+        return _fa_bwd_pallas(*core, bias3, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret)
+
+    def impl(*args):
+        return run(args[:n_arrays], *args[n_arrays:])
+
+    wrapped = custom_partitioning(
+        impl, static_argnums=tuple(range(n_arrays, n_arrays + 5)))
+
+    def _dim0(sharding):
+        spec = sharding.spec
+        return spec[0] if len(spec) else None
+
+    def partition(causal, scale, block_q, block_k, interpret,
+                  mesh, arg_shapes, result_shape):
+        arg_shardings = tuple(
+            NamedSharding(mesh, PartitionSpec(_dim0(a.sharding), None, None))
+            for a in arg_shapes)
+        out_shardings = tuple(
+            NamedSharding(mesh, PartitionSpec(_dim0(r.sharding), None, None))
+            for r in result_shape)
+
+        def lower(*arrays):
+            return run(arrays, causal, scale, block_q, block_k, interpret)
+
+        return mesh, lower, out_shardings, arg_shardings
+
+    def infer(causal, scale, block_q, block_k, interpret,
+              mesh, arg_shapes, shape):
+        sh = NamedSharding(
+            mesh, PartitionSpec(_dim0(arg_shapes[0].sharding), None, None))
+        return (sh, sh, sh)
+
+    wrapped.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=rule,
+        need_replication_factors=("q", "d", "k", "s"))
+    return wrapped
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
+             interpret):
+    """q [BH, Tq, D], k/v [BH, Tk, D], optional additive score bias
+    [BH, Tk] → (o [BH, Tq, D], m, l [BH, Tq])."""
+    BH, Tq0, D = q.shape
+    q, Tq0 = _pad_axis(q, 1, block_q)
+    k, Tk0 = _pad_axis(k, 1, block_k)
+    v, _ = _pad_axis(v, 1, block_k)
+    Tq, Tk = q.shape[1], k.shape[1]
+    bias3 = _pad_bias3(bias, BH, Tk0, Tk)
+    if _partition_enabled():
+        w = _sharded_wrapper("fwd", bias3 is not None)
+        args = (q, k, v) + ((bias3,) if bias3 is not None else ())
+        o, m, l = w(*args, causal, scale, block_q, block_k, interpret)
+    else:
+        o, m, l = _fa_pallas(q, k, v, bias3, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
     return o[:, :Tq0], m[:, :Tq0, 0], l[:, :Tq0, 0]
 
 
-
 def _recompute_p_ds(q, k, v, do, m, l, dsum, bias_tile, *, scale, causal,
-                    bq, bk, iq, ik, valid_k):
+                    bq, bk, iq, ik):
     """Shared backward-tile recompute: probability tile ``p`` and score
     cotangent ``ds`` for one (q-block, k-block) pair, from the saved softmax
     stats. Masking must mirror ``_fa_kernel`` exactly."""
@@ -198,8 +312,6 @@ def _recompute_p_ds(q, k, v, do, m, l, dsum, bias_tile, *, scale, causal,
     if causal:
         q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    if valid_k % bk:
-        s = jnp.where(k_pos < valid_k, s, NEG_INF)
     l = jnp.where(l == 0.0, 1.0, l)
     p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m)) / l
     dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -209,7 +321,7 @@ def _recompute_p_ds(q, k, v, do, m, l, dsum, bias_tile, *, scale, causal,
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
-                      *refs, scale, causal, bq, bk, nk, valid_k, has_bias):
+                      *refs, scale, causal, bq, bk, nk, has_bias):
     """dQ pass: grid (BH, q-block, k-block), k innermost; recomputes the
     probability tile from the saved (m, l) softmax stats (FlashAttention-2
     backward), folds dS·K into a per-q-block accumulator."""
@@ -232,7 +344,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         _, ds = _recompute_p_ds(
             q_ref[0], k, v_ref[0], do_ref[0], m_ref[0], l_ref[0], d_ref[0],
             bias_ref[0] if has_bias else None, scale=scale, causal=causal,
-            bq=bq, bk=bk, iq=iq, ik=ik, valid_k=valid_k)
+            bq=bq, bk=bk, iq=iq, ik=ik)
         acc[:] = acc[:] + lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -243,7 +355,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
-                       *refs, scale, causal, bq, bk, nq, valid_k, has_bias):
+                       *refs, scale, causal, bq, bk, nq, has_bias):
     """dK/dV pass: grid (BH, k-block, q-block), q innermost. Padded q rows
     contribute nothing because their dO (and rowsum term) are zero-padded."""
     if has_bias:
@@ -267,7 +379,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
         p, ds = _recompute_p_ds(
             q, k_ref[0], v_ref[0], do, m_ref[0], l_ref[0], d_ref[0],
             bias_ref[0] if has_bias else None, scale=scale, causal=causal,
-            bq=bq, bk=bk, iq=iqb, ik=ikb, valid_k=valid_k)
+            bq=bq, bk=bk, iq=iqb, ik=ikb)
         dv_acc[:] = dv_acc[:] + lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -283,20 +395,11 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, d_ref,
 
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "block_q", "block_k", "interpret"))
-def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
-                 block_q, block_k, interpret):
-    """Folded-[BH] backward. Returns (dq, dk, dv) in the input dtypes."""
-    BH, Tq0, D = q.shape
-    dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                   keepdims=True)  # [BH, Tq, 1] — the FA2 rowsum(dO*O) term
-    q, _ = _pad_axis(q, 1, block_q)
-    do, _ = _pad_axis(do, 1, block_q)
-    dsum, _ = _pad_axis(dsum, 1, block_q)
-    m3, _ = _pad_axis(m[..., None].astype(jnp.float32), 1, block_q)
-    l3, _ = _pad_axis(l[..., None].astype(jnp.float32), 1, block_q)
-    k, Tk0 = _pad_axis(k, 1, block_k)
-    v, _ = _pad_axis(v, 1, block_k)
-    Tq, Tk = q.shape[1], k.shape[1]
+def _fa_bwd_pallas(q, k, v, do, m3, l3, dsum, bias3, *, causal, scale,
+                   block_q, block_k, interpret):
+    """Backward pallas_calls on PADDED folded shapes → (dq, dk, dv)."""
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
     nq, nk = Tq // block_q, Tk // block_k
 
     base_specs = [
@@ -309,16 +412,15 @@ def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),   # dsum
     ]
     operands = [q, k, v, do, m3, l3, dsum]
-    if bias is not None:
-        bias, _ = _pad_axis(bias, 1, block_k)
-        operands.append(bias[:, None, :])
+    if bias3 is not None:
+        operands.append(bias3)
         base_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)))
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=block_q, bk=block_k, nk=nk, valid_k=Tk0,
-                          has_bias=bias is not None),
+                          bq=block_q, bk=block_k, nk=nk,
+                          has_bias=bias3 is not None),
         grid=(BH, nq, nk),
         in_specs=base_specs,
         out_specs=[pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))],
@@ -338,13 +440,13 @@ def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),   # l
         pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),   # dsum
     ]
-    if bias is not None:
+    if bias3 is not None:
         kv_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, i)))
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=block_q, bk=block_k, nq=nq, valid_k=Tk0,
-                          has_bias=bias is not None),
+                          bq=block_q, bk=block_k, nq=nq,
+                          has_bias=bias3 is not None),
         grid=(BH, nk, nq),
         in_specs=kv_specs,
         out_specs=[
@@ -361,6 +463,76 @@ def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
         ],
         interpret=interpret,
     )(*operands)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_fa_bwd():
+    """custom_partitioning for the backward pair — same rule as the forward:
+    batch*head passthrough, everything else need-replication."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+
+    def bwd_impl(q, k, v, do, m3, l3, dsum, bias3, causal, scale, block_q,
+                 block_k, interpret):
+        return _fa_bwd_pallas(q, k, v, do, m3, l3, dsum, bias3,
+                              causal=causal, scale=scale, block_q=block_q,
+                              block_k=block_k, interpret=interpret)
+
+    bwd = custom_partitioning(bwd_impl,
+                              static_argnums=(8, 9, 10, 11, 12))
+
+    def partition(causal, scale, block_q, block_k, interpret,
+                  mesh, arg_shapes, result_shape):
+        arg_shardings = jax.tree_util.tree_map(lambda s: s.sharding,
+                                               arg_shapes)
+        out_shardings = jax.tree_util.tree_map(lambda s: s.sharding,
+                                               result_shape)
+        impl = functools.partial(_fa_bwd_pallas, causal=causal, scale=scale,
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+        return mesh, impl, out_shardings, arg_shardings
+
+    def infer(causal, scale, block_q, block_k, interpret,
+              mesh, arg_shapes, shape):
+        from jax.sharding import NamedSharding, PartitionSpec
+        b = arg_shapes[0].sharding.spec[0]
+        sh = NamedSharding(mesh, PartitionSpec(b, None, None))
+        return (sh, sh, sh)
+
+    bwd.def_partition(
+        partition=partition,
+        infer_sharding_from_operands=infer,
+        sharding_rule=("b q d, b k d, b k d, b q d, b q s, b q s, b q s, "
+                       "b s k -> b q d, b k d, b k d"),
+        need_replication_factors=("q", "d", "k", "s"))
+    return bwd
+
+
+def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
+                 block_q, block_k, interpret):
+    """Folded-[BH] backward. Returns (dq, dk, dv) in the input dtypes."""
+    BH, Tq0, D = q.shape
+    dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                   keepdims=True)  # [BH, Tq, 1] — the FA2 rowsum(dO*O) term
+    q, _ = _pad_axis(q, 1, block_q)
+    do, _ = _pad_axis(do, 1, block_q)
+    dsum, _ = _pad_axis(dsum, 1, block_q)
+    m3, _ = _pad_axis(m[..., None].astype(jnp.float32), 1, block_q)
+    l3, _ = _pad_axis(l[..., None].astype(jnp.float32), 1, block_q)
+    k, Tk0 = _pad_axis(k, 1, block_k)
+    v, _ = _pad_axis(v, 1, block_k)
+    Tq, Tk = q.shape[1], k.shape[1]
+    bias3 = _pad_bias3(bias, BH, Tk0, Tk)
+    if _partition_enabled():
+        w = _sharded_wrapper("bwd", bias3 is not None)
+        args = (q, k, v, do, m3, l3, dsum) + (
+            (bias3,) if bias3 is not None else ())
+        dq, dk, dv = w(*args, causal, scale, block_q, block_k, interpret)
+    else:
+        dq, dk, dv = _fa_bwd_pallas(q, k, v, do, m3, l3, dsum, bias3,
+                                    causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret)
     return dq[:, :Tq0], dk[:, :Tk0], dv[:, :Tk0]
 
 
