@@ -277,9 +277,9 @@ def _sharded_wrapper(kind: str, has_bias: bool):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "block_q", "block_k", "interpret"))
+    "causal", "scale", "block_q", "block_k", "interpret", "partition"))
 def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
-             interpret):
+             interpret, partition):
     """q [BH, Tq, D], k/v [BH, Tk, D], optional additive score bias
     [BH, Tk] → (o [BH, Tq, D], m, l [BH, Tq])."""
     BH, Tq0, D = q.shape
@@ -288,7 +288,7 @@ def _fa_call(q, k, v, bias=None, *, causal, scale, block_q, block_k,
     v, _ = _pad_axis(v, 1, block_k)
     Tq, Tk = q.shape[1], k.shape[1]
     bias3 = _pad_bias3(bias, BH, Tk0, Tk)
-    if _partition_enabled():
+    if partition:
         w = _sharded_wrapper("fwd", bias3 is not None)
         args = (q, k, v) + ((bias3,) if bias3 is not None else ())
         o, m, l = w(*args, causal, scale, block_q, block_k, interpret)
@@ -466,50 +466,8 @@ def _fa_bwd_pallas(q, k, v, do, m3, l3, dsum, bias3, *, causal, scale,
     return dq, dk, dv
 
 
-@functools.lru_cache(maxsize=None)
-def _sharded_fa_bwd():
-    """custom_partitioning for the backward pair — same rule as the forward:
-    batch*head passthrough, everything else need-replication."""
-    from jax.experimental.custom_partitioning import custom_partitioning
-
-    def bwd_impl(q, k, v, do, m3, l3, dsum, bias3, causal, scale, block_q,
-                 block_k, interpret):
-        return _fa_bwd_pallas(q, k, v, do, m3, l3, dsum, bias3,
-                              causal=causal, scale=scale, block_q=block_q,
-                              block_k=block_k, interpret=interpret)
-
-    bwd = custom_partitioning(bwd_impl,
-                              static_argnums=(8, 9, 10, 11, 12))
-
-    def partition(causal, scale, block_q, block_k, interpret,
-                  mesh, arg_shapes, result_shape):
-        arg_shardings = jax.tree_util.tree_map(lambda s: s.sharding,
-                                               arg_shapes)
-        out_shardings = jax.tree_util.tree_map(lambda s: s.sharding,
-                                               result_shape)
-        impl = functools.partial(_fa_bwd_pallas, causal=causal, scale=scale,
-                                 block_q=block_q, block_k=block_k,
-                                 interpret=interpret)
-        return mesh, impl, out_shardings, arg_shardings
-
-    def infer(causal, scale, block_q, block_k, interpret,
-              mesh, arg_shapes, shape):
-        from jax.sharding import NamedSharding, PartitionSpec
-        b = arg_shapes[0].sharding.spec[0]
-        sh = NamedSharding(mesh, PartitionSpec(b, None, None))
-        return (sh, sh, sh)
-
-    bwd.def_partition(
-        partition=partition,
-        infer_sharding_from_operands=infer,
-        sharding_rule=("b q d, b k d, b k d, b q d, b q s, b q s, b q s, "
-                       "b s k -> b q d, b k d, b k d"),
-        need_replication_factors=("q", "d", "k", "s"))
-    return bwd
-
-
 def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
-                 block_q, block_k, interpret):
+                 block_q, block_k, interpret, partition):
     """Folded-[BH] backward. Returns (dq, dk, dv) in the input dtypes."""
     BH, Tq0, D = q.shape
     dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
@@ -523,7 +481,7 @@ def _fa_bwd_call(q, k, v, do, o, m, l, bias=None, *, causal, scale,
     v, _ = _pad_axis(v, 1, block_k)
     Tq, Tk = q.shape[1], k.shape[1]
     bias3 = _pad_bias3(bias, BH, Tk0, Tk)
-    if _partition_enabled():
+    if partition:
         w = _sharded_wrapper("bwd", bias3 is not None)
         args = (q, k, v, do, m3, l3, dsum) + (
             (bias3,) if bias3 is not None else ())
@@ -577,7 +535,8 @@ def _fa_fwd_impl(q, k, v, bias, causal, scale, block_q, block_k):
     o, m, l = _fa_call(_fold(q, B, H, D), _fold(k, B, H, D),
                        _fold(v, B, H, D), fbias, causal=causal,
                        scale=scale, block_q=block_q, block_k=block_k,
-                       interpret=interpret)
+                       interpret=interpret,
+                       partition=_partition_enabled())
     o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
     return o, m.reshape(B, H, Tq), l.reshape(B, H, Tq)
 
@@ -636,7 +595,7 @@ def _fa_bwd_nores(causal, scale, block_q, block_k, res, do):
         _fold(q, B, H, D), _fold(k, B, H, D), _fold(v, B, H, D),
         _fold(do, B, H, D), _fold(o, B, H, D), fm, fl, fbias,
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=_use_interpret())
+        interpret=_use_interpret(), partition=_partition_enabled())
     unfold = lambda x, T: x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
     dbias = None if bias is None else jnp.zeros_like(bias)
     return unfold(dq, Tq), unfold(dk, Tk), unfold(dv, Tk), dbias

@@ -1,0 +1,73 @@
+"""End-to-end launcher integration: real ``hvdrun`` subprocesses on
+localhost (the reference's test/integration/test_static_run.py pattern —
+slots on 127.0.0.1 stand in for hosts; no ssh because the host is local)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json
+import os
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+hvd.init()
+print(json.dumps({
+    "size": hvd.size(), "rank": hvd.rank(),
+    "env_pid": os.environ.get("HOROVOD_PROCESS_ID"),
+    "env_first_rank": os.environ.get("HOROVOD_FIRST_RANK"),
+    "env_size": os.environ.get("HOROVOD_SIZE"),
+}))
+"""
+
+
+def _run_hvdrun(args, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.integration
+def test_hvdrun_single_host_end_to_end(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    r = _run_hvdrun(["-np", "1", "-H", "localhost:1",
+                     sys.executable, str(script)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout.strip().splitlines()[-1])
+    assert payload["env_pid"] == "0" and payload["env_size"] == "1"
+    assert payload["env_first_rank"] == "0"
+    assert payload["size"] >= 1
+
+
+@pytest.mark.integration
+def test_hvdrun_propagates_worker_failure(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("raise SystemExit(3)\n")
+    r = _run_hvdrun(["-np", "1", "-H", "localhost:1",
+                     sys.executable, str(script)])
+    assert r.returncode != 0
+
+
+@pytest.mark.integration
+def test_hvdrun_output_filename_redirects(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text("print('hello-from-rank')\n")
+    out = tmp_path / "logs"
+    r = _run_hvdrun(["-np", "1", "-H", "localhost:1",
+                     "--output-filename", str(out),
+                     sys.executable, str(script)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    logs = list(out.rglob("*")) if out.exists() else []
+    assert any("hello-from-rank" in f.read_text()
+               for f in logs if f.is_file()), (logs, r.stdout)
