@@ -60,7 +60,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.hvd_pipeline_create.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
             ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int,
-            ctypes.c_int, ctypes.c_uint, ctypes.c_int, ctypes.c_int]
+            ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int]
         lib.hvd_pipeline_next.restype = ctypes.c_longlong
         lib.hvd_pipeline_next.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(ctypes.c_uint8)]
@@ -108,6 +108,26 @@ class NativeTimeline:
         if self._h:
             self._lib.hvd_timeline_close(self._h)
             self._h = None
+
+
+def _splitmix64_shuffle(items, seed: int) -> None:
+    """Fisher-Yates with a SplitMix64 stream — bit-for-bit the shuffle in
+    native/src/hvd_runtime.cc, so native and fallback pipelines yield the
+    SAME batches for the same seed (the documented contract)."""
+    mask = (1 << 64) - 1
+    state = seed & mask
+
+    def next_u64():
+        nonlocal state
+        state = (state + 0x9E3779B97F4A7C15) & mask
+        z = state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+        return z ^ (z >> 31)
+
+    for i in range(len(items) - 1, 0, -1):
+        j = next_u64() % (i + 1)
+        items[i], items[j] = items[j], items[i]
 
 
 class RecordPipeline:
@@ -169,10 +189,7 @@ class RecordPipeline:
                 raise OSError(f"{p} size not a multiple of record_bytes")
             index.extend((p, i) for i in range(sz // self.record_bytes))
         if self.shuffle:
-            # Match the C++ std::mt19937/std::shuffle? Different PRNGs —
-            # documented: the two paths agree on the SET of records per
-            # epoch, not the permutation.
-            np.random.RandomState(self.seed).shuffle(index)
+            _splitmix64_shuffle(index, self.seed)
         files = {p: open(p, "rb") for p in self.paths}
         try:
             n_full = len(index) // self.batch_size

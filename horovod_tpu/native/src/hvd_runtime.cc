@@ -27,6 +27,7 @@
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <functional>
 #include <mutex>
 #include <queue>
@@ -186,8 +187,8 @@ class RecordPipeline {
  public:
   RecordPipeline(const std::vector<std::string>& paths,
                  long long record_bytes, long long batch_records,
-                 int n_threads, int capacity, unsigned seed, bool shuffle,
-                 bool drop_remainder)
+                 int n_threads, int capacity, unsigned long long seed,
+                 bool shuffle, bool drop_remainder)
       : record_bytes_(record_bytes), batch_records_(batch_records),
         capacity_(capacity < 1 ? 1 : capacity), done_producing_(false),
         error_(false), shutdown_(false), pool_(n_threads) {
@@ -210,8 +211,22 @@ class RecordPipeline {
       files_.push_back(p);
     }
     if (shuffle) {
-      std::mt19937 rng(seed);
-      std::shuffle(index_.begin(), index_.end(), rng);
+      // Deterministic SplitMix64 Fisher-Yates, mirrored bit-for-bit by the
+      // Python fallback (native/__init__.py): same seed => same batches on
+      // both paths. std::shuffle's algorithm is implementation-defined, so
+      // it cannot honor that contract across toolchains.
+      unsigned long long state = seed;
+      auto next_u64 = [&state]() {
+        state += 0x9E3779B97F4A7C15ULL;
+        unsigned long long z = state;
+        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+        z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+        return z ^ (z >> 31);
+      };
+      for (long long i = (long long)index_.size() - 1; i > 0; --i) {
+        long long j = (long long)(next_u64() % (unsigned long long)(i + 1));
+        std::swap(index_[i], index_[j]);
+      }
     }
     // Partition the index into batches; reader tasks claim batch slots in
     // order but produce concurrently; a bounded queue applies backpressure.
@@ -242,13 +257,15 @@ class RecordPipeline {
   long long Next(uint8_t* dst) {
     std::unique_lock<std::mutex> lk(mu_);
     cv_out_.wait(lk, [this] {
-      return error_ || !ready_.empty() ||
+      return error_ || ready_.count(next_emit_) ||
              (done_producing_ && ready_.empty());
     });
     if (error_) return -1;
-    if (ready_.empty()) return 0;     // done
-    Batch b = std::move(ready_.front());
-    ready_.pop_front();
+    auto it = ready_.find(next_emit_);
+    if (it == ready_.end()) return 0;  // done
+    Batch b = std::move(it->second);
+    ready_.erase(it);
+    ++next_emit_;
     lk.unlock();
     cv_in_.notify_all();
     std::memcpy(dst, b.data.data(), b.data.size());
@@ -284,14 +301,19 @@ class RecordPipeline {
         cv_out_.notify_all();
         break;
       }
-      cv_in_.wait(lk, [this] {
-        return error_ || shutdown_ ||
+      // Emit in batch-index order (same-seed determinism contract): each
+      // producer parks its batch under its index; the consumer drains
+      // next_emit_ in sequence. The bi == next_emit_ escape keeps the
+      // needed batch insertable when the buffer is full of later ones
+      // (no deadlock: the lowest outstanding index can always land).
+      cv_in_.wait(lk, [this, bi] {
+        return error_ || shutdown_ || bi == next_emit_ ||
                (long long)ready_.size() < capacity_;
       });
       if (error_ || shutdown_) break;
-      ready_.push_back(std::move(b));
+      ready_.emplace(bi, std::move(b));
       lk.unlock();
-      cv_out_.notify_one();
+      cv_out_.notify_all();
     }
     if (producers_live_.fetch_sub(1) == 1) {
       std::lock_guard<std::mutex> lk(mu_);
@@ -331,7 +353,8 @@ class RecordPipeline {
   std::atomic<int> producers_live_;
   std::mutex mu_;
   std::condition_variable cv_in_, cv_out_;
-  std::deque<Batch> ready_;
+  std::map<long long, Batch> ready_;
+  long long next_emit_ = 0;
   bool done_producing_;
   bool error_;
   bool shutdown_;
@@ -388,7 +411,8 @@ void hvd_timeline_close(void* t) {
 
 void* hvd_pipeline_create(const char** paths, int n_paths,
                           long long record_bytes, long long batch_records,
-                          int n_threads, int capacity, unsigned seed,
+                          int n_threads, int capacity,
+                          unsigned long long seed,
                           int shuffle, int drop_remainder) {
   std::vector<std::string> ps;
   for (int i = 0; i < n_paths; ++i) ps.emplace_back(paths[i]);
