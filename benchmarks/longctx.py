@@ -1,0 +1,84 @@
+"""Long-context training throughput (capability-NEW vs the reference).
+
+The reference's longest-context config is BERT-Large@512 (SURVEY.md §5.7 —
+it has no sequence-length scaling story). This measures what the TPU build
+adds: a decoder LM training step at 4k context through the Pallas flash
+attention path (blockwise fwd+bwd, nothing materialises the [T, T] score
+matrix), with the materialised-softmax path as the in-run A/B. Multi-chip,
+sequence parallelism continues the curve via parallel/ring.py (ring
+attention over the ICI ring; tested on the virtual mesh in
+tests/test_parallel.py).
+
+Metric: tokens/sec/chip at seq 4096; vs_baseline = flash / materialised.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from common import emit, on_tpu, slope_time, sync
+
+
+def main():
+    import dataclasses
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.llama import Llama, LlamaConfig
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import (create_train_state, make_train_step,
+                                   next_token_loss)
+
+    hvd.init()
+    n = hvd.size()
+    tpu = on_tpu()
+    seq = 4096 if tpu else 64
+    cfg = LlamaConfig(vocab_size=32000 if tpu else 256,
+                      dim=1024 if tpu else 64,
+                      n_layers=8 if tpu else 2,
+                      n_heads=16 if tpu else 4,
+                      n_kv_heads=8 if tpu else 2,
+                      hidden_dim=2816 if tpu else 128, max_seq_len=seq,
+                      dtype=jnp.bfloat16 if tpu else jnp.float32,
+                      remat=tpu, scan_layers=tpu)
+    per_chip = 1
+    batch = per_chip * n
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = tokens  # next-token loss below shifts internally
+
+    loss_fn = next_token_loss  # the shared shifted-xent objective
+
+    results = {}
+    for name, flash in (("flash", True), ("materialised", False)):
+        model = Llama(dataclasses.replace(cfg, use_flash=flash))
+        dopt = distributed(optax.adamw(1e-4))
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   tokens[:1], dopt)
+        s_short, s_long = (2, 8) if tpu else (1, 3)
+        steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
+                                    donate=False)
+                 for k in (s_short, s_long)}
+
+        def run(k):
+            _, loss = steps[k](state, tokens, labels)
+            sync(loss)
+
+        sec = slope_time(run, s_short, s_long,
+                         repeats=5 if tpu else 2)
+        results[name] = batch * seq / sec
+
+    emit("longctx_llama_tokens_per_sec_per_chip",
+         round(results["flash"] / n, 3),
+         f"tokens/sec/chip ({cfg.dim}d x {cfg.n_layers}L, seq {seq}, "
+         f"flash attention, {n} devices)",
+         vs_baseline=round(results["flash"] / results["materialised"], 4))
+
+
+if __name__ == "__main__":
+    main()

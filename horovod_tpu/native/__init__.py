@@ -41,7 +41,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         lib = ctypes.CDLL(path)
         lib.hvd_runtime_abi_version.restype = ctypes.c_int
-        if lib.hvd_runtime_abi_version() != 1:
+        if lib.hvd_runtime_abi_version() != 2:
             return None
         # signatures
         lib.hvd_pool_create.restype = ctypes.c_void_p

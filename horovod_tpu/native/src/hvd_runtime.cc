@@ -299,6 +299,7 @@ class RecordPipeline {
         err_ = "read failed in " + files_[index_[(size_t)lo].first];
         lk.unlock();
         cv_out_.notify_all();
+        cv_in_.notify_all();  // wake producers parked on queue space
         break;
       }
       // Emit in batch-index order (same-seed determinism contract): each
@@ -369,7 +370,8 @@ class RecordPipeline {
 
 extern "C" {
 
-int hvd_runtime_abi_version() { return 1; }
+// v2: hvd_pipeline_create seed widened to unsigned long long.
+int hvd_runtime_abi_version() { return 2; }
 
 // -- thread pool (exposed for tests; the pipeline uses it internally) -------
 
