@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
 
-from .llama import _part
+from .llama import _part, _remat
 from ._flash import resolve_flash as _resolve_flash
 
 
@@ -33,6 +33,7 @@ class BertConfig:
     norm_eps: float = 1e-12
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    remat_policy: str = "dots"  # see models/llama.py LlamaConfig
     # None = auto: Pallas flash attention on TPU, materialised softmax
     # elsewhere (interpret-mode Pallas is too slow for CPU test meshes).
     use_flash: "bool | None" = None
@@ -119,7 +120,7 @@ class Bert(nn.Module):
                          name="embed_norm")(x.astype(c.dtype))
         x = nn_partitioning.with_sharding_constraint(
             x, ("batch", "seq", "embed"))
-        block = nn.remat(EncoderBlock, prevent_cse=False) if c.remat \
+        block = _remat(EncoderBlock, c.remat_policy) if c.remat \
             else EncoderBlock
         for i in range(c.n_layers):
             x = block(c, name=f"layer_{i}")(x, attn_mask)

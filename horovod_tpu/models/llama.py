@@ -59,6 +59,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise (the standard TPU
+    # trade — elementwise recompute is HBM-cheap, matmuls are not).
+    # "full": save nothing inside the block.
+    remat_policy: str = "dots"
     scan_layers: bool = True
     tie_embeddings: bool = False
     # None = auto: Pallas flash attention on TPU, materialised softmax
@@ -75,6 +79,20 @@ def llama_tiny(vocab: int = 256) -> LlamaConfig:
     return LlamaConfig(vocab_size=vocab, dim=64, n_layers=2, n_heads=4,
                        n_kv_heads=2, hidden_dim=128, max_seq_len=128,
                        dtype=jnp.float32, remat=False, scan_layers=False)
+
+
+_REMAT_POLICIES = {
+    "full": None,
+    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def _remat(cls, policy_name: str):
+    if policy_name not in _REMAT_POLICIES:
+        raise ValueError(f"remat_policy {policy_name!r} not in "
+                         f"{sorted(_REMAT_POLICIES)}")
+    return nn.remat(cls, prevent_cse=False,
+                    policy=_REMAT_POLICIES[policy_name])
 
 
 def _part(init, names):
@@ -212,7 +230,7 @@ def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
     if c.scan_layers:
         scanned = scanned_cls
         if c.remat:
-            scanned = nn.remat(scanned_cls, prevent_cse=False)
+            scanned = _remat(scanned_cls, c.remat_policy)
         variable_axes = {"params": 0}
         for coll in extra_scan_collections:
             variable_axes[coll] = 0
@@ -225,7 +243,7 @@ def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(c, name="layers")(x, positions)
     else:
-        block = nn.remat(block_cls, prevent_cse=False) if c.remat \
+        block = _remat(block_cls, c.remat_policy) if c.remat \
             else block_cls
         for i in range(c.n_layers):
             x = block(c, name=f"block_{i}")(x, positions)
