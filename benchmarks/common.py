@@ -49,18 +49,35 @@ def slope_time(run, s_short: int = S_SHORT, s_long: int = S_LONG,
     estimated as min-over-repeats before the slope is taken (a min of
     per-pair slopes would bias low — slope noise is two-sided).
     """
-    run(s_short)  # warm both compiles
-    run(s_long)
+    return slope_time_paired({"_": run}, s_short, s_long,
+                             rounds=repeats)["_"]
 
-    def best(k):
-        times = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            run(k)
-            times.append(time.perf_counter() - t0)
-        return min(times)
 
-    return max(best(s_long) - best(s_short), 1e-9) / (s_long - s_short)
+def slope_time_paired(runs: dict, s_short: int = S_SHORT,
+                      s_long: int = S_LONG, rounds: int = 7) -> dict:
+    """``slope_time`` for several configs at once, interleaved.
+
+    Measuring config A's repeats and then config B's lets slow drift in the
+    tunnel/device (other tenants, thermals) land entirely on one side and
+    skew the A/B ratio. Here every round samples each (config, scan-length)
+    once, in round-robin order, so drift is shared; the min over rounds per
+    cell then cancels spike noise as in ``slope_time``. Returns
+    ``{name: seconds-per-unit}``.
+    """
+    for fn in runs.values():  # warm all compiles before any timing
+        fn(s_short)
+        fn(s_long)
+    best: dict = {(name, k): float("inf")
+                  for name in runs for k in (s_short, s_long)}
+    for _ in range(rounds):
+        for name, fn in runs.items():
+            for k in (s_short, s_long):
+                t0 = time.perf_counter()
+                fn(k)
+                dt = time.perf_counter() - t0
+                best[(name, k)] = min(best[(name, k)], dt)
+    return {name: max(best[(name, s_long)] - best[(name, s_short)], 1e-9)
+            / (s_long - s_short) for name in runs}
 
 
 def emit(metric: str, value: float, unit: str,

@@ -1,0 +1,98 @@
+"""Torch-API synthetic benchmark (reference
+``examples/pytorch/pytorch_synthetic_benchmark.py`` parity).
+
+A reference training script ported with the one-line import change
+(``import horovod.torch as hvd`` → ``from horovod_tpu import torch as
+hvd``): init → pin to rank → broadcast params + optimizer state →
+``hvd.DistributedOptimizer`` with fp16 compression → train loop. Torch
+tensors live on host CPU in this build (see ``horovod_tpu/torch/``); the
+TPU compute path is the JAX API (``examples/train_resnet.py``).
+
+Run:
+    python examples/torch_synthetic.py --steps 20
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run in-repo without pip install
+
+from horovod_tpu import torch as hvd
+
+
+def _small_convnet(num_classes):
+    return torch.nn.Sequential(
+        torch.nn.Conv2d(3, 32, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Conv2d(32, 64, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(64, num_classes))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="batch size PER RANK (reference convention)")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--backward-passes-per-step", type=int, default=1)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(hvd.rank())  # differ pre-broadcast on purpose
+
+    model = _small_convnet(args.num_classes)
+    optimizer = torch.optim.SGD(model.parameters(), lr=args.lr * hvd.size(),
+                                momentum=0.9)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        backward_passes_per_step=args.backward_passes_per_step)
+
+    rng = np.random.RandomState(hvd.rank())
+    data = torch.as_tensor(rng.randn(
+        args.batch_size, 3, args.image_size, args.image_size)
+        .astype(np.float32))
+    target = torch.as_tensor(rng.randint(0, args.num_classes,
+                                         (args.batch_size,)))
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    def one_step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(data), target)
+        loss.backward()
+        optimizer.step()
+        return float(loss.detach())
+
+    if hvd.rank() == 0:
+        print(f"ranks={hvd.size()} batch/rank={args.batch_size}")
+    for _ in range(args.warmup):
+        loss = one_step()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = one_step()
+    dt = time.perf_counter() - t0
+    ips = args.batch_size * args.steps / dt
+    if hvd.rank() == 0:
+        print(f"loss={loss:.4f} images/sec/rank={ips:.1f} "
+              f"step_ms={dt / args.steps * 1e3:.2f}")
+
+
+if __name__ == "__main__":
+    main()

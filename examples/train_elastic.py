@@ -25,6 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run in-repo without pip install
+
 import horovod_tpu as hvd
 from horovod_tpu import elastic
 from horovod_tpu.models import ResNetTiny

@@ -18,6 +18,12 @@ CPU smoke test (8 virtual devices):
 import argparse
 import time
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run in-repo without pip install
+
 from horovod_tpu.platform import honor_jax_platforms_env
 honor_jax_platforms_env()
 

@@ -21,5 +21,7 @@ lazily and raise a clear error when absent.
 
 from .runner import run  # noqa: F401
 from .estimator import JaxEstimator, JaxModel  # noqa: F401
+from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
 
-__all__ = ["run", "JaxEstimator", "JaxModel"]
+__all__ = ["run", "JaxEstimator", "JaxModel", "TorchEstimator",
+           "TorchModel"]

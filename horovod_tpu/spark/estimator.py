@@ -59,6 +59,40 @@ def _materialize(data, feature_col: str, label_col: str
         f"DataFrame or an (X, y) tuple")
 
 
+def _transform_df(transformer, df):
+    """Shared Spark/pandas ``transform`` dispatch for fitted models:
+    appends ``transformer.output_col`` = ``transformer.predict(features)``."""
+    try:
+        from pyspark.sql import DataFrame as SparkDF
+        if isinstance(df, SparkDF):
+            feats = np.asarray(
+                [np.asarray(r[0])
+                 for r in df.select(transformer.feature_col).collect()])
+            preds = transformer.predict(feats)
+            spark = df.sparkSession
+            pdf = df.toPandas()
+            pdf[transformer.output_col] = list(np.asarray(preds))
+            return spark.createDataFrame(pdf)
+    except ImportError:
+        pass
+    feats = np.stack([np.asarray(v) for v in df[transformer.feature_col]])
+    out = df.copy()
+    out[transformer.output_col] = list(transformer.predict(feats))
+    return out
+
+
+def _validation_split(feats, labels, validation, rng):
+    """Hold out a ``validation`` fraction; returns (train_X, train_y, val)
+    where val is ``(X, y)`` or None."""
+    if not validation:
+        return feats, labels, None
+    n_val = max(1, int(len(feats) * validation))
+    idx = rng.permutation(len(feats))
+    val_idx, train_idx = idx[:n_val], idx[n_val:]
+    val = (feats[val_idx], labels[val_idx])
+    return feats[train_idx], labels[train_idx], val
+
+
 class JaxModel:
     """The fitted Transformer (reference: the estimator's Spark Model).
 
@@ -89,23 +123,7 @@ class JaxModel:
 
     def transform(self, df):
         """Spark/pandas DataFrame → same DataFrame + prediction column."""
-        try:
-            from pyspark.sql import DataFrame as SparkDF
-            if isinstance(df, SparkDF):
-                feats = np.asarray(
-                    [np.asarray(r[0])
-                     for r in df.select(self.feature_col).collect()])
-                preds = self.predict(feats)
-                spark = df.sparkSession
-                pdf = df.toPandas()
-                pdf[self.output_col] = list(np.asarray(preds))
-                return spark.createDataFrame(pdf)
-        except ImportError:
-            pass
-        feats = np.stack([np.asarray(v) for v in df[self.feature_col]])
-        out = df.copy()
-        out[self.output_col] = list(self.predict(feats))
-        return out
+        return _transform_df(self, df)
 
     # -- store round trip ---------------------------------------------------
 
@@ -182,14 +200,8 @@ class JaxEstimator:
 
         feats, labels = _materialize(data, self.feature_col, self.label_col)
         rng = np.random.RandomState(self.seed)
-        if self.validation:
-            n_val = max(1, int(len(feats) * self.validation))
-            idx = rng.permutation(len(feats))
-            val_idx, train_idx = idx[:n_val], idx[n_val:]
-            val = (feats[val_idx], labels[val_idx])
-            feats, labels = feats[train_idx], labels[train_idx]
-        else:
-            val = None
+        feats, labels, val = _validation_split(feats, labels,
+                                               self.validation, rng)
         if len(feats) < self.batch_size:
             raise ValueError(
                 f"need at least one global batch ({self.batch_size}) of "
