@@ -17,7 +17,12 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+# `python benchmarks/<x>.py` puts benchmarks/ (the script dir) on sys.path,
+# not the repo root — add it so `import horovod_tpu` resolves in-repo.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The session image pre-imports jax with the axon TPU plugin; an env var
 # alone doesn't switch backends (see .claude/skills/verify). Honor an

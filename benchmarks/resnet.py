@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from common import emit, on_tpu, slope_time, sync, S_SHORT, S_LONG
+from common import emit, on_tpu, slope_time_paired, sync, S_SHORT, S_LONG
 
 
 def main():
@@ -54,42 +54,30 @@ def main():
         _, loss = steps[k](state, images, labels)
         sync(loss)
 
-    ips = batch / slope_time(run)
-    emit("resnet50_images_per_sec_per_chip", ips / n,
-         f"images/sec/chip (batch {per_chip}/chip, {n} devices)")
-
-    # single-device plain baseline for scaling efficiency
+    # Single-device plain baseline for scaling efficiency, through the SAME
+    # harness (bench.py methodology: interleaved rounds so tunnel drift
+    # cannot land on one side of the ratio; see common.slope_time_paired).
     model1 = model_cls(axis_name=None,
                        dtype=jnp.bfloat16 if tpu else jnp.float32)
     opt1 = optax.sgd(0.1, momentum=0.9)
     x1, y1 = images[:per_chip], labels[:per_chip]
-    variables = model1.init(jax.random.PRNGKey(0), x1[:1], train=False)
-    pstate = (variables["params"], variables.get("batch_stats", {}),
-              opt1.init(variables["params"]))
-
-    def plain(k):
-        def one(st, _):
-            params, stats, opt_state = st
-
-            def loss_of(p):
-                out, mut = model1.apply(
-                    {"params": p, "batch_stats": stats}, x1, train=True,
-                    mutable=["batch_stats"])
-                return loss_fn(out, y1), mut["batch_stats"]
-            (l, stats2), grads = jax.value_and_grad(loss_of,
-                                                    has_aux=True)(params)
-            updates, opt_state = opt1.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), stats2,
-                    opt_state), l
-        return jax.jit(lambda st: jax.lax.scan(one, st, None,
-                                               length=k)[1][-1])
-
-    plains = {k: plain(k) for k in (S_SHORT, S_LONG)}
+    mesh1 = jax.sharding.Mesh(np.asarray(jax.devices()[:1]),
+                              (hvd.RANK_AXIS,))
+    pstate = create_train_state(model1, jax.random.PRNGKey(0), x1[:1], opt1,
+                                broadcast=False)
+    plains = {k: make_train_step(model1, opt1, loss_fn, scan_steps=k,
+                                 mesh=mesh1, donate=False)
+              for k in (S_SHORT, S_LONG)}
 
     def run1(k):
-        sync(plains[k](pstate))
+        _, loss = plains[k](pstate, x1, y1)
+        sync(loss)
 
-    ips1 = per_chip / slope_time(run1)
+    sec = slope_time_paired({"hvd": run, "plain": run1})
+    ips = batch / sec["hvd"]
+    ips1 = per_chip / sec["plain"]
+    emit("resnet50_images_per_sec_per_chip", ips / n,
+         f"images/sec/chip (batch {per_chip}/chip, {n} devices)")
     emit("resnet50_scaling_efficiency", (ips / n) / ips1,
          f"per-chip throughput vs 1-device plain JAX ({n} devices)",
          (ips / n) / ips1)

@@ -80,7 +80,8 @@ def main():
           f"model={args.model} op={args.op}")
     for _ in range(args.warmup):
         state, loss = step(state, tokens, tokens)
-    float(np.asarray(loss))
+    if args.warmup:
+        float(np.asarray(loss))  # sync
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step(state, tokens, tokens)

@@ -90,7 +90,8 @@ def main():
           f"model={args.model} seq={seq} compression={args.compression}")
     for _ in range(args.warmup):
         state, loss = step(state, tokens, labels)
-    float(np.asarray(loss))
+    if args.warmup:
+        float(np.asarray(loss))  # sync
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step(state, tokens, labels)

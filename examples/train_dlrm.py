@@ -111,7 +111,8 @@ def main():
             params2, opt_state2, loss = jitted(params, opt_state, dense,
                                                sparse, labels)
             params, opt_state = params2, opt_state2
-        float(np.asarray(loss))
+        if args.warmup:
+            float(np.asarray(loss))
         t0 = time.perf_counter()
         for _ in range(args.steps):
             params, opt_state, loss = jitted(params, opt_state, dense,

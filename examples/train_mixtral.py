@@ -79,7 +79,8 @@ def main():
           f"platform={jax.devices()[0].platform} model={args.model}")
     for _ in range(args.warmup):
         state, loss = step(state, tokens)
-    float(np.asarray(loss))
+    if args.warmup:
+        float(np.asarray(loss))  # sync
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step(state, tokens)

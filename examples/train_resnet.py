@@ -93,7 +93,8 @@ def main():
           f"global_batch={args.batch_size} model={args.model}")
     for i in range(args.warmup):
         state, loss = step(state, images, labels)
-    float(loss)  # value fetch: a real sync even on remote-tunnel backends
+    if args.warmup:
+        float(loss)  # value fetch: a real sync even on remote-tunnel backends
     t0 = time.perf_counter()
     for i in range(args.steps):
         state, loss = step(state, images, labels)

@@ -27,11 +27,12 @@ from .collectives import (Adasum, Average, Compression, Max, Min, Product,
                           hierarchical_adasum, iterate_with_join, join,
                           join_allreduce, join_count, reducescatter)
 from .core import (Config, HorovodInternalError, HostsUpdatedInterrupt,
-                   ProcessSet, RANK_AXIS, add_process_set, global_process_set, cross_rank,
+                   ProcessSet, RANK_AXIS, add_process_set, cuda_built,
+                   global_process_set, cross_rank,
                    cross_size, gloo_enabled, init, is_homogeneous,
                    is_initialized, local_rank, local_size, mesh, mpi_enabled, mpi_threads_supported,
-                   nccl_built, rank, remove_process_set, shutdown, size, start_timeline, stop_timeline,
-                   xla_built)
+                   nccl_built, rank, remove_process_set, rocm_built, shutdown,
+                   size, start_timeline, stop_timeline, xla_built)
 
 __version__ = "0.1.0"
 

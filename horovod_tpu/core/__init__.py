@@ -2,7 +2,8 @@ from .config import Config
 from .context_api import (RANK_AXIS, add_process_set, global_process_set, context, cross_rank,
                       cross_size, gloo_enabled, init, is_homogeneous,
                       is_initialized, local_rank, local_size, mesh,
-                      mpi_enabled, mpi_threads_supported, nccl_built, rank, remove_process_set,
+                      cuda_built, mpi_enabled, mpi_threads_supported, nccl_built,
+                      rank, remove_process_set, rocm_built,
                       shutdown, size, start_timeline, stop_timeline, xla_built)
 from .exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
                          NotInitializedError)
@@ -11,7 +12,8 @@ from .process_sets import ProcessSet, ProcessSetTable
 __all__ = [
     "Config", "RANK_AXIS", "add_process_set", "global_process_set", "context", "cross_rank",
     "cross_size", "gloo_enabled", "init", "is_homogeneous", "is_initialized",
-    "local_rank", "local_size", "mesh", "mpi_enabled", "mpi_threads_supported", "nccl_built", "rank",
+    "cuda_built", "local_rank", "local_size", "mesh", "mpi_enabled",
+    "mpi_threads_supported", "nccl_built", "rank", "rocm_built",
     "remove_process_set", "shutdown", "size", "start_timeline", "stop_timeline", "xla_built",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",
     "ProcessSet", "ProcessSetTable",

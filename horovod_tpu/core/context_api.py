@@ -222,6 +222,15 @@ def gloo_enabled() -> bool:
     return False
 
 
+def cuda_built() -> bool:
+    """Reference basics.py probe set: no CUDA/ROCm in the TPU build."""
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
 def add_process_set(ranks: Sequence[int]) -> ProcessSet:
     return context().process_sets.add(ranks)
 

@@ -18,6 +18,7 @@ must be into equal-size groups so the compiled program keeps static shapes.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence
 
 
@@ -44,6 +45,9 @@ class ProcessSetTable:
     def __init__(self, world_size: int):
         self._world_size = world_size
         self._next_id = 1
+        # One table is shared by all rank threads in thread-sim runs —
+        # guard the read-modify-write of _next_id / _sets.
+        self._lock = threading.Lock()
         self._sets: Dict[int, ProcessSet] = {
             0: ProcessSet(0, tuple(range(world_size)))
         }
@@ -59,22 +63,26 @@ class ProcessSetTable:
         if ranks[0] < 0 or ranks[-1] >= self._world_size:
             raise ValueError(
                 f"ranks {ranks} out of range for world size {self._world_size}")
-        for ps in self._sets.values():
-            if ps.ranks == ranks:
-                return ps
-        ps = ProcessSet(self._next_id, ranks)
-        self._sets[self._next_id] = ps
-        self._next_id += 1
-        return ps
+        with self._lock:
+            for ps in self._sets.values():
+                if ps.ranks == ranks:
+                    return ps
+            ps = ProcessSet(self._next_id, ranks)
+            self._sets[self._next_id] = ps
+            self._next_id += 1
+            return ps
 
     def remove(self, ps: "ProcessSet | int") -> None:
         psid = ps.process_set_id if isinstance(ps, ProcessSet) else int(ps)
         if psid == 0:
             raise ValueError("cannot remove the global process set")
-        self._sets.pop(psid, None)
+        with self._lock:
+            self._sets.pop(psid, None)
 
     def get(self, psid: int) -> Optional[ProcessSet]:
-        return self._sets.get(psid)
+        with self._lock:
+            return self._sets.get(psid)
 
     def ids(self) -> List[int]:
-        return sorted(self._sets)
+        with self._lock:
+            return sorted(self._sets)

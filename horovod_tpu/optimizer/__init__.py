@@ -1,11 +1,12 @@
 from .distributed import (DistributedOptimizer, DistributedState,
                           distributed)
-from .functions import (broadcast_object, broadcast_optimizer_state,
-                        broadcast_parameters, join, join_allreduce)
+from .functions import (allgather_object, broadcast_object,
+                        broadcast_optimizer_state, broadcast_parameters,
+                        join, join_allreduce)
 from .sync_batch_norm import SyncBatchNorm
 
 __all__ = [
     "DistributedOptimizer", "DistributedState", "distributed",
-    "broadcast_object", "broadcast_optimizer_state", "broadcast_parameters",
+    "allgather_object", "broadcast_object", "broadcast_optimizer_state", "broadcast_parameters",
     "join", "join_allreduce", "SyncBatchNorm",
 ]

@@ -105,3 +105,21 @@ def join(*, axis_name: Optional[str] = None) -> int:
     :func:`join_allreduce` inside the step."""
     from horovod_tpu.core import context_api as _ctx
     return _ctx.size() - 1
+
+
+def allgather_object(obj: Any) -> list:
+    """Gather one picklable object per PROCESS; every process gets the
+    process-ordered list (reference ``hvd.allgather_object``). Single-host:
+    ``[obj]``. Uses a fixed-shape length exchange then a pad-to-max byte
+    gather, the same shape discipline as ``broadcast_object``."""
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray([payload.shape[0]], np.int64), tiled=False)).reshape(-1)
+    padded = np.zeros((int(sizes.max()),), np.uint8)
+    padded[:payload.shape[0]] = payload
+    g = np.asarray(multihost_utils.process_allgather(padded, tiled=False))
+    return [pickle.loads(g[i, :int(s)].tobytes())
+            for i, s in enumerate(sizes)]

@@ -47,6 +47,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--config-file", dest="config_file",
                    help="YAML file of flag defaults (reference "
                         "config_parser.py; explicit CLI flags win)")
+    # Reference transport selectors: accepted for drop-in compatibility,
+    # ignored with a warning — there is ONE transport here (XLA collectives
+    # wired up by the JAX coordination service).
+    p.add_argument("--gloo", "--use-gloo", action="store_true",
+                   dest="use_gloo", help=argparse.SUPPRESS)
+    p.add_argument("--mpi", "--use-mpi", action="store_true",
+                   dest="use_mpi", help=argparse.SUPPRESS)
+    p.add_argument("--mpi-args", dest="mpi_args", help=argparse.SUPPRESS)
     # Elastic (reference: _run_elastic)
     p.add_argument("--min-np", type=int, dest="min_np")
     p.add_argument("--max-np", type=int, dest="max_np")
@@ -86,7 +94,8 @@ Available features:
 
 # Launcher flags that take NO value — the pre-scan below needs this to know
 # where the launcher's flags end and the user command begins.
-_NO_VALUE_FLAGS = {"--check-build", "-v", "--verbose", "-h", "--help"}
+_NO_VALUE_FLAGS = {"--check-build", "-v", "--verbose", "-h", "--help",
+                   "--gloo", "--use-gloo", "--mpi", "--use-mpi"}
 
 
 def _own_config_file(argv: List[str]) -> Optional[str]:
@@ -157,6 +166,11 @@ def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
     if args.check_build:
         check_build()
         raise SystemExit(0)
+    if args.use_gloo or args.use_mpi or args.mpi_args:
+        which = "--gloo" if args.use_gloo else "--mpi"
+        print(f"hvdrun: {which} ignored — one transport here (XLA "
+              f"collectives over ICI/DCN, wired by the JAX coordination "
+              f"service); see docs/migration.md", file=sys.stderr)
     hosts_str = args.hosts
     if args.hostfile:
         hosts_str = parse_host_files(args.hostfile)

@@ -21,18 +21,20 @@ from .compression import Compression
 from .engine import (Adasum, Average, CollectiveEngine, JaxProcessEngine,
                      Max, Min, Product, SingleProcessEngine, Sum,
                      ThreadSimEngine)
-from .functions import (broadcast_object, broadcast_optimizer_state,
-                        broadcast_parameters)
-from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
+from .functions import (allgather_object, broadcast_object,
+                        broadcast_optimizer_state, broadcast_parameters)
+from .mpi_ops import (ProcessSet, add_process_set, allgather,
+                      allgather_async, allreduce, allreduce_,
                       allreduce_async, allreduce_async_, alltoall,
                       alltoall_async, barrier, broadcast, broadcast_,
                       broadcast_async, broadcast_async_, cross_rank,
-                      cross_size, grouped_allgather, grouped_allgather_async,
+                      cross_size, global_process_set, grouped_allgather,
+                      grouped_allgather_async,
                       grouped_allreduce, grouped_allreduce_,
                       grouped_allreduce_async, grouped_allreduce_async_,
                       init, is_initialized, join, local_rank, local_size,
                       poll, rank, reducescatter, reducescatter_async,
-                      shutdown, size, synchronize)
+                      remove_process_set, shutdown, size, synchronize)
 from .optimizer import DistributedOptimizer
 from .sync_batch_norm import SyncBatchNorm
 
@@ -60,4 +62,12 @@ def ddl_built() -> bool:
 
 
 def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
     return False

@@ -111,27 +111,39 @@ class CollectiveEngine:
 
     # Collectives. ``name`` identifies the op across ranks (the reference's
     # tensor-name negotiation key, SURVEY.md §2.1 controller).
-    def allreduce(self, name: str, arr: np.ndarray, op: str) -> np.ndarray:
+    # ``members`` (optional tuple of global ranks) restricts the op to a
+    # process set: only members call, only members meet (reference
+    # process_set.cc semantics). Engines that cannot form subgroups raise.
+    def allreduce(self, name: str, arr: np.ndarray, op: str,
+                  members=None) -> np.ndarray:
         raise NotImplementedError
 
-    def allgather(self, name: str, arr: np.ndarray) -> np.ndarray:
+    def allgather(self, name: str, arr: np.ndarray,
+                  members=None) -> np.ndarray:
         raise NotImplementedError
 
     def broadcast(self, name: str, arr: Optional[np.ndarray],
-                  root_rank: int) -> np.ndarray:
+                  root_rank: int, members=None) -> np.ndarray:
         raise NotImplementedError
 
     def alltoall(self, name: str, arr: np.ndarray,
-                 splits: Optional[np.ndarray]
+                 splits: Optional[np.ndarray], members=None
                  ) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
     def reducescatter(self, name: str, arr: np.ndarray,
-                      op: str) -> np.ndarray:
+                      op: str, members=None) -> np.ndarray:
         raise NotImplementedError
 
-    def barrier(self, name: str = "barrier") -> None:
+    def barrier(self, name: str = "barrier", members=None) -> None:
         raise NotImplementedError
+
+    def _check_member(self, members) -> None:
+        if members is not None and self.rank() not in members:
+            raise ValueError(
+                f"rank {self.rank()} is not in process set {sorted(members)}"
+                " — only member ranks may call a process-set op"
+                " (reference semantics)")
 
     def join(self) -> int:
         """Mark this rank as out of data; block until all ranks joined;
@@ -168,27 +180,33 @@ class SingleProcessEngine(CollectiveEngine):
     def size(self) -> int:
         return 1
 
-    def allreduce(self, name, arr, op):
+    def allreduce(self, name, arr, op, members=None):
+        self._check_member(members)
         if op == Adasum:  # combine with nothing = identity (tree of one)
             return np.array(arr, copy=True)
         return reduce_arrays([arr], op)
 
-    def allgather(self, name, arr):
+    def allgather(self, name, arr, members=None):
+        self._check_member(members)
         return np.array(arr, copy=True)
 
-    def broadcast(self, name, arr, root_rank):
+    def broadcast(self, name, arr, root_rank, members=None):
+        self._check_member(members)
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
         return np.array(arr, copy=True)
 
-    def alltoall(self, name, arr, splits):
+    def alltoall(self, name, arr, splits, members=None):
+        self._check_member(members)
         n_recv = np.asarray([arr.shape[0]], dtype=np.int64)
         return np.array(arr, copy=True), n_recv
 
-    def reducescatter(self, name, arr, op):
+    def reducescatter(self, name, arr, op, members=None):
+        self._check_member(members)
         return reduce_arrays([arr], Sum if op == Average else op)
 
-    def barrier(self, name="barrier"):
+    def barrier(self, name="barrier", members=None):
+        self._check_member(members)
         return None
 
     def join(self) -> int:
@@ -216,8 +234,13 @@ class _Rendezvous:
         self.joined: set = set()
         self.generation: Dict[str, int] = {}
 
-    def run(self, key: str, rank: int, payload, compute):
+    def run(self, key: str, rank: int, payload, compute, members=None):
         import time as _time
+        if members is not None:
+            # Process-set ops meet only their members; fold the member set
+            # into the key so same-named ops on different sets never mix.
+            members = frozenset(members)
+            key = f"{key}|ps{sorted(members)}"
         with self.cv:
             gen = self.generation.get(key, 0)
             slot_key = (key, gen) if (key, gen) not in self.pending or \
@@ -229,7 +252,8 @@ class _Rendezvous:
                 slot_key = (key, gen)
             slot = self.pending.setdefault(
                 slot_key, {"contrib": {}, "result": None, "done": 0,
-                           "computed": False, "error": None})
+                           "computed": False, "error": None,
+                           "members": members})
             slot["contrib"][rank] = payload
             self._maybe_compute(key, gen, slot, compute)
             deadline = _time.monotonic() + self.stall_timeout_s
@@ -254,7 +278,9 @@ class _Rendezvous:
             return result
 
     def _maybe_compute(self, key, gen, slot, compute):
-        active = set(range(self.n)) - self.joined
+        world = slot["members"] if slot["members"] is not None \
+            else set(range(self.n))
+        active = set(world) - self.joined
         if not slot["computed"] and slot["error"] is None \
                 and active <= set(slot["contrib"]):
             try:
@@ -317,7 +343,9 @@ class ThreadSimEngine(CollectiveEngine):
 
     # -- collectives ---------------------------------------------------------
 
-    def allreduce(self, name, arr, op):
+    def allreduce(self, name, arr, op, members=None):
+        self._check_member(members)
+
         def compute(contrib, joined):
             ranks = sorted(contrib)
             arrays = [contrib[r] for r in ranks]
@@ -325,47 +353,63 @@ class ThreadSimEngine(CollectiveEngine):
             # count (reference join_allreduce semantics, collectives/join.py).
             return reduce_arrays(arrays, op)
         out = self._rv.run(f"allreduce.{name}", self.rank(),
-                           np.asarray(arr), compute)
+                           np.asarray(arr), compute, members=members)
         return np.array(out, copy=True)
 
-    def allgather(self, name, arr):
+    def allgather(self, name, arr, members=None):
+        self._check_member(members)
+
         def compute(contrib, joined):
             return np.concatenate([contrib[r] for r in sorted(contrib)])
         out = self._rv.run(f"allgather.{name}", self.rank(),
-                           np.asarray(arr), compute)
+                           np.asarray(arr), compute, members=members)
         return np.array(out, copy=True)
 
-    def broadcast(self, name, arr, root_rank):
+    def broadcast(self, name, arr, root_rank, members=None):
+        self._check_member(members)
+
         def compute(contrib, joined):
             if root_rank not in contrib:
                 raise RuntimeError(f"broadcast root {root_rank} joined/absent")
             return contrib[root_rank]
         payload = None if arr is None else np.asarray(arr)
-        out = self._rv.run(f"broadcast.{name}", self.rank(), payload, compute)
+        out = self._rv.run(f"broadcast.{name}", self.rank(), payload, compute,
+                           members=members)
         return np.array(out, copy=True)
 
-    def alltoall(self, name, arr, splits):
+    def alltoall(self, name, arr, splits, members=None):
+        self._check_member(members)
         me = self.rank()
+        group = len(members) if members is not None else self._n
 
         def compute(contrib, joined):
             chunks = {}
             for r, (a, sp) in contrib.items():
-                chunks[r] = _alltoall_chunks(a, sp, self._n)
+                chunks[r] = _alltoall_chunks(a, sp, group)
             out = {}
+            world = sorted(members) if members is not None \
+                else list(range(self._n))
             for dst in contrib:
-                parts = [chunks[src][dst] for src in sorted(contrib)]
+                # Chunk i of each member goes to the i-th member of the SET
+                # (set-local destination order, reference process-set
+                # alltoall); for the global set this is the rank index.
+                parts = [chunks[src][world.index(dst)]
+                         for src in sorted(contrib)]
                 out[dst] = (np.concatenate(parts),
                             np.asarray([p.shape[0] for p in parts],
                                        dtype=np.int64))
             return out
         payload = (np.asarray(arr), None if splits is None
                    else np.asarray(splits))
-        out = self._rv.run(f"alltoall.{name}", me, payload, compute)
+        out = self._rv.run(f"alltoall.{name}", me, payload, compute,
+                           members=members)
         recv, recv_splits = out[me]
         return np.array(recv, copy=True), np.array(recv_splits, copy=True)
 
-    def reducescatter(self, name, arr, op):
+    def reducescatter(self, name, arr, op, members=None):
+        self._check_member(members)
         me = self.rank()
+        group = len(members) if members is not None else self._n
 
         def compute(contrib, joined):
             ranks = sorted(contrib)
@@ -373,19 +417,22 @@ class ThreadSimEngine(CollectiveEngine):
                                 Sum if op == Average else op)
             if op == Average:
                 red = (red / len(ranks)).astype(red.dtype, copy=False)
-            n = self._n
-            if red.shape[0] % n:
+            if red.shape[0] % group:
                 raise ValueError(
                     f"reducescatter first dim {red.shape[0]} not divisible "
-                    f"by size {n}")
-            return {r: c for r, c in zip(range(n), np.split(red, n))}
+                    f"by size {group}")
+            world = sorted(members) if members is not None \
+                else list(range(self._n))
+            chunks = np.split(red, group)
+            return {r: chunks[world.index(r)] for r in ranks}
         out = self._rv.run(f"reducescatter.{name}", me, np.asarray(arr),
-                           compute)
+                           compute, members=members)
         return np.array(out[me], copy=True)
 
-    def barrier(self, name="barrier"):
+    def barrier(self, name="barrier", members=None):
+        self._check_member(members)
         self._rv.run(f"barrier.{name}", self.rank(), None,
-                     lambda contrib, joined: True)
+                     lambda contrib, joined: True, members=members)
 
     def join(self) -> int:
         return self._rv.join(self.rank())
@@ -427,6 +474,19 @@ class JaxProcessEngine(CollectiveEngine):
 
     #: mpi_ops keys on this to serialize submission (program order).
     requires_ordered_submission = True
+
+    def _no_subgroup(self, members) -> None:
+        """Subgroup rounds would deadlock: every op here is a collective
+        over ALL processes (multihost_utils has no sub-communicators).
+        Process sets on pods belong to the JAX API (``axis_index_groups``
+        lower to partitioned ICI collectives, core/process_sets.py)."""
+        if members is not None and len(members) != self.size():
+            raise NotImplementedError(
+                "process sets are not supported by the multi-host torch "
+                "engine; use the JAX API's process sets "
+                "(horovod_tpu.add_process_set) for in-graph subgroup "
+                "collectives")
+        self._check_member(members)
 
     def rank(self) -> int:
         return self._jax.process_index()
@@ -524,7 +584,8 @@ class JaxProcessEngine(CollectiveEngine):
         h.update(extra or {})
         return h
 
-    def allreduce(self, name, arr, op):
+    def allreduce(self, name, arr, op, members=None):
+        self._no_subgroup(members)
         arr = np.asarray(arr)
         flat = arr.reshape(1, -1)
         headers, payloads = self._round(
@@ -533,7 +594,8 @@ class JaxProcessEngine(CollectiveEngine):
                   if not h["joined"] and len(payloads[r])]
         return reduce_arrays(arrays, op).reshape(arr.shape)
 
-    def allgather(self, name, arr):
+    def allgather(self, name, arr, members=None):
+        self._no_subgroup(members)
         arr = np.asarray(arr)
         headers, payloads = self._round(
             self._header("allgather", name, arr), arr)
@@ -541,7 +603,8 @@ class JaxProcessEngine(CollectiveEngine):
                               if any(p.shape[0] for p in payloads)
                               else [arr[:0]])
 
-    def broadcast(self, name, arr, root_rank):
+    def broadcast(self, name, arr, root_rank, members=None):
+        self._no_subgroup(members)
         arr = None if arr is None else np.asarray(arr)
         payload = arr[None] if arr is not None else None
         headers, payloads = self._round(
@@ -552,7 +615,8 @@ class JaxProcessEngine(CollectiveEngine):
                 f"broadcast root {root_rank} has already joined")
         return payloads[root_rank][0]
 
-    def alltoall(self, name, arr, splits):
+    def alltoall(self, name, arr, splits, members=None):
+        self._no_subgroup(members)
         arr = np.asarray(arr)
         n = self.size()
         me = self.rank()
@@ -576,7 +640,8 @@ class JaxProcessEngine(CollectiveEngine):
         return (np.concatenate(parts) if parts else arr[:0],
                 np.asarray([p.shape[0] for p in parts], dtype=np.int64))
 
-    def reducescatter(self, name, arr, op):
+    def reducescatter(self, name, arr, op, members=None):
+        self._no_subgroup(members)
         arr = np.asarray(arr)
         flat = arr.reshape(1, -1)
         headers, payloads = self._round(
@@ -594,7 +659,8 @@ class JaxProcessEngine(CollectiveEngine):
                 f"size {n}")
         return np.split(red, n)[self.rank()].copy()
 
-    def barrier(self, name="barrier"):
+    def barrier(self, name="barrier", members=None):
+        self._no_subgroup(members)
         self._round(self._header("barrier", name, None),
                     np.zeros((1, 0), dtype=np.float32))
 

@@ -105,3 +105,21 @@ def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast_object"):
         payload = np.zeros(int(sz[0]), dtype=np.uint8)
     payload = rt.engine.broadcast(f"{name}.data", payload, root_rank)
     return pickle.loads(payload.tobytes())
+
+
+def allgather_object(obj, name: str = "allgather_object") -> list:
+    """Gather one arbitrary picklable object per rank; every rank gets the
+    rank-ordered list (reference ``hvd.allgather_object``: pickle + size
+    exchange + ragged byte allgather)."""
+    payload = np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+        dtype=np.uint8).copy()
+    rt = _ops._rt()
+    sizes = rt.engine.allgather(
+        f"{name}.size", np.asarray([payload.shape[0]], dtype=np.int64))
+    data = rt.engine.allgather(f"{name}.data", payload)
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(data[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -25,6 +25,7 @@ import torch
 from . import engine as _engine
 from .engine import (Adasum, Average, Max, Min, Product, Sum)  # noqa: F401
 from .compression import Compression
+from ..core.process_sets import ProcessSet, ProcessSetTable  # noqa: F401
 
 # --- module state -----------------------------------------------------------
 
@@ -43,6 +44,7 @@ class _TorchRuntime:
         self._executors: Dict[int, ThreadPoolExecutor] = {}
         self._name_counters: Dict[int, Dict[str, int]] = {}
         self._inflight: set = set()
+        self.process_sets = ProcessSetTable(eng.size())
 
     def executor(self) -> ThreadPoolExecutor:
         # A worker POOL per rank: ops run concurrently so ranks may submit
@@ -172,6 +174,31 @@ def cross_size() -> int:
     return _rt().engine.cross_size()
 
 
+# --- process sets (reference process_sets.py over the engine layer) ---------
+
+def add_process_set(ranks) -> ProcessSet:
+    """Register a subset of ranks for subgroup collectives (reference
+    ``hvd.add_process_set``). Pass the returned set as ``process_set=`` to
+    any op; only member ranks may call it."""
+    return _rt().process_sets.add(ranks)
+
+
+def remove_process_set(ps) -> None:
+    _rt().process_sets.remove(ps)
+
+
+def global_process_set() -> ProcessSet:
+    return _rt().process_sets.global_set
+
+
+def _members(process_set: Optional[ProcessSet]):
+    """ProcessSet -> engine ``members`` tuple (None for the global set, so
+    the non-set path stays byte-identical)."""
+    if process_set is None or process_set.process_set_id == 0:
+        return None
+    return tuple(process_set.ranks)
+
+
 # --- numpy adaptation -------------------------------------------------------
 
 def _to_np(t: torch.Tensor) -> np.ndarray:
@@ -188,13 +215,14 @@ def _from_np(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
 def _allreduce_impl(tensor: torch.Tensor, op: str, name: Optional[str],
                     compression, prescale_factor: float,
                     postscale_factor: float,
-                    output: Optional[torch.Tensor]) -> torch.Tensor:
+                    output: Optional[torch.Tensor],
+                    members=None) -> torch.Tensor:
     rt = _rt()
     compressed, ctx = compression.compress(tensor)
     arr = _to_np(compressed)
     if prescale_factor != 1.0:
         arr = arr * prescale_factor
-    out = rt.engine.allreduce(name, arr, op)
+    out = rt.engine.allreduce(name, arr, op, members=members)
     if postscale_factor != 1.0:
         out = out * postscale_factor
     res = compression.decompress(_from_np(out, compressed), ctx)
@@ -209,51 +237,57 @@ def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
                     name: Optional[str] = None,
                     compression=Compression.none, op: Optional[str] = None,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> int:
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None) -> int:
     op = _op_from_average(average, op)
     return _rt().submit("allreduce", name, lambda nm: _allreduce_impl(
         tensor, op, nm, compression, prescale_factor, postscale_factor,
-        None))
+        None, _members(process_set)))
 
 
 def allreduce_async_(tensor: torch.Tensor, average: Optional[bool] = None,
                      name: Optional[str] = None,
                      compression=Compression.none, op: Optional[str] = None,
                      prescale_factor: float = 1.0,
-                     postscale_factor: float = 1.0) -> int:
+                     postscale_factor: float = 1.0,
+                     process_set: Optional[ProcessSet] = None) -> int:
     op = _op_from_average(average, op)
     return _rt().submit("allreduce", name, lambda nm: _allreduce_impl(
         tensor, op, nm, compression, prescale_factor, postscale_factor,
-        tensor))
+        tensor, _members(process_set)))
 
 
 def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
               name: Optional[str] = None, compression=Compression.none,
               op: Optional[str] = None, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0) -> torch.Tensor:
+              postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
     return synchronize(allreduce_async(
         tensor, average, name, compression, op, prescale_factor,
-        postscale_factor))
+        postscale_factor, process_set))
 
 
 def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
                name: Optional[str] = None, compression=Compression.none,
                op: Optional[str] = None, prescale_factor: float = 1.0,
-               postscale_factor: float = 1.0) -> torch.Tensor:
+               postscale_factor: float = 1.0,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
     return synchronize(allreduce_async_(
         tensor, average, name, compression, op, prescale_factor,
-        postscale_factor))
+        postscale_factor, process_set))
 
 
 def grouped_allreduce_async(tensors, average=None, name=None,
                             compression=Compression.none, op=None,
-                            prescale_factor=1.0, postscale_factor=1.0):
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set: Optional[ProcessSet] = None):
     """One handle for a list of tensors, reduced atomically (reference:
     grouped ops via group_table.cc, SURVEY.md §2.1)."""
     op = _op_from_average(average, op)
+    m = _members(process_set)
     return _rt().submit("grouped_allreduce", name, lambda nm: [
         _allreduce_impl(t, op, f"{nm}.{i}", compression,
-                        prescale_factor, postscale_factor, None)
+                        prescale_factor, postscale_factor, None, m)
         for i, t in enumerate(tensors)])
 
 
@@ -263,11 +297,13 @@ def grouped_allreduce(tensors, **kw):
 
 def grouped_allreduce_async_(tensors, average=None, name=None,
                              compression=Compression.none, op=None,
-                             prescale_factor=1.0, postscale_factor=1.0):
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set: Optional[ProcessSet] = None):
     op = _op_from_average(average, op)
+    m = _members(process_set)
     return _rt().submit("grouped_allreduce", name, lambda nm: [
         _allreduce_impl(t, op, f"{nm}.{i}", compression,
-                        prescale_factor, postscale_factor, t)
+                        prescale_factor, postscale_factor, t, m)
         for i, t in enumerate(tensors)])
 
 
@@ -288,69 +324,83 @@ def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
 
 # --- allgather --------------------------------------------------------------
 
-def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
     return rt.submit("allgather", name, lambda nm: _from_np(
-        rt.engine.allgather(nm, _to_np(tensor)), tensor))
+        rt.engine.allgather(nm, _to_np(tensor),
+                            members=_members(process_set)), tensor))
 
 
-def allgather(tensor: torch.Tensor, name: Optional[str] = None
-              ) -> torch.Tensor:
-    return synchronize(allgather_async(tensor, name))
+def allgather(tensor: torch.Tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name, process_set))
 
 
-def grouped_allgather_async(tensors, name: Optional[str] = None) -> int:
+def grouped_allgather_async(tensors, name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
+    m = _members(process_set)
     return rt.submit("grouped_allgather", name, lambda nm: [
-        _from_np(rt.engine.allgather(f"{nm}.{i}", _to_np(t)), t)
+        _from_np(rt.engine.allgather(f"{nm}.{i}", _to_np(t), members=m), t)
         for i, t in enumerate(tensors)])
 
 
-def grouped_allgather(tensors, name: Optional[str] = None):
-    return synchronize(grouped_allgather_async(tensors, name))
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    return synchronize(grouped_allgather_async(tensors, name, process_set))
 
 
 # --- broadcast --------------------------------------------------------------
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
     return rt.submit("broadcast", name, lambda nm: _from_np(
-        rt.engine.broadcast(nm, _to_np(tensor), root_rank), tensor))
+        rt.engine.broadcast(nm, _to_np(tensor), root_rank,
+                            members=_members(process_set)), tensor))
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
 
     def run(nm):
-        out = rt.engine.broadcast(nm, _to_np(tensor), root_rank)
+        out = rt.engine.broadcast(nm, _to_np(tensor), root_rank,
+                                  members=_members(process_set))
         tensor.copy_(_from_np(out, tensor))
         return tensor
     return rt.submit("broadcast", name, run)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int,
-              name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_async(tensor, root_rank, name))
+              name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int,
-               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_async_(tensor, root_rank, name))
+               name: Optional[str] = None,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        process_set))
 
 
 # --- alltoall ---------------------------------------------------------------
 
 def alltoall_async(tensor: torch.Tensor,
                    splits: Optional[torch.Tensor] = None,
-                   name: Optional[str] = None) -> int:
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
     want_splits = splits is not None
 
     def run(nm):
         sp = None if splits is None else _to_np(splits)
-        out, recv = rt.engine.alltoall(nm, _to_np(tensor), sp)
+        out, recv = rt.engine.alltoall(nm, _to_np(tensor), sp,
+                                       members=_members(process_set))
         res = _from_np(out, tensor)
         if want_splits:
             return res, torch.from_numpy(recv.astype(np.int64))
@@ -359,24 +409,28 @@ def alltoall_async(tensor: torch.Tensor,
 
 
 def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
-             name: Optional[str] = None):
+             name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
     """Returns the received tensor, or ``(tensor, received_splits)`` when
     ``splits`` is given (reference mpi_ops.py contract)."""
-    return synchronize(alltoall_async(tensor, splits, name))
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 
 # --- reducescatter ----------------------------------------------------------
 
 def reducescatter_async(tensor: torch.Tensor, op: str = Sum,
-                        name: Optional[str] = None) -> int:
+                        name: Optional[str] = None,
+                        process_set: Optional[ProcessSet] = None) -> int:
     rt = _rt()
     return rt.submit("reducescatter", name, lambda nm: _from_np(
-        rt.engine.reducescatter(nm, _to_np(tensor), op), tensor))
+        rt.engine.reducescatter(nm, _to_np(tensor), op,
+                                members=_members(process_set)), tensor))
 
 
 def reducescatter(tensor: torch.Tensor, op: str = Sum,
-                  name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(reducescatter_async(tensor, op, name))
+                  name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, op, name, process_set))
 
 
 # --- handles ----------------------------------------------------------------
@@ -413,6 +467,8 @@ def join(device: int = -1) -> int:
     return rt.executor().submit(rt.engine.join).result()
 
 
-def barrier() -> None:
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
     rt = _rt()
-    rt.executor().submit(rt.engine.barrier).result()
+    m = _members(process_set)
+    rt.executor().submit(
+        lambda: rt.engine.barrier(members=m)).result()

@@ -179,6 +179,11 @@ def test_broadcast_parameters_single_host_identity():
     np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
 
 
+def test_allgather_object_single_host():
+    obj = {"config": [1, 2, 3]}
+    assert hvd.optimizer.allgather_object(obj) == [obj]
+
+
 def test_broadcast_object_single_host():
     obj = {"epoch": 3, "lr": 0.1}
     assert hvd.optimizer.broadcast_object(obj) == obj

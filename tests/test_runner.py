@@ -154,6 +154,18 @@ def test_parse_settings_elastic():
     assert cmd == ["python", "t.py"]
 
 
+def test_parse_settings_accepts_reference_transport_flags(capsys):
+    # Reference drop-in compat: --gloo/--mpi are accepted and ignored with
+    # a warning (one transport here).
+    s, cmd = parse_settings(["-np", "2", "-H", "localhost:2", "--gloo",
+                             "python", "t.py"])
+    assert cmd == ["python", "t.py"]
+    assert "ignored" in capsys.readouterr().err
+    s, cmd = parse_settings(["-np", "2", "-H", "localhost:2", "--mpi",
+                             "--mpi-args", "-x FOO", "python", "t.py"])
+    assert cmd == ["python", "t.py"]
+
+
 def test_parse_settings_requires_command():
     with pytest.raises(SystemExit):
         parse_settings(["-np", "2"])

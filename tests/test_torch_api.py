@@ -454,6 +454,85 @@ def test_broadcast_object():
         assert o == {"epoch": 5, "name": "rank1"}
 
 
+def test_allgather_object():
+    n = 3
+
+    def fn(r):
+        return hvd.allgather_object({"rank": r, "data": list(range(r))})
+
+    for out in run_parallel(n, fn):
+        assert out == [{"rank": i, "data": list(range(i))}
+                       for i in range(n)]
+
+
+# --- process sets -----------------------------------------------------------
+
+def test_process_set_allreduce_disjoint_sets():
+    n = 4
+
+    def fn(r):
+        lo = hvd.add_process_set([0, 1])
+        hi = hvd.add_process_set([2, 3])
+        ps = lo if r < 2 else hi
+        out = hvd.allreduce(torch.tensor([float(r)]), process_set=ps,
+                            name="ps_ar")
+        return float(out)
+
+    outs = run_parallel(n, fn)
+    # {0,1} average to 0.5; {2,3} average to 2.5 — sets never mix.
+    assert outs == [0.5, 0.5, 2.5, 2.5]
+
+
+def test_process_set_allgather_and_broadcast():
+    n = 4
+
+    def fn(r):
+        evens = hvd.add_process_set([0, 2])
+        if r in (0, 2):
+            g = hvd.allgather(torch.tensor([[r]]), process_set=evens,
+                              name="ps_ag")
+            b = hvd.broadcast(torch.tensor([r * 10]), root_rank=2,
+                              process_set=evens, name="ps_bc")
+            return g.flatten().tolist(), int(b)
+        return None
+
+    outs = run_parallel(n, fn)
+    assert outs[0] == ([0, 2], 20) and outs[2] == ([0, 2], 20)
+    assert outs[1] is None and outs[3] is None
+
+
+def test_process_set_non_member_call_raises():
+    n = 2
+
+    def fn(r):
+        ps = hvd.add_process_set([0])
+        if r == 1:
+            with pytest.raises(ValueError, match="not in process set"):
+                hvd.allreduce(torch.tensor([1.0]), process_set=ps,
+                              name="ps_bad")
+        else:
+            out = hvd.allreduce(torch.tensor([5.0]), process_set=ps,
+                                name="ps_ok")
+            assert float(out) == 5.0
+        return True
+
+    assert run_parallel(n, fn) == [True, True]
+
+
+def test_process_set_registry_roundtrip():
+    def fn(r):
+        gs = hvd.global_process_set()
+        assert gs.process_set_id == 0 and gs.size() == 2
+        ps = hvd.add_process_set([0, 1])
+        assert ps.process_set_id == 0  # same ranks as global -> same set
+        ps2 = hvd.add_process_set([1])
+        assert ps2.included(1) and not ps2.included(0)
+        hvd.remove_process_set(ps2)
+        return True
+
+    assert run_parallel(2, fn) == [True, True]
+
+
 # --- SyncBatchNorm ----------------------------------------------------------
 
 def test_sync_batch_norm_matches_global_batch():
