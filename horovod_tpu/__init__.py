@@ -43,7 +43,7 @@ def __getattr__(name):
     import importlib
     if name in ("optimizer", "elastic", "models", "parallel", "runner",
                 "tools", "ops", "utils", "train", "callbacks", "checkpoint",
-                "ray", "spark", "torch"):
+                "data", "ray", "spark", "torch"):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

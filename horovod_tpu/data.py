@@ -1,0 +1,187 @@
+"""Host-side input pipeline: shard batches over the mesh, prefetch to device.
+
+Role: the reference leaves data loading to each framework (torch
+``DataLoader`` + ``DistributedSampler``; its own ``ElasticSampler`` for
+elastic runs — SURVEY.md §2.5). On TPU the input pipeline is a first-order
+perf concern (HBM is fed over PCIe from the host): this module provides the
+three host-side pieces a training loop needs, TPU-shaped:
+
+- :func:`shard_batch` — host-local numpy → a global ``jax.Array`` laid out
+  batch-over-rank-axis on the mesh (one process per host contributes its
+  local shard; single-process worlds take the in-process fast path).
+- :class:`Prefetcher` — background-thread double buffering: the next
+  batch's host→device transfer overlaps the current step's compute
+  (the ``flax`` ``prefetch_to_device`` idiom, made mesh-aware).
+- :class:`Dataset` — minimal array dataset: per-process sharding by
+  ``cross_rank`` (the reference's ``DistributedSampler`` role), epoch
+  shuffling, drop-last batching; composes with
+  :class:`~horovod_tpu.elastic.ElasticSampler` for elastic runs.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from .core import context_api as _ctx
+
+
+def shard_batch(batch: Any, mesh=None, axis: Optional[str] = None):
+    """Per-process host batch (pytree of numpy arrays, leading dim = LOCAL
+    batch) → global device array sharded over the mesh's rank axis.
+
+    Multi-process: every process contributes its local shard
+    (``multihost_utils.host_local_array_to_global_array``); the global
+    leading dim is ``local_batch * process_count``. Single-process: one
+    ``device_put`` with the sharded layout.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh if mesh is not None else _ctx.mesh()
+    axis = axis or _ctx.context().axis_name
+    spec = P(axis)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(
+            batch, mesh, spec)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x), sharding), batch)
+
+
+class Prefetcher:
+    """Wrap a host-batch iterator; a worker thread runs ``transfer`` (by
+    default :func:`shard_batch`) ``depth`` batches ahead so host→device
+    copies overlap device compute.
+
+    Iteration re-raises worker exceptions at the consumption point; the
+    worker dies with the iterator (daemon + sentinel), and ``close()``
+    stops it early.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterable, depth: int = 2,
+                 transfer: Optional[Callable] = None, mesh=None,
+                 axis: Optional[str] = None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        if transfer is None:
+            def transfer(b):  # noqa: F811 — default is the mesh shard-put
+                return shard_batch(b, mesh=mesh, axis=axis)
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._work, args=(iter(it), transfer), daemon=True)
+        self._thread.start()
+
+    def _work(self, it: Iterator, transfer: Callable) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                self._q.put(transfer(batch))
+            self._q.put(self._DONE)
+        except BaseException as e:  # re-raised on the consumer side
+            self._q.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock a producer waiting on a full queue.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class Dataset:
+    """Array dataset with per-process sharding and epoch shuffling.
+
+    ``arrays`` is a pytree of equal-leading-dim numpy arrays (e.g.
+    ``(images, labels)``). Each PROCESS iterates its own contiguous slice
+    of the shuffled global order (the reference ``DistributedSampler``
+    contract: same seed ⇒ disjoint, exhaustive shards), yielding
+    local batches of ``batch_size // process_count`` ready for
+    :func:`shard_batch` / :class:`Prefetcher`.
+    """
+
+    def __init__(self, arrays: Any, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True,
+                 rank: Optional[int] = None,
+                 num_replicas: Optional[int] = None):
+        import jax
+
+        leaves = _leaves(arrays)
+        if not leaves:
+            raise ValueError("empty dataset pytree")
+        self.n = leaves[0].shape[0]
+        if any(l.shape[0] != self.n for l in leaves):
+            raise ValueError("all leaves need the same leading dimension")
+        self.arrays = arrays
+        self.global_batch = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.rank = jax.process_index() if rank is None else rank
+        self.num_replicas = (jax.process_count() if num_replicas is None
+                             else num_replicas)
+        if batch_size % self.num_replicas:
+            raise ValueError(
+                f"batch_size {batch_size} must divide over "
+                f"{self.num_replicas} processes")
+        self.local_batch = batch_size // self.num_replicas
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        steps = self.n // self.global_batch
+        if not self.drop_last and self.n % self.global_batch:
+            steps += 1
+        return steps
+
+    def __iter__(self):
+        order = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        for s in range(len(self)):
+            sel = order[s * self.global_batch:(s + 1) * self.global_batch]
+            if len(sel) % self.num_replicas:
+                # drop_last=False tail: pad by wrapping from the front of
+                # the epoch order (DistributedSampler convention) so every
+                # process sees the SAME local size — required by
+                # shard_batch/host_local_array_to_global_array, and keeps
+                # jitted steps from recompiling on a ragged final shape.
+                pad = self.num_replicas - len(sel) % self.num_replicas
+                sel = np.concatenate([sel, order[:pad]])
+            per = len(sel) // self.num_replicas
+            mine = sel[self.rank * per:(self.rank + 1) * per]
+            yield _map_leaves(lambda a: a[mine], self.arrays)
+
+
+def _leaves(tree):
+    import jax
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _map_leaves(fn, tree):
+    import jax
+    return jax.tree_util.tree_map(lambda a: fn(np.asarray(a)), tree)

@@ -25,6 +25,20 @@ from ..core.logging import get_logger
 from .estimator import _materialize, _transform_df, _validation_split
 
 
+def _label_tensor(labels):
+    """Labels → torch tensor with a loss-friendly dtype: floating numpy
+    arrives as float64 (e.g. ``X @ w``) which MSELoss rejects against
+    float32 outputs; integer class labels must be int64 for NLL/CE."""
+    import torch as _torch
+
+    t = _torch.as_tensor(labels)
+    if t.is_floating_point():
+        return t.to(_torch.float32)
+    if t.dtype in (_torch.int8, _torch.int16, _torch.int32, _torch.uint8):
+        return t.to(_torch.int64)
+    return t
+
+
 class TorchModel:
     """The fitted Transformer (reference: ``horovod.spark.torch.TorchModel``).
 
@@ -113,6 +127,7 @@ class TorchEstimator:
         self.backward_passes_per_step = backward_passes_per_step
         self.output_col = output_col
         self.history: list = []
+        self._dopt = None  # hooks register once; refitting reuses them
 
     def fit(self, data) -> TorchModel:
         import torch
@@ -141,15 +156,20 @@ class TorchEstimator:
         # from rank 0, then hook the optimizer (optimizer.py parity).
         hvd.broadcast_parameters(self.model.state_dict(), root_rank=0)
         hvd.broadcast_optimizer_state(self.optimizer, root_rank=0)
-        dopt = hvd.DistributedOptimizer(
-            self.optimizer,
-            named_parameters=self.model.named_parameters(),
-            backward_passes_per_step=self.backward_passes_per_step)
+        if self._dopt is None:
+            # Wrap exactly once: DistributedOptimizer registers grad hooks
+            # on the model's parameters, and a second fit() must not stack
+            # a second set (duplicate in-flight names / double reduction).
+            self._dopt = hvd.DistributedOptimizer(
+                self.optimizer,
+                named_parameters=self.model.named_parameters(),
+                backward_passes_per_step=self.backward_passes_per_step)
+        dopt = self._dopt
 
         log = get_logger()
         steps_per_epoch = len(feats) // self.batch_size
         ft = torch.as_tensor(feats, dtype=torch.float32)
-        lt = torch.as_tensor(labels)
+        lt = _label_tensor(labels)
         self.model.train()
         for epoch in range(self.epochs):
             # Same shard-by-rank slicing every launcher here uses: each rank
@@ -190,6 +210,6 @@ class TorchEstimator:
         self.model.eval()
         with torch.no_grad():
             out = self.model(torch.as_tensor(feats, dtype=torch.float32))
-            loss = float(self.loss(out, torch.as_tensor(labels)))
+            loss = float(self.loss(out, _label_tensor(labels)))
         self.model.train()
         return loss

@@ -11,12 +11,12 @@
 
 from . import profiler
 from .autotune import (Autotuner, CatDim, Dim, GaussianProcess, IntDim,
-                       LogIntDim, expected_improvement)
+                       LogIntDim, StepAutotuner, expected_improvement)
 from .mismatch import MismatchDetector, MismatchError, detector, maybe_record
 from .stall import StallInspector
 from .timeline import Timeline, merge_chrome_traces
 
-__all__ = ["Autotuner", "CatDim", "Dim", "GaussianProcess", "IntDim",
+__all__ = ["Autotuner", "CatDim", "Dim", "GaussianProcess", "IntDim", "StepAutotuner",
            "LogIntDim", "MismatchDetector", "MismatchError",
            "StallInspector", "Timeline", "detector",
            "expected_improvement", "maybe_record", "merge_chrome_traces",

@@ -239,3 +239,84 @@ class Autotuner:
 
     def __del__(self):
         self.close()
+
+
+# --- transparent in-training autotuning --------------------------------------
+
+class StepAutotuner:
+    """Tune a compiled train step WHILE training, the way the reference's
+    ``parameter_manager`` does: every ``steps_per_trial`` steps the observed
+    throughput is reported as the trial's score and the next proposal's
+    step is built; after convergence the best knobs are locked in. Training
+    progress is real throughout — trial steps update real state.
+
+    ``build_step(**knobs) -> step_fn`` is the factory (each distinct knob
+    set costs one compile; compiles are cached by XLA per shape+flags).
+
+    Usage::
+
+        tuner = StepAutotuner(
+            lambda **kn: make_train_step(model, opt, loss_fn, **kn),
+            {"scan_steps": IntDim(1, 8)})
+        for batch, labels in data:
+            state, loss = tuner.step(state, batch, labels)
+        print(tuner.chosen)
+    """
+
+    def __init__(self, build_step, space: Dict[str, Any], *,
+                 steps_per_trial: int = 10, skip_first: int = 1,
+                 tuner: Optional[Autotuner] = None):
+        import time as _time
+        self._time = _time
+        self.build_step = build_step
+        self.tuner = tuner or Autotuner(space)
+        self.steps_per_trial = steps_per_trial
+        self.skip_first = skip_first  # per-trial compile steps to discount
+        self.chosen: Optional[Dict[str, Any]] = None
+        self._current: Optional[Dict[str, Any]] = None
+        self._fn = None
+        self._count = 0
+        self._t0 = 0.0
+
+    def _begin_trial(self) -> None:
+        self._current = self.tuner.propose()
+        self._fn = self.build_step(**self._current)
+        self._count = 0
+        if self.skip_first == 0:
+            # No compile steps to discount: the trial window starts now
+            # (first-step compile time lands in the score — callers who
+            # care pass skip_first >= 1, the default).
+            self._t0 = self._time.perf_counter()
+
+    def step(self, *args, **kwargs):
+        """Run one training step under the current knobs (tuning while not
+        converged, best knobs afterwards)."""
+        if self.chosen is None and self.tuner.converged():
+            self.chosen = self.tuner.best_params()
+            self._fn = self.build_step(**self.chosen)
+            get_logger().info("autotune converged: %s (score %.4g)",
+                              self.chosen, self.tuner.best_score())
+        if self._fn is None:
+            if self.chosen is None:
+                self._begin_trial()
+            else:
+                self._fn = self.build_step(**self.chosen)
+        out = self._fn(*args, **kwargs)
+        if self.chosen is not None:
+            return out
+        self._count += 1
+        if self._count == self.skip_first and self.skip_first > 0:
+            # Timing starts after the compile-bearing first step(s).
+            import jax
+            jax.tree_util.tree_map(lambda x: getattr(x, "block_until_ready",
+                                                     lambda: x)(), out)
+            self._t0 = self._time.perf_counter()
+        elif self._count >= self.steps_per_trial + self.skip_first:
+            import jax
+            jax.tree_util.tree_map(lambda x: getattr(x, "block_until_ready",
+                                                     lambda: x)(), out)
+            dt = self._time.perf_counter() - self._t0
+            self.tuner.report(self._current,
+                              self.steps_per_trial / max(dt, 1e-9))
+            self._begin_trial()
+        return out

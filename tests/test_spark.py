@@ -216,3 +216,22 @@ def test_torch_estimator_transform_pandas():
     df = pd.DataFrame({"features": list(X[:8]), "label": y[:8]})
     out = fitted.transform(df)
     assert "prediction" in out.columns and len(out) == 8
+
+
+def test_torch_estimator_float64_labels_and_refit(tmp_path):
+    import torch
+
+    from horovod_tpu.spark import TorchEstimator
+
+    X, y = _toy_data(128)
+    model = _torch_linear()
+    est = TorchEstimator(model=model,
+                         optimizer=torch.optim.Adam(model.parameters(),
+                                                    lr=0.05),
+                         loss=torch.nn.MSELoss(),
+                         batch_size=64, epochs=2)
+    est.fit((X, y.astype(np.float64)))   # float64 labels: cast, not crash
+    first_dopt = est._dopt
+    est.fit((X, y.astype(np.float64)))   # refit: no second hook stack
+    assert est._dopt is first_dopt
+    assert len(est.history) == 4
