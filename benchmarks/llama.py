@@ -35,10 +35,14 @@ def main():
     n = hvd.size()
     tpu = on_tpu()
     if tpu:
+        # remat_policy="full" + per-chip batch 8: measured fastest on one
+        # v5e chip (26.9k tok/s vs 25.7k at batch 4 with the "dots"
+        # policy; batch is HBM-bound — full remat frees the activation
+        # memory that buys the larger batch).
         cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24,
                           n_heads=16, n_kv_heads=8, hidden_dim=4096,
-                          max_seq_len=2048)
-        per_chip, seq = 4, 1024
+                          max_seq_len=2048, remat_policy="full")
+        per_chip, seq = 8, 1024
     else:
         cfg = llama_tiny()
         per_chip, seq = 2, 32

@@ -54,14 +54,37 @@ def shard_batch(batch: Any, mesh=None, axis: Optional[str] = None):
         lambda x: jax.device_put(np.asarray(x), sharding), batch)
 
 
+def _prefetch_worker(it: Iterator, transfer: Callable, q: "queue.Queue",
+                     stop: threading.Event, done: object) -> None:
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    try:
+        for batch in it:
+            if stop.is_set() or not put(transfer(batch)):
+                return
+        put(done)
+    except BaseException as e:  # re-raised on the consumer side
+        put(e)
+
+
 class Prefetcher:
     """Wrap a host-batch iterator; a worker thread runs ``transfer`` (by
     default :func:`shard_batch`) ``depth`` batches ahead so host→device
     copies overlap device compute.
 
-    Iteration re-raises worker exceptions at the consumption point; the
-    worker dies with the iterator (daemon + sentinel), and ``close()``
-    stops it early.
+    Iteration re-raises worker exceptions at the consumption point (a
+    drained/failed Prefetcher then yields StopIteration, never hangs).
+    The worker exits when the iterator ends, when ``close()`` is called,
+    or when the Prefetcher is garbage-collected (its queue puts poll a
+    stop flag, so an abandoned ``for``-loop cannot strand the thread
+    holding device-sized batches). Usable as a context manager.
     """
 
     _DONE = object()
@@ -76,33 +99,43 @@ class Prefetcher:
                 return shard_batch(b, mesh=mesh, axis=axis)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._dead = False
         self._thread = threading.Thread(
-            target=self._work, args=(iter(it), transfer), daemon=True)
+            target=_prefetch_worker,
+            args=(iter(it), transfer, self._q, self._stop, self._DONE),
+            daemon=True)
         self._thread.start()
-
-    def _work(self, it: Iterator, transfer: Callable) -> None:
-        try:
-            for batch in it:
-                if self._stop.is_set():
-                    return
-                self._q.put(transfer(batch))
-            self._q.put(self._DONE)
-        except BaseException as e:  # re-raised on the consumer side
-            self._q.put(e)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._dead:
+            raise StopIteration
         item = self._q.get()
         if item is self._DONE:
+            self._dead = True
             raise StopIteration
         if isinstance(item, BaseException):
+            self._dead = True  # next call: StopIteration, not a hang
             raise item
         return item
 
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # abandoned mid-loop: don't strand the worker
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def close(self) -> None:
         self._stop.set()
+        self._dead = True
         # Unblock a producer waiting on a full queue.
         try:
             while True:

@@ -106,13 +106,15 @@ class Bert(nn.Module):
         c = self.cfg
         if attn_mask is None:
             attn_mask = jnp.ones_like(tokens, bool)
+        # "embed_table" (→ no fsdp): gather/slice operands sharded over
+        # fsdp trigger SPMD involuntary rematerialization — see llama.py.
         emb = self.param("tok_embedding",
                          _part(nn.initializers.normal(0.02),
-                               ("vocab", "embed")),
+                               ("vocab", "embed_table")),
                          (c.vocab_size, c.dim), jnp.float32)
         pos = self.param("pos_embedding",
                          _part(nn.initializers.normal(0.02),
-                               ("seq", "embed")),
+                               ("seq", "embed_table")),
                          (c.max_seq_len, c.dim), jnp.float32)
         T = tokens.shape[1]
         x = jnp.take(emb, tokens, axis=0) + pos[None, :T]

@@ -35,8 +35,13 @@ LOGICAL_RULES = (
     ("batch", ("dp", "fsdp")),
     ("seq", "sp"),
     ("vocab", "tp"),
-    ("embed", None),
+    # ZeRO-3 role: params' embed dim shards over fsdp (sharded at rest;
+    # XLA inserts allgather-on-use / reducescatter-on-grad). Activations
+    # are unaffected: their specs already consume fsdp via "batch", and
+    # flax drops a rule whose mesh axis is taken within the same spec.
+    ("embed", "fsdp"),
     ("embed_fsdp", "fsdp"),
+    ("embed_table", None),
     ("heads", "tp"),
     ("kv_heads", "tp"),
     ("head_dim", None),
@@ -68,6 +73,14 @@ class LlamaConfig:
     # None = auto: Pallas flash attention on TPU, materialised softmax
     # elsewhere (interpret-mode Pallas is too slow for CPU test meshes).
     use_flash: "bool | None" = None
+    # Context parallelism for the attention itself (SURVEY.md §5.7 —
+    # capability the reference lacks). None: XLA handles the sp axis by
+    # gathering K/V (fine up to moderate T). "ring": blockwise ring
+    # attention — K/V rotate the ICI ring via ppermute, O(T/n) memory per
+    # device (parallel/ring.py). "ulysses": head-scatter all_to_all
+    # (parallel/ulysses.py; needs n_heads % sp == 0). Both engage only
+    # when the ambient mesh has an "sp" axis of size > 1.
+    attention_impl: "str | None" = None
 
 
 def llama3_8b() -> LlamaConfig:
@@ -97,6 +110,44 @@ def _remat(cls, policy_name: str):
 
 def _part(init, names):
     return nn.with_logical_partitioning(init, names)
+
+
+def _seq_parallel_attention(q, k, v, impl: str, scale: float):
+    """Context-parallel attention inside the GSPMD step: wraps the manual
+    ring/Ulysses collectives (which need a bound axis) in a ``shard_map``
+    over the AMBIENT mesh, so the sp axis becomes explicit exactly for the
+    attention while everything around it stays sharding-annotated.
+
+    Returns None when the ambient mesh has no sp axis (or sp == 1) —
+    caller falls through to the dense/flash path, so the same model config
+    runs anywhere."""
+    if impl not in ("ring", "ulysses"):
+        # Validate on EVERY mesh — a typo must not silently train dense on
+        # the dev box and explode on the production sp mesh.
+        raise ValueError(f"attention_impl {impl!r}: use None, 'ring' or "
+                         "'ulysses'")
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or "sp" not in mesh.axis_names or mesh.shape["sp"] == 1:
+        return None
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import ring_attention, ulysses_attention
+    batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    heads = "tp" if "tp" in mesh.axis_names else None
+    spec = P(batch or None, "sp", heads, None)
+    if impl == "ring":
+        def body(qb, kb, vb):
+            return ring_attention(qb, kb, vb, "sp", causal=True, scale=scale)
+    else:
+        def body(qb, kb, vb):
+            return ulysses_attention(qb, kb, vb, "sp", causal=True,
+                                     scale=scale)
+    return shard_map(body, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                     check_vma=False)(q, k, v)
 
 
 class RMSNorm(nn.Module):
@@ -156,7 +207,12 @@ class Attention(nn.Module):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
         scale = 1.0 / head_dim ** 0.5  # python float: static for the kernel
-        if _resolve_flash(c.use_flash, T):
+        o = None
+        if c.attention_impl is not None:
+            o = _seq_parallel_attention(q, k, v, c.attention_impl, scale)
+        if o is not None:
+            pass
+        elif _resolve_flash(c.use_flash, T):
             from ..ops.flash_attention import flash_attention
             o = flash_attention(q, k, v, causal=True, scale=scale)
         else:
@@ -220,8 +276,14 @@ def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
     """Shared decoder body (embedding → blocks → norm → lm head) used by
     Llama and Mixtral; called from inside a module's compact ``__call__`` so
     parameters stay flat under the calling module."""
+    # "embed_table", not "embed": the table feeds a gather (jnp.take), and
+    # an fsdp-sharded gather operand makes the SPMD partitioner replicate
+    # it anyway ("involuntary full rematerialization") — a per-step
+    # allgather with none of ZeRO's memory saving. Keep the table out of
+    # the fsdp rule; the matmul params carry it.
     emb = mdl.param("embedding",
-                    _part(nn.initializers.normal(0.02), ("vocab", "embed")),
+                    _part(nn.initializers.normal(0.02),
+                          ("vocab", "embed_table")),
                     (c.vocab_size, c.dim), jnp.float32)
     x = jnp.take(emb, tokens, axis=0).astype(c.dtype)
     x = nn_partitioning.with_sharding_constraint(x, ("batch", "seq", "embed"))

@@ -318,5 +318,8 @@ class StepAutotuner:
             dt = self._time.perf_counter() - self._t0
             self.tuner.report(self._current,
                               self.steps_per_trial / max(dt, 1e-9))
-            self._begin_trial()
+            if self.tuner.converged():
+                self._fn = None  # next step() locks in the best knobs
+            else:
+                self._begin_trial()
         return out

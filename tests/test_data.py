@@ -147,3 +147,39 @@ def test_dataset_tail_pads_to_equal_process_shards():
     allv = np.concatenate(seen)
     assert set(allv) == set(X)                        # nothing lost
     assert len(allv) == 44                            # 2 wrapped pads
+
+
+def test_prefetcher_stops_not_hangs_after_error():
+    def gen():
+        yield np.ones((hvd.size(), 1))
+        raise RuntimeError("boom")
+
+    p = Prefetcher(gen(), depth=1)
+    it = iter(p)
+    next(it)
+    with pytest.raises(RuntimeError):
+        next(it)
+    with pytest.raises(StopIteration):   # NOT a hang
+        next(it)
+
+
+def test_prefetcher_abandoned_loop_worker_exits():
+    import time
+
+    def gen():
+        for _ in range(10_000):
+            yield np.ones((hvd.size(), 1))
+
+    p = Prefetcher(gen(), depth=1)
+    for batch in p:
+        break                            # abandon mid-iteration
+    t = p._thread
+    p.close()                            # context-manager/__del__ path
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_prefetcher_context_manager():
+    batches = [np.ones((hvd.size(), 1))] * 3
+    with Prefetcher(batches) as p:
+        assert len(list(p)) == 3

@@ -85,13 +85,17 @@ hvd.init()
 assert jax.process_count() == 2
 
 # 1. in-graph allreduce over the 2-process global mesh
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 from jax.experimental import multihost_utils
+try:
+    from jax import shard_map
+    _kw = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+    _kw = {"check_rep": False}
 
 f = jax.jit(shard_map(lambda x: hvd.allreduce(x), mesh=hvd.mesh(),
-                      in_specs=P(hvd.RANK_AXIS), out_specs=P(),
-                      check_vma=False))
+                      in_specs=P(hvd.RANK_AXIS), out_specs=P(), **_kw))
 x = np.arange(hvd.size() * 2, dtype=np.float32).reshape(hvd.size(), 2)
 gx = multihost_utils.host_local_array_to_global_array(
     x[hvd.rank():hvd.rank() + 1], hvd.mesh(), P(hvd.RANK_AXIS))
