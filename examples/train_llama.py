@@ -56,6 +56,10 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--attention-impl", choices=["auto", "ring", "ulysses"],
+                   default="auto",
+                   help="context-parallel attention over the sp axis "
+                        "(docs/long-context.md); auto = dense/flash")
     args = p.parse_args()
 
     hvd.init()
@@ -66,6 +70,9 @@ def main():
     mesh = create_mesh({"dp": dp, "sp": args.sp, "tp": args.tp})
 
     cfg = MODELS[args.model]()
+    if args.attention_impl != "auto":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, attention_impl=args.attention_impl)
     model = Llama(cfg)
     opt = optax.adamw(args.lr, weight_decay=0.01)
 

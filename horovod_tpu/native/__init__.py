@@ -41,7 +41,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         lib = ctypes.CDLL(path)
         lib.hvd_runtime_abi_version.restype = ctypes.c_int
-        if lib.hvd_runtime_abi_version() != 2:
+        if lib.hvd_runtime_abi_version() != 3:
             return None
         # signatures
         lib.hvd_pool_create.restype = ctypes.c_void_p
@@ -67,6 +67,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.hvd_pipeline_error.restype = ctypes.c_char_p
         lib.hvd_pipeline_error.argtypes = [ctypes.c_void_p]
         lib.hvd_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_parallel_gather.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -257,4 +262,59 @@ class RecordPipeline:
             pass
 
 
-__all__ = ["NativeTimeline", "RecordPipeline", "available"]
+__all__ = ["NativeTimeline", "RecordPipeline", "available",
+           "parallel_gather"]
+
+
+def parallel_gather(src: np.ndarray, indices: np.ndarray,
+                    out: Optional[np.ndarray] = None,
+                    threads: int = 0) -> np.ndarray:
+    """``src[indices]`` along axis 0 (1-D integer ``indices``) with native
+    threaded memcpy.
+
+    The batch-assembly hot op of the input pipeline (the reference's
+    MEMCPY_IN role): ctypes releases the GIL, so gathering the next batch
+    overlaps device compute inside :class:`~horovod_tpu.data.Prefetcher`.
+    Falls back to numpy fancy indexing when the native lib is unavailable,
+    ``src`` is not plain C-contiguous numeric data, or ``indices`` uses
+    numpy-only semantics (negative values) — identical results either way.
+    """
+    indices = np.asarray(indices)
+    if indices.ndim != 1:
+        raise ValueError(f"indices must be 1-D, got shape {indices.shape}")
+    if not np.issubdtype(indices.dtype, np.integer):
+        raise TypeError(f"indices must be integers, got {indices.dtype}")
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    n = src.shape[0] if src.ndim else 0
+    if idx.size and (int(idx.max()) >= n or int(idx.min()) < -n):
+        raise IndexError(
+            f"index out of bounds for axis 0 with size {n}")
+    lib = _load()
+    use_native = (lib is not None and src.ndim >= 1
+                  and src.flags.c_contiguous and not src.dtype.hasobject
+                  and (not idx.size or int(idx.min()) >= 0))
+    if not use_native:
+        result = src[idx]
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+    row_bytes = src.dtype.itemsize
+    for d in src.shape[1:]:
+        row_bytes *= d
+    want_shape = (idx.shape[0],) + src.shape[1:]
+    if out is None:
+        out = np.empty(want_shape, dtype=src.dtype)
+    elif (out.shape != want_shape or out.dtype != src.dtype
+          or not out.flags.c_contiguous):
+        raise ValueError(
+            f"out must be C-contiguous {want_shape} {src.dtype}, got "
+            f"{out.shape} {out.dtype}")
+    if threads <= 0:
+        threads = min(8, os.cpu_count() or 1)
+    lib.hvd_parallel_gather(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        idx.shape[0], row_bytes,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), threads)
+    return out

@@ -368,10 +368,47 @@ class RecordPipeline {
 // C ABI
 // ---------------------------------------------------------------------------
 
+// ---------------------------------------------------------------------------
+// ParallelGather — fork-join row gather (batch assembly: dst[i] =
+// src[idx[i]]). The memcpy half of the reference's MEMCPY_IN_FUSION_BUFFER
+// stage, applied to the host input path; called from Python via ctypes
+// (which drops the GIL), so shuffle-gather overlaps device compute.
+// ---------------------------------------------------------------------------
+
+static void ParallelGather(const uint8_t* src, const long long* idx,
+                           long long n_idx, long long row_bytes,
+                           uint8_t* dst, int n_threads) {
+  long long total = n_idx * row_bytes;
+  int want = n_threads < 1 ? 1 : n_threads;
+  if (want > n_idx) want = static_cast<int>(n_idx > 0 ? n_idx : 1);
+  if (want == 1 || total < (1 << 24)) {  // <16MB: spawn costs more than the copy
+    for (long long i = 0; i < n_idx; ++i) {
+      memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+             static_cast<size_t>(row_bytes));
+    }
+    return;
+  }
+  std::vector<std::thread> ts;
+  long long per = (n_idx + want - 1) / want;
+  for (int t = 0; t < want; ++t) {
+    long long lo = t * per;
+    long long hi = std::min(n_idx, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back([src, idx, row_bytes, dst, lo, hi] {
+      for (long long i = lo; i < hi; ++i) {
+        memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+               static_cast<size_t>(row_bytes));
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+}
+
 extern "C" {
 
 // v2: hvd_pipeline_create seed widened to unsigned long long.
-int hvd_runtime_abi_version() { return 2; }
+// v3: hvd_parallel_gather.
+int hvd_runtime_abi_version() { return 3; }
 
 // -- thread pool (exposed for tests; the pipeline uses it internally) -------
 
@@ -433,6 +470,14 @@ const char* hvd_pipeline_error(void* p) {
 
 void hvd_pipeline_destroy(void* p) {
   delete static_cast<RecordPipeline*>(p);
+}
+
+// -- parallel gather --------------------------------------------------------
+
+void hvd_parallel_gather(const uint8_t* src, const long long* idx,
+                         long long n_idx, long long row_bytes,
+                         uint8_t* dst, int n_threads) {
+  ParallelGather(src, idx, n_idx, row_bytes, dst, n_threads);
 }
 
 }  // extern "C"

@@ -78,6 +78,22 @@ def test_llama_context_parallel_attention_matches_dense(impl):
     assert cp[-1] < cp[0]
 
 
+def test_mixtral_ring_attention_with_expert_parallel():
+    """Ring attention composes with MoE expert dispatch: dp2 x sp2 x ep2
+    mesh, attention_impl='ring' — the shard_map attention island and the
+    alltoall expert exchange live in one compiled step."""
+    from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+
+    t = toks(batch=2, seq=32)
+    mesh = create_mesh({"dp": 2, "sp": 2, "ep": 2})
+    cfg = dataclasses.replace(mixtral_tiny(), attention_impl="ring")
+    losses, _ = train_losses(Mixtral(cfg), mesh, tokens=t,
+                             aux_weight=cfg.router_aux_weight)
+    dense, _ = train_losses(Mixtral(mixtral_tiny()), mesh, tokens=t,
+                            aux_weight=mixtral_tiny().router_aux_weight)
+    np.testing.assert_allclose(losses, dense, rtol=3e-4)
+
+
 def test_llama_parity_across_meshes():
     """Same seed, same data: dp8 mesh == dp2×sp2×tp2 mesh == 1-device.
     Sharding must never change the math."""

@@ -90,3 +90,56 @@ def test_record_pipeline_large_seed_parity(tmp_path):
         paths, (6,), np.float32, batch_size=16, shuffle=True, seed=big,
         force_fallback=True)))
     np.testing.assert_array_equal(a, b)
+
+
+def test_parallel_gather_matches_numpy_all_dtypes():
+    from horovod_tpu import native
+
+    rng = np.random.RandomState(0)
+    for dtype, shape in [(np.float32, (128, 33)), (np.int8, (64, 7, 5)),
+                         (np.float64, (32,)), (np.uint8, (256, 3000))]:
+        src = rng.randint(0, 100, size=shape).astype(dtype)
+        idx = rng.randint(0, shape[0], 50)
+        np.testing.assert_array_equal(native.parallel_gather(src, idx),
+                                      src[idx])
+
+
+def test_parallel_gather_large_threaded_path():
+    from horovod_tpu import native
+
+    rng = np.random.RandomState(1)
+    src = rng.randn(512, 70000).astype(np.float32)   # >16MB gather
+    idx = rng.randint(0, 512, 128)
+    out = np.empty((128, 70000), np.float32)
+    res = native.parallel_gather(src, idx, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_parallel_gather_non_contiguous_falls_back():
+    from horovod_tpu import native
+
+    src = np.arange(200).reshape(20, 10)[:, ::2]     # not C-contiguous
+    idx = np.asarray([3, 1, 7])
+    np.testing.assert_array_equal(native.parallel_gather(src, idx),
+                                  src[idx])
+
+
+def test_parallel_gather_validates_inputs():
+    from horovod_tpu import native
+
+    src = np.arange(20, dtype=np.float32).reshape(10, 2)
+    with pytest.raises(IndexError):
+        native.parallel_gather(src, np.asarray([0, 10]))
+    with pytest.raises(IndexError):
+        native.parallel_gather(src, np.asarray([-11]))
+    with pytest.raises(ValueError, match="1-D"):
+        native.parallel_gather(src, np.zeros((2, 2), np.int64))
+    with pytest.raises(TypeError):
+        native.parallel_gather(src, np.asarray([0.5]))
+    with pytest.raises(ValueError, match="out must be"):
+        native.parallel_gather(src, np.asarray([1, 2]),
+                               out=np.empty((3, 2), np.float32))
+    # negative indices take the numpy-fallback path, numpy semantics
+    np.testing.assert_array_equal(
+        native.parallel_gather(src, np.asarray([-1, 0])), src[[-1, 0]])
