@@ -103,23 +103,44 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                 process_id=pid, **kw)
         cfg = config or Config.from_env()
         if "HOROVOD_FUSION_THRESHOLD" in os.environ:
-            # Best-effort: forward the fusion threshold to XLA's collective
-            # combiner. XLA_FLAGS is read at backend init, so this only takes
-            # effect if the backend is not yet up (e.g. init() before first
-            # computation, or a launcher exporting it pre-spawn).
-            flags = os.environ.get("XLA_FLAGS", "")
-            add = [f for f in cfg.xla_combiner_flags() if f not in flags]
-            if add:
-                os.environ["XLA_FLAGS"] = (flags + " " + " ".join(add)).strip()
+            # Forward the fusion threshold to XLA's collective combiner —
+            # OPT-IN via HOROVOD_FUSION_APPLY_XLA_FLAGS=1: XLA aborts the
+            # process (F-level, uncatchable) on any flag name its build
+            # does not know, and the combiner flag names vary by backend/
+            # version (both backends of this image reject them — measured;
+            # in-graph fusion via grouped ops is the default mechanism,
+            # docs/tensor-fusion.md).
+            if os.environ.get("HOROVOD_FUSION_APPLY_XLA_FLAGS", "") in (
+                    "1", "true", "yes", "on"):
+                flags = os.environ.get("XLA_FLAGS", "")
+                add = [f for f in cfg.xla_combiner_flags()
+                       if f not in flags]
+                if add:
+                    os.environ["XLA_FLAGS"] = (
+                        flags + " " + " ".join(add)).strip()
+                    get_logger().info(
+                        "forwarded HOROVOD_FUSION_THRESHOLD=%d to XLA "
+                        "combiner flags (effective only if the XLA backend "
+                        "was not yet initialized)",
+                        cfg.fusion_threshold_bytes)
+            else:
                 get_logger().info(
-                    "forwarded HOROVOD_FUSION_THRESHOLD=%d to XLA combiner "
-                    "flags (effective only if the XLA backend was not yet "
-                    "initialized)", cfg.fusion_threshold_bytes)
+                    "HOROVOD_FUSION_THRESHOLD=%d recorded (gradient fusion "
+                    "is in-graph via grouped ops; set "
+                    "HOROVOD_FUSION_APPLY_XLA_FLAGS=1 to also emit XLA "
+                    "combiner flags if your XLA build supports them)",
+                    cfg.fusion_threshold_bytes)
         timeline = None
         if cfg.timeline_path:
             from ..tools.timeline import Timeline
             timeline = Timeline(cfg.timeline_path,
                                 mark_cycles=cfg.timeline_mark_cycles)
+            timeline.marker("INIT")
+            # Close (flush events + the closing bracket) even when the
+            # script never calls shutdown() — the reference's timeline is
+            # usable after abnormal exits for the same reason.
+            import atexit
+            atexit.register(timeline.close)
         devs = list(devices) if devices is not None else jax.devices()
         mesh = Mesh(np.asarray(devs), (axis_name,))
         ctx = Context(mesh, cfg, axis_name)

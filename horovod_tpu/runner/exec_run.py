@@ -78,6 +78,11 @@ def get_run_env(a: HostAssignment, settings: Settings,
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(BLOCKED_ENV)}
     env.update(settings.env)
+    if env.get("HOROVOD_TIMELINE") and a.num_processes > 1:
+        # One trace file PER WORKER: multi-host runs over a shared FS would
+        # otherwise truncate and interleave one file into invalid JSON.
+        root, ext = os.path.splitext(env["HOROVOD_TIMELINE"])
+        env["HOROVOD_TIMELINE"] = f"{root}.rank{a.process_id}{ext or '.json'}"
     env.update(assignment_env(a, coordinator_addr, settings.start_timeout_s))
     if secret_key is not None:
         env[secret.ENV_VAR] = secret.encode(secret_key)

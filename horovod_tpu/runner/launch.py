@@ -55,6 +55,41 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--mpi", "--use-mpi", action="store_true",
                    dest="use_mpi", help=argparse.SUPPRESS)
     p.add_argument("--mpi-args", dest="mpi_args", help=argparse.SUPPRESS)
+    # Tuning knobs (reference launch.py: CLI flags mirror the HOROVOD_*
+    # env surface, CLI > env > default — SURVEY.md §5.6). Each maps to the
+    # env var of the same name in the WORKERS' environment.
+    p.add_argument("--fusion-threshold-mb", type=int,
+                   dest="fusion_threshold_mb",
+                   help="gradient fusion buffer size (feeds the XLA "
+                        "collective combiner; docs/tensor-fusion.md)")
+    p.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms",
+                   help="accepted for compatibility (no negotiation cycle "
+                        "exists here)")
+    p.add_argument("--cache-capacity", type=int, dest="cache_capacity",
+                   help="accepted for compatibility (no response cache)")
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   dest="hierarchical_allreduce", help=argparse.SUPPRESS)
+    p.add_argument("--hierarchical-allgather", action="store_true",
+                   dest="hierarchical_allgather", help=argparse.SUPPRESS)
+    p.add_argument("--timeline-filename", dest="timeline_filename",
+                   help="write a chrome-trace timeline per worker "
+                        "(HOROVOD_TIMELINE)")
+    p.add_argument("--timeline-mark-cycles", action="store_true",
+                   dest="timeline_mark_cycles")
+    p.add_argument("--autotune", action="store_true",
+                   help="enable the BO autotuner (HOROVOD_AUTOTUNE)")
+    p.add_argument("--autotune-log-file", dest="autotune_log_file",
+                   help="CSV trial log (HOROVOD_AUTOTUNE_LOG)")
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"],
+                   help="worker log level (HOROVOD_LOG_LEVEL)")
+    p.add_argument("--no-stall-check", action="store_true",
+                   dest="no_stall_check")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   dest="stall_check_warning_time_seconds")
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   dest="stall_check_shutdown_time_seconds")
     # Elastic (reference: _run_elastic)
     p.add_argument("--min-np", type=int, dest="min_np")
     p.add_argument("--max-np", type=int, dest="max_np")
@@ -95,7 +130,10 @@ Available features:
 # Launcher flags that take NO value — the pre-scan below needs this to know
 # where the launcher's flags end and the user command begins.
 _NO_VALUE_FLAGS = {"--check-build", "-v", "--verbose", "-h", "--help",
-                   "--gloo", "--use-gloo", "--mpi", "--use-mpi"}
+                   "--gloo", "--use-gloo", "--mpi", "--use-mpi",
+                   "--hierarchical-allreduce", "--hierarchical-allgather",
+                   "--timeline-mark-cycles", "--autotune",
+                   "--no-stall-check"}
 
 
 def _own_config_file(argv: List[str]) -> Optional[str]:
@@ -142,10 +180,21 @@ def _apply_config_file(parser: argparse.ArgumentParser,
                 flat[str(k).replace("-", "_")] = v
 
     walk(raw)
-    valid = {a.dest for a in parser._actions}
-    unknown = set(flat) - valid
+    actions = {a.dest: a for a in parser._actions}
+    unknown = set(flat) - set(actions)
     if unknown:
         raise SystemExit(f"--config-file: unknown keys {sorted(unknown)}")
+    for k, v in list(flat.items()):
+        # argparse applies `type` only to CLI tokens; coerce file values
+        # the same way so a quoted number cannot leak through as str (and
+        # a YAML int lands as the action's float where it expects one).
+        t = actions[k].type
+        if t is not None and v is not None and not isinstance(v, bool):
+            try:
+                flat[k] = t(str(v))
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"--config-file: bad value for {k!r}: {v!r}")
     post = {}
     for action in parser._actions:
         if isinstance(action, argparse._CountAction) \
@@ -154,6 +203,43 @@ def _apply_config_file(parser: argparse.ArgumentParser,
                                  action.default or 0)
     parser.set_defaults(**flat)
     return post
+
+
+def _tuning_env(args) -> dict:
+    """Flag → worker-env mapping (reference launch.py config_parser role).
+    Only explicitly-given flags produce entries, so env vars already set by
+    the operator keep working (CLI > env > default)."""
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            args.fusion_threshold_mb * 1024 * 1024)
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.hierarchical_allgather:
+        env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.no_stall_check:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_check_shutdown_time_seconds)
+    return env
 
 
 def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
@@ -181,7 +267,8 @@ def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
         hosts_str = detect_hosts()
     hosts = parse_hosts(hosts_str) if hosts_str else []
     elastic = bool(args.host_discovery_script or args.min_np or args.max_np)
-    s = Settings(num_proc=args.np, hosts=hosts,
+    env = _tuning_env(args)
+    s = Settings(num_proc=args.np, hosts=hosts, env=env,
                  ssh_port=args.ssh_port,
                  ssh_identity_file=args.ssh_identity_file,
                  start_timeout_s=args.start_timeout,

@@ -40,6 +40,7 @@ class Timeline:
             os.makedirs(d, exist_ok=True)
         self._file = open(path, "w")
         self._file.write("[\n")
+        self._file.flush()  # header visible even if the process dies early
         self._first = True
         self._writer = threading.Thread(target=self._drain, daemon=True,
                                         name="hvd-timeline-writer")

@@ -147,3 +147,19 @@ def test_hvdrun_two_process_collectives(tmp_path):
         assert out["torch_ar"] == 1.5                   # mean of 1, 2
         assert out["torch_ag"] == [0, 1]
         assert [tuple(x) for x in out["torch_objs"]] == [("r", 0), ("r", 1)]
+
+
+@pytest.mark.integration
+def test_hvdrun_timeline_flag_reaches_worker(tmp_path):
+    """--timeline-filename → HOROVOD_TIMELINE in the worker env → init
+    writes a chrome trace (reference: horovodrun --timeline-filename)."""
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    trace = tmp_path / "t.json"
+    r = _run_hvdrun(["-np", "1", "-H", "localhost:1",
+                     "--timeline-filename", str(trace),
+                     sys.executable, str(script)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert trace.exists()
+    text = trace.read_text()
+    assert '"traceEvents"' in text or text.strip().startswith("[")

@@ -355,3 +355,54 @@ def test_config_file_count_flag_merges_not_stacks(tmp_path):
     s2, _ = parse_settings(["--config-file", str(cfg), "-np", "2",
                             "python", "x.py"])
     assert s2.verbose == 2
+
+
+def test_parse_settings_tuning_flags_map_to_worker_env():
+    s, cmd = parse_settings([
+        "-np", "2", "-H", "localhost:2",
+        "--fusion-threshold-mb", "128", "--timeline-filename", "/tmp/t.json",
+        "--timeline-mark-cycles", "--autotune",
+        "--autotune-log-file", "/tmp/a.csv", "--log-level", "DEBUG",
+        "--no-stall-check", "--stall-check-warning-time-seconds", "30",
+        "python", "t.py"])
+    assert cmd == ["python", "t.py"]
+    assert s.env["HOROVOD_FUSION_THRESHOLD"] == str(128 << 20)
+    assert s.env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+    assert s.env["HOROVOD_TIMELINE_MARK_CYCLES"] == "1"
+    assert s.env["HOROVOD_AUTOTUNE"] == "1"
+    assert s.env["HOROVOD_AUTOTUNE_LOG"] == "/tmp/a.csv"
+    assert s.env["HOROVOD_LOG_LEVEL"] == "DEBUG"
+    assert s.env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+    assert s.env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30.0"
+    # no accidental entries for flags not given
+    assert "HOROVOD_CYCLE_TIME" not in s.env
+
+
+def test_parse_settings_no_tuning_flags_empty_env():
+    s, _ = parse_settings(["-np", "1", "-H", "localhost:1", "python", "x"])
+    assert s.env == {}
+
+
+def test_config_file_accepts_documented_tuning_keys(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("stall-check-warning-time-seconds: 30\n"
+                   "fusion-threshold-mb: \"128\"\n")   # quoted on purpose
+    s, _ = parse_settings(["--config-file", str(cfg), "-np", "1",
+                           "-H", "localhost:1", "python", "x"])
+    assert s.env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30.0"
+    assert s.env["HOROVOD_FUSION_THRESHOLD"] == str(128 << 20)
+
+
+def test_timeline_path_is_per_worker_on_multihost():
+    from horovod_tpu.runner.exec_run import get_run_env
+    from horovod_tpu.runner.hosts import HostAssignment
+
+    s = Settings(num_proc=2, env={"HOROVOD_TIMELINE": "/tmp/t.json"})
+    a1 = HostAssignment(hostname="a", process_id=1, num_processes=2,
+                        first_rank=1, local_size=1, world_size=2)
+    env = get_run_env(a1, s, "a:1")
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.rank1.json"
+    a0 = HostAssignment(hostname="a", process_id=0, num_processes=1,
+                        first_rank=0, local_size=1, world_size=1)
+    env0 = get_run_env(a0, s, "a:1")
+    assert env0["HOROVOD_TIMELINE"] == "/tmp/t.json"   # single proc: as-is
