@@ -34,7 +34,8 @@ from .mpi_ops import (ProcessSet, add_process_set, allgather,
                       grouped_allreduce_async, grouped_allreduce_async_,
                       init, is_initialized, join, local_rank, local_size,
                       poll, rank, reducescatter, reducescatter_async,
-                      remove_process_set, shutdown, size, synchronize)
+                      remove_process_set, shutdown, size,
+                      sparse_allreduce_async, synchronize)
 from .optimizer import DistributedOptimizer
 from .sync_batch_norm import SyncBatchNorm
 

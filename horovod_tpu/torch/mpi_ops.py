@@ -322,6 +322,41 @@ def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
     return Average
 
 
+def sparse_allreduce_async(tensor: torch.Tensor, op: str = Average,
+                           name: Optional[str] = None) -> int:
+    """Allreduce a sparse COO tensor via the reference's gather-based
+    scheme (``horovod/torch/optimizer.py`` ``_sparse_allreduce_async``):
+    allgather (indices, values) across ranks — nnz may differ per rank,
+    the engines' ragged allgather handles it — then rebuild;
+    ``coalesce()`` sums duplicate coordinates, which IS the reduction.
+    Only Sum/Average make sense for sparse.
+
+    NOT in place (sparse storage cannot be swapped under a live tensor):
+    the reduced tensor is ``synchronize(handle)``'s RETURN VALUE — assign
+    it, e.g. ``p.grad = hvd.synchronize(h)``; the input is untouched."""
+    if op not in (Sum, Average):
+        raise ValueError(f"sparse allreduce supports Sum/Average, got {op}")
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async needs a sparse tensor")
+    rt = _rt()
+    n = rt.engine.size()
+
+    def run(nm):
+        t = tensor.coalesce()
+        idx = t.indices().t().contiguous().cpu().numpy()  # [nnz, ndim]
+        vals = t.values().contiguous()
+        if op == Average:
+            vals = vals / n
+        g_idx = rt.engine.allgather(f"{nm}.idx", idx)
+        g_vals = rt.engine.allgather(f"{nm}.vals", _to_np(vals))
+        return torch.sparse_coo_tensor(
+            torch.from_numpy(np.ascontiguousarray(g_idx.T)),
+            torch.from_numpy(np.ascontiguousarray(g_vals)).to(
+                tensor.dtype),
+            t.shape).coalesce().to(tensor.device)
+    return rt.submit("sparse_allreduce", name, run)
+
+
 # --- allgather --------------------------------------------------------------
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,

@@ -26,11 +26,13 @@ from .engine import Adasum, Average, Sum
 
 class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step, op, gradient_predivide_factor):
+                 backward_passes_per_step, op, gradient_predivide_factor,
+                 sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._op = op
         self._gradient_predivide_factor = gradient_predivide_factor
+        self._sparse_as_dense = sparse_as_dense
         self.backward_passes_per_step = backward_passes_per_step
 
         if named_parameters is not None:
@@ -44,6 +46,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         self._handles = {}
         self._passes = {}
+        self._sparse_params = {}  # param -> sparse_dim of its grads
         self._should_synchronize = True
         self._synchronized = False
         if _ops.size() > 1:
@@ -69,6 +72,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p):
         name = self._param_names.get(p)
         grad = p.grad
+        if grad.is_sparse:
+            if self._sparse_as_dense:
+                p.grad = grad = grad.to_dense()
+            else:
+                self._sparse_params[p] = grad.sparse_dim()
+                # Gather-based sparse allreduce (reference
+                # _sparse_allreduce_async); synchronize() assigns the
+                # rebuilt tensor back to p.grad.
+                if self.backward_passes_per_step > 1:
+                    p.grad = grad = torch.sparse_coo_tensor(
+                        grad._indices(),
+                        grad._values() / self.backward_passes_per_step,
+                        grad.shape)
+                return ("sparse", p,
+                        _ops.sparse_allreduce_async(grad, op=self._op,
+                                                    name=name))
         if self.backward_passes_per_step > 1:
             grad.div_(self.backward_passes_per_step)
         if self._op == Average and self._gradient_predivide_factor != 1.0:
@@ -96,10 +115,27 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         if self._passes.get(p, 0) != 0:
                             continue  # mid local aggregation: not due yet
                         if p.grad is None:
-                            p.grad = torch.zeros_like(p)
+                            # Fill-in must match the collective the OTHER
+                            # ranks issued for this param: a sparse-grad
+                            # param gets an EMPTY sparse contribution, not
+                            # dense zeros (a dense allreduce would never
+                            # rendezvous with their indices/values
+                            # allgathers — deadlock).
+                            sd = self._sparse_params.get(p)
+                            if sd is not None and not self._sparse_as_dense:
+                                p.grad = torch.sparse_coo_tensor(
+                                    torch.zeros((sd, 0), dtype=torch.int64),
+                                    torch.zeros((0,) + p.shape[sd:],
+                                                dtype=p.dtype),
+                                    p.shape)
+                            else:
+                                p.grad = torch.zeros_like(p)
                         self._handles[p] = self._allreduce_grad_async(p)
             for p, handle in list(self._handles.items()):
-                _ops.synchronize(handle)
+                if isinstance(handle, tuple) and handle[0] == "sparse":
+                    p.grad = _ops.synchronize(handle[2])
+                else:
+                    _ops.synchronize(handle)
             self._handles.clear()
         self._synchronized = True
 
@@ -134,9 +170,14 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: str = Average,
-                         gradient_predivide_factor: float = 1.0):
+                         gradient_predivide_factor: float = 1.0,
+                         sparse_as_dense: bool = False):
     """Wrap ``optimizer`` so gradients are allreduced across ranks during
-    ``loss.backward()`` (reference ``hvd.DistributedOptimizer``)."""
+    ``loss.backward()`` (reference ``hvd.DistributedOptimizer``).
+
+    ``sparse_as_dense`` densifies sparse gradients (``nn.Embedding(
+    sparse=True)``) before the allreduce; when False they go through the
+    gather-based sparse allreduce (reference semantics)."""
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
@@ -147,4 +188,5 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, op, gradient_predivide_factor)
+               backward_passes_per_step, op, gradient_predivide_factor,
+               sparse_as_dense)

@@ -626,3 +626,92 @@ def test_torch_state_sync_broadcasts_rank0():
     assert outs[0][0] == outs[1][0] == 0
     for p0, p1 in zip(outs[0][1], outs[1][1]):
         assert torch.equal(p0, p1)
+
+
+# --- sparse gradients -------------------------------------------------------
+
+def test_sparse_allreduce_matches_dense_sum():
+    n = 2
+
+    def fn(r):
+        dense = torch.zeros(6, 3)
+        dense[r] = 1.0 + r          # rank-distinct rows
+        dense[4] = 2.0              # overlapping row: coalesce must SUM
+        sp = dense.to_sparse_coo()
+        out = hvd.synchronize(hvd.sparse_allreduce_async(sp, op="sum",
+                                                         name="sp"))
+        return out.to_dense()
+
+    for out in run_parallel(n, fn):
+        expect = torch.zeros(6, 3)
+        expect[0] = 1.0
+        expect[1] = 2.0
+        expect[4] = 4.0             # 2.0 from each rank
+        torch.testing.assert_close(out, expect)
+
+
+@pytest.mark.parametrize("sparse_as_dense", [False, True])
+def test_distributed_optimizer_sparse_embedding(sparse_as_dense):
+    """nn.Embedding(sparse=True) grads flow through the sparse path and all
+    ranks converge to identical weights, matching a dense-grad run."""
+    n = 2
+
+    def fit(rank, sparse):
+        emb = torch.nn.Embedding(8, 4, sparse=sparse)
+        with torch.no_grad():
+            # Deterministic init WITHOUT the global RNG: rank threads run
+            # concurrently, so manual_seed would interleave draws.
+            emb.weight.copy_(torch.arange(32, dtype=torch.float32)
+                             .reshape(8, 4) / 10)
+        opt = torch.optim.SGD(emb.parameters(), lr=0.1)
+        hvd.broadcast_parameters(emb.state_dict(), root_rank=0)
+        dopt = hvd.DistributedOptimizer(
+            opt, named_parameters=emb.named_parameters(),
+            sparse_as_dense=sparse_as_dense and sparse)
+        for step in range(3):
+            dopt.zero_grad()
+            ids = torch.tensor([rank, 2 + rank, 5])  # rank-distinct + shared
+            loss = emb(ids).sum()
+            loss.backward()
+            dopt.step()
+        return emb.weight.detach().clone()
+
+    sparse_out = run_parallel(n, lambda r: fit(r, True))
+    torch.testing.assert_close(sparse_out[0], sparse_out[1])
+    dense_out = run_parallel(n, lambda r: fit(r, False))
+    torch.testing.assert_close(sparse_out[0], dense_out[0])
+
+
+def test_sparse_param_unused_on_one_rank_no_deadlock():
+    """Rank 1 skips the sparse embedding for a step: its fill-in must be an
+    EMPTY sparse contribution (same collective type as rank 0), not dense
+    zeros — and both ranks still agree afterwards."""
+    n = 2
+
+    def fit(rank):
+        emb = torch.nn.Embedding(6, 3, sparse=True)
+        with torch.no_grad():
+            emb.weight.copy_(torch.arange(18, dtype=torch.float32)
+                             .reshape(6, 3))
+        lin = torch.nn.Linear(3, 1)
+        with torch.no_grad():
+            lin.weight.fill_(0.5)
+            lin.bias.zero_()
+        params = list(emb.parameters()) + list(lin.parameters())
+        opt = torch.optim.SGD(params, lr=0.1)
+        dopt = hvd.DistributedOptimizer(
+            opt, named_parameters=(list(emb.named_parameters())
+                                   + list(lin.named_parameters())))
+        for step in range(2):
+            dopt.zero_grad()
+            if rank == 0 or step == 0:       # rank 1 skips emb on step 1
+                loss = lin(emb(torch.tensor([rank, 3]))).sum()
+            else:
+                loss = lin(torch.ones(2, 3)).sum()
+            loss.backward()
+            dopt.step()
+        return emb.weight.detach().clone(), lin.weight.detach().clone()
+
+    outs = run_parallel(n, fit)
+    torch.testing.assert_close(outs[0][0], outs[1][0])
+    torch.testing.assert_close(outs[0][1], outs[1][1])
