@@ -59,7 +59,8 @@ def slope_time(run, s_short: int = S_SHORT, s_long: int = S_LONG,
 
 
 def slope_time_paired(runs: dict, s_short: int = S_SHORT,
-                      s_long: int = S_LONG, rounds: int = 7) -> dict:
+                      s_long: int = S_LONG, rounds: int = 7,
+                      return_rounds: bool = False):
     """``slope_time`` for several configs at once, interleaved.
 
     Measuring config A's repeats and then config B's lets slow drift in the
@@ -68,21 +69,55 @@ def slope_time_paired(runs: dict, s_short: int = S_SHORT,
     once, in round-robin order, so drift is shared; the min over rounds per
     cell then cancels spike noise as in ``slope_time``. Returns
     ``{name: seconds-per-unit}``.
+
+    ``return_rounds=True`` additionally returns the PER-ROUND slopes
+    (``[{name: sec-per-unit}, ...]``): for A/B *ratios* take the median of
+    per-round ratios — the min-over-rounds slopes may pair config A's
+    quietest window with a different window of B's, skewing the ratio
+    under bursty contention (measured: ratio read 0.88 in contended
+    windows vs 1.00 quiet with min-pairing; round-local ratios stay ~1.0).
     """
     for fn in runs.values():  # warm all compiles before any timing
         fn(s_short)
         fn(s_long)
     best: dict = {(name, k): float("inf")
                   for name in runs for k in (s_short, s_long)}
+    per_round = []
     for _ in range(rounds):
+        times = {}
         for name, fn in runs.items():
             for k in (s_short, s_long):
                 t0 = time.perf_counter()
                 fn(k)
                 dt = time.perf_counter() - t0
+                times[(name, k)] = dt
                 best[(name, k)] = min(best[(name, k)], dt)
-    return {name: max(best[(name, s_long)] - best[(name, s_short)], 1e-9)
-            / (s_long - s_short) for name in runs}
+        per_round.append(
+            {name: max(times[(name, s_long)] - times[(name, s_short)], 1e-9)
+             / (s_long - s_short) for name in runs})
+    slopes = {name: max(best[(name, s_long)] - best[(name, s_short)], 1e-9)
+              / (s_long - s_short) for name in runs}
+    if return_rounds:
+        return slopes, per_round
+    return slopes
+
+
+def median_ratio(rounds, num: str, den: str) -> float:
+    """Median over rounds of ``slope[num]/slope[den]`` (statistics.median:
+    averages the middle pair for even counts — a 2-round sample must not
+    degenerate to max-pick). Rounds where either slope hit the 1e-9
+    negative-clamp (timing jitter made long < short) are invalid — a
+    clamped denominator would read as a ~1e9 ratio; falls back to the
+    ratio of per-config MIN slopes when no round is clean.
+    """
+    import statistics
+    valid = [r[num] / r[den] for r in rounds
+             if r[num] > 2e-9 and r[den] > 2e-9]
+    if valid:
+        return float(statistics.median(valid))
+    best_n = min(r[num] for r in rounds)
+    best_d = min(r[den] for r in rounds)
+    return best_n / best_d
 
 
 def emit(metric: str, value: float, unit: str,

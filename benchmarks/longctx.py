@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from common import emit, on_tpu, slope_time, sync
+from common import emit, median_ratio, on_tpu, slope_time_paired, sync
 
 
 def main():
@@ -54,30 +54,33 @@ def main():
 
     loss_fn = next_token_loss  # the shared shifted-xent objective
 
-    results = {}
+    s_short, s_long = (2, 8) if tpu else (1, 3)
+    runs = {}
     for name, flash in (("flash", True), ("materialised", False)):
         model = Llama(dataclasses.replace(cfg, use_flash=flash))
         dopt = distributed(optax.adamw(1e-4))
         state = create_train_state(model, jax.random.PRNGKey(0),
                                    tokens[:1], dopt)
-        s_short, s_long = (2, 8) if tpu else (1, 3)
         steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
                                     donate=False)
                  for k in (s_short, s_long)}
 
-        def run(k):
-            _, loss = steps[k](state, tokens, labels)
+        def run(k, _steps=steps, _state=state):
+            _, loss = _steps[k](_state, tokens, labels)
             sync(loss)
+        runs[name] = run
 
-        sec = slope_time(run, s_short, s_long,
-                         repeats=5 if tpu else 2)
-        results[name] = batch * seq / sec
-
+    # Interleaved rounds; the A/B ratio is the median of round-local
+    # ratios (robust to contended bursts — common.slope_time_paired).
+    sec, rounds = slope_time_paired(runs, s_short, s_long,
+                                    rounds=5 if tpu else 2,
+                                    return_rounds=True)
     emit("longctx_llama_tokens_per_sec_per_chip",
-         round(results["flash"] / n, 3),
+         round(batch * seq / sec["flash"] / n, 3),
          f"tokens/sec/chip ({cfg.dim}d x {cfg.n_layers}L, seq {seq}, "
          f"flash attention, {n} devices)",
-         vs_baseline=round(results["flash"] / results["materialised"], 4))
+         vs_baseline=round(median_ratio(rounds, "materialised", "flash"),
+                           4))
 
 
 if __name__ == "__main__":

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from common import emit, on_tpu, slope_time_paired, sync, S_SHORT, S_LONG
+from common import (emit, median_ratio, on_tpu, slope_time_paired,
+                    sync, S_SHORT, S_LONG)
 
 
 def main():
@@ -73,14 +74,16 @@ def main():
         _, loss = plains[k](pstate, x1, y1)
         sync(loss)
 
-    sec = slope_time_paired({"hvd": run, "plain": run1})
+    sec, rounds = slope_time_paired({"hvd": run, "plain": run1},
+                                    return_rounds=True)
     ips = batch / sec["hvd"]
-    ips1 = per_chip / sec["plain"]
+    # Median of round-local ratios: robust to contended bursts (see
+    # common.median_ratio).
+    eff = median_ratio(rounds, "plain", "hvd")
     emit("resnet50_images_per_sec_per_chip", ips / n,
          f"images/sec/chip (batch {per_chip}/chip, {n} devices)")
-    emit("resnet50_scaling_efficiency", (ips / n) / ips1,
-         f"per-chip throughput vs 1-device plain JAX ({n} devices)",
-         (ips / n) / ips1)
+    emit("resnet50_scaling_efficiency", eff,
+         f"per-chip throughput vs 1-device plain JAX ({n} devices)", eff)
 
 
 if __name__ == "__main__":
