@@ -14,13 +14,14 @@ three host-side pieces a training loop needs, TPU-shaped:
   (the ``flax`` ``prefetch_to_device`` idiom, made mesh-aware).
 - :class:`Dataset` — minimal array dataset: per-process sharding by
   ``cross_rank`` (the reference's ``DistributedSampler`` role), epoch
-  shuffling, drop-last batching; composes with
-  :class:`~horovod_tpu.elastic.ElasticSampler` for elastic runs.
+  shuffling, drop-last batching.
+- :func:`sampler_batches` — the elastic glue: batches an
+  :class:`~horovod_tpu.elastic.ElasticSampler`'s local shard and records
+  progress, so commit/restore resumes mid-epoch after membership changes.
 """
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -223,3 +224,35 @@ def _leaves(tree):
 def _map_leaves(fn, tree):
     import jax
     return jax.tree_util.tree_map(lambda a: fn(np.asarray(a)), tree)
+
+
+def sampler_batches(sampler, arrays: Any, local_batch: int, *,
+                    drop_last: bool = True):
+    """Iterate an :class:`~horovod_tpu.elastic.ElasticSampler`'s LOCAL
+    shard as host batches — the elastic-training input glue: the sampler
+    owns ordering (commit/restore survives membership changes), this
+    yields ``local_batch``-sized pytree slices via the native gather.
+
+    Progress recording is the TRAINING LOOP's job, after the step that
+    actually consumed the batch (the reference contract:
+    ``sampler.record_batch(step, batch_size)`` then ``state.commit()``).
+    Recording here at production time would mark batches sitting in a
+    :class:`Prefetcher` queue as processed — a commit then persists
+    untrained examples as done, and an elastic restore silently skips
+    them.
+
+    Compose::
+
+        for i, b in enumerate(Prefetcher(sampler_batches(s, (X, Y), 32))):
+            state, loss = step(state, *b)
+            s.record_batch(i, 32)
+            st.commit()
+    """
+    from . import native
+
+    idx = np.asarray(list(sampler), dtype=np.int64)
+    steps = len(idx) // local_batch if drop_last \
+        else -(-len(idx) // local_batch)
+    for s in range(steps):
+        sel = idx[s * local_batch:(s + 1) * local_batch]
+        yield _map_leaves(lambda a: native.parallel_gather(a, sel), arrays)
