@@ -32,6 +32,7 @@ from .mpi_ops import (ProcessSet, add_process_set, allgather,
                       grouped_allgather_async,
                       grouped_allreduce, grouped_allreduce_,
                       grouped_allreduce_async, grouped_allreduce_async_,
+                      grouped_reducescatter, grouped_reducescatter_async,
                       init, is_initialized, join, local_rank, local_size,
                       poll, rank, reducescatter, reducescatter_async,
                       remove_process_set, shutdown, size,

@@ -468,6 +468,27 @@ def reducescatter(tensor: torch.Tensor, op: str = Sum,
     return synchronize(reducescatter_async(tensor, op, name, process_set))
 
 
+def grouped_reducescatter_async(tensors, op: str = Sum,
+                                name: Optional[str] = None,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> int:
+    """One handle for a list of tensors, each reducescattered (reference:
+    grouped ops via group_table.cc)."""
+    rt = _rt()
+    m = _members(process_set)
+    return rt.submit("grouped_reducescatter", name, lambda nm: [
+        _from_np(rt.engine.reducescatter(f"{nm}.{i}", _to_np(t), op,
+                                         members=m), t)
+        for i, t in enumerate(tensors)])
+
+
+def grouped_reducescatter(tensors, op: str = Sum,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None):
+    return synchronize(grouped_reducescatter_async(tensors, op, name,
+                                                   process_set))
+
+
 # --- handles ----------------------------------------------------------------
 
 def synchronize(handle: int):

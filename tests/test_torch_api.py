@@ -715,3 +715,18 @@ def test_sparse_param_unused_on_one_rank_no_deadlock():
     outs = run_parallel(n, fit)
     torch.testing.assert_close(outs[0][0], outs[1][0])
     torch.testing.assert_close(outs[0][1], outs[1][1])
+
+
+def test_grouped_reducescatter():
+    n = 2
+
+    def fn(r):
+        ts = [torch.ones(4, 2) * (r + 1), torch.ones(2, 3) * (r + 1)]
+        outs = hvd.grouped_reducescatter(ts, name="grs")
+        return [o for o in outs]
+
+    r0, r1 = run_parallel(n, fn)
+    # sum over ranks = 3; first dim scattered across the 2 ranks
+    torch.testing.assert_close(r0[0], torch.full((2, 2), 3.0))
+    torch.testing.assert_close(r1[0], torch.full((2, 2), 3.0))
+    torch.testing.assert_close(r0[1], torch.full((1, 3), 3.0))
