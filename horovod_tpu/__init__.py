@@ -24,7 +24,8 @@ from .collectives import (Adasum, Average, Compression, Max, Min, Product,
                           allreduce, alltoall, alltoall_v, barrier, broadcast,
                           eager, grouped_allgather, grouped_allreduce,
                           grouped_broadcast, grouped_reducescatter,
-                          hierarchical_adasum, iterate_with_join, join,
+                          hierarchical_adasum, hierarchical_allreduce,
+                          iterate_with_join, join,
                           join_allreduce, join_count, reducescatter)
 from .core import (Config, HorovodInternalError, HostsUpdatedInterrupt,
                    ProcessSet, RANK_AXIS, add_process_set, cuda_built,

@@ -5,13 +5,15 @@ from .dynamic import allgather_v, alltoall_v, compact_gathered
 from .join import iterate_with_join, join, join_allreduce, join_count
 from .ops import (Adasum, Average, Max, Min, Product, Sum, allgather,
                   allreduce, alltoall, barrier, broadcast, grouped_allgather,
+                  hierarchical_allreduce,
                   grouped_allreduce, grouped_broadcast, grouped_reducescatter,
                   reducescatter)
 
 __all__ = [
     "eager", "adasum_allreduce", "hierarchical_adasum", "Compression",
     "allgather_v", "alltoall_v", "compact_gathered", "iterate_with_join",
-    "join", "join_allreduce", "join_count", "Adasum", "Average",
+    "join", "join_allreduce", "join_count", "hierarchical_allreduce",
+    "Adasum", "Average",
     "Max", "Min", "Product", "Sum", "allgather", "allreduce", "alltoall",
     "barrier", "broadcast", "grouped_allgather", "grouped_allreduce",
     "grouped_broadcast", "grouped_reducescatter", "reducescatter",

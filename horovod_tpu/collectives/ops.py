@@ -26,7 +26,11 @@ from __future__ import annotations
 
 import contextlib as _contextlib
 import threading as _threading
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+#: A rank axis is one mesh axis name, or — on a hierarchical (multi-
+#: axis) mesh — a tuple of names with the ICI-contiguous axis last.
+AxisName = Union[str, Tuple[str, ...]]
 
 import jax
 import jax.numpy as jnp
@@ -45,18 +49,26 @@ Product = "product"
 Adasum = "adasum"
 
 
-def _axis(axis_name: Optional[str]) -> str:
+def _axis(axis_name: Optional[AxisName]) -> AxisName:
     if axis_name is not None:
-        return axis_name
+        return tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+            else axis_name
     if _ctx.is_initialized():
         return _ctx.context().axis_name
     return _ctx.RANK_AXIS
 
 
-def _groups(process_set: Optional[ProcessSet], axis: str,
+def _groups(process_set: Optional[ProcessSet], axis: AxisName,
             require_equal: bool = False) -> Optional[List[List[int]]]:
     if process_set is None or process_set.process_set_id == 0:
         return None
+    if isinstance(axis, tuple):
+        # axis_index_groups are flat indices over ONE named axis; XLA
+        # rejects groups combined with multiple axis names. Sub-world
+        # collectives on a hierarchical mesh should run over one axis.
+        raise NotImplementedError(
+            "process sets are not supported over a multi-axis (hierarchical) "
+            "rank axis; pass a single axis_name for sub-world collectives")
     world = lax.axis_size(axis)
     members = list(process_set.ranks)
     rest = [r for r in range(world) if r not in process_set.ranks]
@@ -70,13 +82,13 @@ def _groups(process_set: Optional[ProcessSet], axis: str,
     return [members] + [rest[i:i + k] for i in range(0, len(rest), k)]
 
 
-def _set_size(process_set: Optional[ProcessSet], axis: str) -> int:
+def _set_size(process_set: Optional[ProcessSet], axis: AxisName) -> int:
     if process_set is None or process_set.process_set_id == 0:
         return lax.axis_size(axis)
     return process_set.size()
 
 
-def _member_mask(process_set: Optional[ProcessSet], axis: str):
+def _member_mask(process_set: Optional[ProcessSet], axis: AxisName):
     """Traced boolean: is this device a member of the process set?
     None for the global set (everyone is)."""
     if process_set is None or process_set.process_set_id == 0:
@@ -88,7 +100,7 @@ def _member_mask(process_set: Optional[ProcessSet], axis: str):
     return member
 
 
-def static_axis_size(axis: str) -> Optional[int]:
+def static_axis_size(axis: AxisName) -> Optional[int]:
     """Bound size of ``axis`` at trace time, or None outside a binding
     context. Lets every op collapse to identity on a 1-member axis — XLA
     does NOT reliably elide single-participant collectives (measured: a
@@ -120,13 +132,23 @@ def force_axis_size1(*axes: str):
         _forced_size1.axes = prev
 
 
-def effective_axis_size(axis: str) -> Optional[int]:
+def effective_axis_size(axis: AxisName) -> Optional[int]:
     """``static_axis_size`` with two extra resolution steps for unbound
     axes: a ``force_axis_size1`` declaration wins, else the context world
     size when the axis IS the context's rank axis. This makes a 1-device
     world behave like the reference's 1-process run — train steps need no
     ``shard_map`` wrapper at all, and every collective inside still
     collapses to identity."""
+    if isinstance(axis, tuple):
+        per_axis = [effective_axis_size(a) for a in axis]
+        if all(n is not None for n in per_axis):
+            total = 1
+            for n in per_axis:
+                total *= n
+            return total
+        if _ctx.is_initialized() and axis == _ctx.context().axis_name:
+            return _ctx.context().size
+        return None
     n = static_axis_size(axis)
     if n is not None:
         return n
@@ -185,6 +207,118 @@ def _reduce_leaf(x, op: str, axis: str, groups, nparticipants: int,
     return y
 
 
+def _fused_reduce(tensors, compression: Compressor, reduce_flat,
+                  member=None):
+    """The compile-time fusion buffer: flatten a pytree's leaves into one
+    contiguous flat buffer per wire dtype, apply ``reduce_flat`` to each, and
+    split/decompress back. Shared by ``grouped_allreduce`` and
+    ``hierarchical_allreduce``. ``member`` (traced bool) restores each
+    non-member leaf to its input (process-set passthrough semantics)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    if not leaves:
+        return tensors
+    compressed = [compression.compress(x) for x in leaves]
+    buckets: dict = {}
+    for i, (cx, _) in enumerate(compressed):
+        buckets.setdefault(cx.dtype, []).append(i)
+    out: List[Any] = [None] * len(leaves)
+    for dtype, idxs in buckets.items():
+        flat = jnp.concatenate([compressed[i][0].ravel() for i in idxs])
+        red = reduce_flat(flat)
+        off = 0
+        for i in idxs:
+            cx, cctx = compressed[i]
+            sz = cx.size
+            y = compression.decompress(red[off:off + sz].reshape(cx.shape),
+                                       cctx)
+            if member is not None:
+                y = jnp.where(member, y, leaves[i])
+            out[i] = y
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _hierarchical_axes(axis, process_set, op: str):
+    """(cross_axes, intra_axis) when HOROVOD_HIERARCHICAL_ALLREDUCE should
+    reshape this reduce, else None.
+
+    Engages only for Sum/Average on the global set over a multi-axis rank
+    axis: the innermost mesh axis is the ICI-contiguous one (parallel/mesh.py
+    axis ordering; ``create_hybrid_mesh`` puts DCN axes outermost), so it
+    plays the reference's intra-node NCCL role and the outer axes the
+    cross-node MPI role (nccl_operations.cc hierarchical path, SURVEY §2.2).
+    """
+    if op not in (Sum, Average):
+        return None
+    if not isinstance(axis, tuple) or len(axis) < 2:
+        return None
+    if not _is_global(process_set):
+        return None
+    if not (_ctx.is_initialized()
+            and _ctx.context().config.hierarchical_allreduce):
+        return None
+    return axis[:-1], axis[-1]
+
+
+def _hier_reduce_flat(flat, op: str, intra_axis: str, cross_axes,
+                      n_total: int, prescale_factor: float,
+                      postscale_factor: float):
+    """Hierarchical sum/average of a flat 1-D buffer: reduce-scatter over the
+    ICI axis → allreduce over the DCN axes → allgather back over ICI.
+
+    Wire cost per device vs a flat N-way allreduce: the cross-slice hop moves
+    1/n_intra of the bytes (each device owns a shard), which is exactly the
+    reference's reason for HOROVOD_HIERARCHICAL_ALLREDUCE — keep the
+    bandwidth-hungry phase on the fast fabric. Average divides on the shard,
+    before the gather, so the scale runs on 1/n_intra of the elements.
+    """
+    if prescale_factor != 1.0:
+        flat = flat * prescale_factor
+    n_intra = lax.axis_size(intra_axis)
+    sz = flat.shape[0]
+    pad = (-sz) % n_intra
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, intra_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.psum(shard, cross_axes)
+    if op == Average:
+        shard = shard / n_total
+    if postscale_factor != 1.0:
+        shard = shard * postscale_factor
+    out = lax.all_gather(shard, intra_axis, axis=0, tiled=True)
+    return out[:sz] if pad else out
+
+
+def hierarchical_allreduce(tensor: Any, op: str = Average, *,
+                           intra_axis: str, cross_axes,
+                           compression: Compressor = Compression.none,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0) -> Any:
+    """Explicit two-level allreduce over a (cross, intra) mesh decomposition.
+
+    Parity: the reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` data path
+    (NCCL reducescatter within the node → MPI allreduce across nodes →
+    NCCL allgather; ``horovod/common/ops/nccl_operations.cc``, SURVEY §2.2),
+    re-expressed on the topology TPU pods actually have: ``intra_axis`` rides
+    ICI within a slice, ``cross_axes`` (a name or tuple of names) rides DCN.
+    ``allreduce()``/``grouped_allreduce()`` route here automatically when the
+    config flag is set and the rank axis is a multi-axis tuple; call this
+    directly to force the shape regardless of the flag. All leaves fuse into
+    per-dtype flat buffers (one collective sequence per dtype).
+    """
+    if op not in (Sum, Average):
+        raise ValueError("hierarchical allreduce supports Sum and Average; "
+                         f"got {op!r}")
+    cross = tuple(cross_axes) if isinstance(cross_axes, (tuple, list)) \
+        else (cross_axes,)
+    n_total = lax.axis_size((*cross, intra_axis))
+    return _fused_reduce(
+        tensor, compression,
+        lambda flat: _hier_reduce_flat(flat, op, intra_axis, cross, n_total,
+                                       prescale_factor, postscale_factor))
+
+
 def allreduce(tensor: Any, op: str = Average, *,
               process_set: Optional[ProcessSet] = None,
               axis_name: Optional[str] = None,
@@ -209,6 +343,13 @@ def allreduce(tensor: Any, op: str = Average, *,
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return _identity_reduce(tensor, op, prescale_factor,
                                 postscale_factor)
+    hier = _hierarchical_axes(axis, process_set, op)
+    if hier is not None:
+        cross, intra = hier
+        return hierarchical_allreduce(
+            tensor, op, intra_axis=intra, cross_axes=cross,
+            compression=compression, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     groups = _groups(process_set, axis)
     n = _set_size(process_set, axis)
     member = _member_mask(process_set, axis)
@@ -254,34 +395,23 @@ def grouped_allreduce(tensors: Any, op: str = Average, *,
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return _identity_reduce(tensors, op, prescale_factor,
                                 postscale_factor)
+    hier = _hierarchical_axes(axis, process_set, op)
+    if hier is not None:
+        # hierarchical_allreduce already fuses leaves into per-dtype flat
+        # buffers — it IS the grouped form.
+        cross, intra = hier
+        return hierarchical_allreduce(
+            tensors, op, intra_axis=intra, cross_axes=cross,
+            compression=compression, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     groups = _groups(process_set, axis)
     n = _set_size(process_set, axis)
     member = _member_mask(process_set, axis)
-
-    leaves, treedef = jax.tree_util.tree_flatten(tensors)
-    if not leaves:
-        return tensors
-    compressed = [compression.compress(x) for x in leaves]
-    # Bucket by wire dtype so concatenation is valid.
-    buckets: dict = {}
-    for i, (cx, _) in enumerate(compressed):
-        buckets.setdefault(cx.dtype, []).append(i)
-    out: List[Any] = [None] * len(leaves)
-    for dtype, idxs in buckets.items():
-        flat = jnp.concatenate([compressed[i][0].ravel() for i in idxs])
-        red = _reduce_leaf(flat, op, axis, groups, n,
-                           prescale_factor, postscale_factor)
-        off = 0
-        for i in idxs:
-            cx, cctx = compressed[i]
-            sz = cx.size
-            piece = red[off:off + sz].reshape(cx.shape)
-            y = compression.decompress(piece, cctx)
-            if member is not None:
-                y = jnp.where(member, y, leaves[i])
-            out[i] = y
-            off += sz
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return _fused_reduce(
+        tensors, compression,
+        lambda flat: _reduce_leaf(flat, op, axis, groups, n,
+                                  prescale_factor, postscale_factor),
+        member=member)
 
 
 def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,

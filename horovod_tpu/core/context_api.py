@@ -67,13 +67,23 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
          coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
-         config: Optional[Config] = None) -> Context:
+         config: Optional[Config] = None,
+         mesh: Optional[Mesh] = None) -> Context:
     """Initialise the global context. Idempotent, like the reference's
     ``InitializeHorovodOnce`` (operations.cc).
 
     Multi-host: if ``coordinator_address`` is given (or the launcher exported
     ``HOROVOD_COORDINATOR_ADDR``), joins the JAX coordination service first —
     the TPU analog of the reference's rendezvous (SURVEY.md §2.7).
+
+    ``mesh``: optionally a prebuilt (possibly multi-axis) Mesh — e.g. from
+    ``parallel.mesh.create_hybrid_mesh`` — instead of the default 1-D mesh
+    over all devices. With a multi-axis mesh the rank axis becomes the TUPLE
+    of its axis names (outer axes ride DCN, innermost rides ICI) and
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE=1`` makes every default allreduce take
+    the two-level reducescatter→cross-psum→allgather path (collectives/ops.py
+    ``hierarchical_allreduce``), matching the reference's hierarchical NCCL
+    pipeline (nccl_operations.cc, SURVEY §2.2).
     """
     global _context
     with _lock:
@@ -141,8 +151,17 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
             # usable after abnormal exits for the same reason.
             import atexit
             atexit.register(timeline.close)
-        devs = list(devices) if devices is not None else jax.devices()
-        mesh = Mesh(np.asarray(devs), (axis_name,))
+        if mesh is not None:
+            if devices is not None:
+                raise ValueError("pass either devices or mesh, not both")
+            devs = list(mesh.devices.flat)
+            if len(mesh.axis_names) > 1:
+                axis_name = tuple(mesh.axis_names)
+            else:
+                axis_name = mesh.axis_names[0]
+        else:
+            devs = list(devices) if devices is not None else jax.devices()
+            mesh = Mesh(np.asarray(devs), (axis_name,))
         ctx = Context(mesh, cfg, axis_name)
         ctx.timeline = timeline
         get_logger().info(

@@ -65,6 +65,8 @@ def create_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     generalisation of the reference's hierarchical allreduce topology."""
     devices = list(devices) if devices is not None else jax.devices()
     names = [a for a in AXIS_ORDER if a in dcn_axes or a in ici_axes]
+    names += [a for a in list(dcn_axes) + list(ici_axes)
+              if a not in names]  # user extras (e.g. "cross"/"intra") last
     ici = [int(ici_axes.get(a, 1)) for a in names]
     dcn = [int(dcn_axes.get(a, 1)) for a in names]
     from jax.experimental import mesh_utils

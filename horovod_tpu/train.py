@@ -68,7 +68,17 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     over the same batch (one dispatch, one sync) — used by benchmarks to
     measure pure device throughput without host dispatch in the loop."""
     mesh = mesh if mesh is not None else _ctx.mesh()
-    axis = axis_name or _ctx.context().axis_name
+    if axis_name is not None:
+        axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+            else axis_name
+    elif _ctx.is_initialized() and mesh is _ctx.mesh():
+        axis = _ctx.context().axis_name
+    else:
+        # A custom multi-axis mesh (e.g. create_hybrid_mesh for hierarchical
+        # allreduce): the rank axis is the tuple of its axes — batch shards
+        # over all of them, collectives reduce over all of them.
+        axis = mesh.axis_names[0] if len(mesh.axis_names) == 1 \
+            else tuple(mesh.axis_names)
 
     def sharded_step(state: TrainState, batch, labels):
         def loss_of(params):
@@ -124,7 +134,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         inner_step = sharded_step
 
         def step(state, batch, labels):
-            with force_axis_size1(axis):
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            with force_axis_size1(*axes):
                 return inner_step(state, batch, labels)
     else:
         step = _shard_map(
