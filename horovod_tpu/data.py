@@ -137,12 +137,27 @@ class Prefetcher:
     def close(self) -> None:
         self._stop.set()
         self._dead = True
-        # Unblock a producer waiting on a full queue.
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+
+        def drain():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+
+        # Unblock a producer waiting on a full queue, then JOIN the worker
+        # and drain again: the worker may complete a put (or an in-flight
+        # transfer) concurrently with the first drain, and close() promises
+        # no queued device-sized batch outlives it. The join timeout is
+        # short: a worker blocked INSIDE the source iterator (slow next())
+        # holds no queued buffer yet, and waiting longer would stall
+        # __exit__/__del__ (GC) for a thread the stop flag will reap at its
+        # next put anyway.
+        drain()
+        t = self._thread
+        if t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=0.5)
+        drain()
 
 
 class Dataset:
@@ -200,14 +215,15 @@ class Dataset:
             np.random.RandomState(self.seed + self.epoch).shuffle(order)
         for s in range(len(self)):
             sel = order[s * self.global_batch:(s + 1) * self.global_batch]
-            if len(sel) % self.num_replicas:
-                # drop_last=False tail: pad by wrapping from the front of
-                # the epoch order (DistributedSampler convention) so every
-                # process sees the SAME local size — required by
-                # shard_batch/host_local_array_to_global_array, and keeps
-                # jitted steps from recompiling on a ragged final shape.
-                pad = self.num_replicas - len(sel) % self.num_replicas
-                sel = np.concatenate([sel, order[:pad]])
+            if len(sel) < self.global_batch:
+                # drop_last=False tail: pad to the FULL global batch by
+                # wrapping from the front of the epoch order (the
+                # DistributedSampler convention, taken one step further):
+                # every process sees the same local size AND every step the
+                # same shape, so a jitted train step never recompiles on
+                # the final batch.
+                pad = self.global_batch - len(sel)
+                sel = np.concatenate([sel, np.resize(order, pad)])
             per = len(sel) // self.num_replicas
             mine = sel[self.rank * per:(self.rank + 1) * per]
             # Native threaded gather (GIL-free memcpy; ~9x numpy fancy

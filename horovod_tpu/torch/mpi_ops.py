@@ -323,7 +323,8 @@ def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
 
 
 def sparse_allreduce_async(tensor: torch.Tensor, op: str = Average,
-                           name: Optional[str] = None) -> int:
+                           name: Optional[str] = None,
+                           process_set: Optional[ProcessSet] = None) -> int:
     """Allreduce a sparse COO tensor via the reference's gather-based
     scheme (``horovod/torch/optimizer.py`` ``_sparse_allreduce_async``):
     allgather (indices, values) across ranks — nnz may differ per rank,
@@ -339,7 +340,10 @@ def sparse_allreduce_async(tensor: torch.Tensor, op: str = Average,
     if not tensor.is_sparse:
         raise ValueError("sparse_allreduce_async needs a sparse tensor")
     rt = _rt()
-    n = rt.engine.size()
+    members = _members(process_set)
+    # Average must divide by the PARTICIPANT count, not the world size —
+    # a future sub-world caller would otherwise get silently wrong means.
+    n = len(members) if members is not None else rt.engine.size()
 
     def run(nm):
         t = tensor.coalesce()
@@ -347,8 +351,9 @@ def sparse_allreduce_async(tensor: torch.Tensor, op: str = Average,
         vals = t.values().contiguous()
         if op == Average:
             vals = vals / n
-        g_idx = rt.engine.allgather(f"{nm}.idx", idx)
-        g_vals = rt.engine.allgather(f"{nm}.vals", _to_np(vals))
+        g_idx = rt.engine.allgather(f"{nm}.idx", idx, members=members)
+        g_vals = rt.engine.allgather(f"{nm}.vals", _to_np(vals),
+                                     members=members)
         return torch.sparse_coo_tensor(
             torch.from_numpy(np.ascontiguousarray(g_idx.T)),
             torch.from_numpy(np.ascontiguousarray(g_vals)).to(

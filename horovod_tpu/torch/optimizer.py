@@ -23,6 +23,10 @@ from . import mpi_ops as _ops
 from .compression import Compression
 from .engine import Adasum, Average, Sum
 
+#: Marker: gradient ready, collective not yet submitted (ordered engines
+#: replay submissions in canonical order inside ``synchronize()``).
+_DEFERRED = object()
+
 
 class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters, compression,
@@ -37,6 +41,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
+            names = [k for k, _ in named_parameters]
+            if len(set(names)) != len(names):
+                dups = sorted({n for n in names if names.count(n) > 1})
+                raise ValueError(
+                    "parameter names must be unique; duplicates: "
+                    f"{dups} (concatenating named_parameters() of several "
+                    "modules? wrap them in one nn.Module — reference "
+                    "optimizer.py enforces the same)")
             self._param_names = {v: k for k, v in named_parameters}
         else:
             self._param_names = {
@@ -47,6 +59,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._handles = {}
         self._passes = {}
         self._sparse_params = {}  # param -> sparse_dim of its grads
+        self._sync_count = 0      # distinguishes per-step meta-round names
         self._should_synchronize = True
         self._synchronized = False
         if _ops.size() > 1:
@@ -61,12 +74,26 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     self._passes[p] = 0
                     p.register_post_accumulate_grad_hook(self._make_hook())
 
+    @property
+    def _ordered_engine(self) -> bool:
+        """True when the transport matches collectives by SUBMISSION ORDER
+        (JaxProcessEngine single-worker) rather than by name. Hook-time
+        submission would then pair ops positionally across ranks — broken
+        whenever ranks' ready-order or op sets differ (unused params,
+        sparse fill-ins) — so submission is deferred to ``synchronize()``
+        and replayed in canonical param-group order, identical everywhere."""
+        return getattr(_ops._rt().engine, "requires_ordered_submission",
+                       False)
+
     def _make_hook(self):
         def hook(p):
             self._passes[p] += 1
             if self._passes[p] == self.backward_passes_per_step:
                 self._passes[p] = 0
-                self._handles[p] = self._allreduce_grad_async(p)
+                if self._ordered_engine:
+                    self._handles[p] = _DEFERRED
+                else:
+                    self._handles[p] = self._allreduce_grad_async(p)
         return hook
 
     def _allreduce_grad_async(self, p):
@@ -103,15 +130,66 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     # -- synchronization -----------------------------------------------------
 
+    def _exchange_sparse_param_meta(self):
+        """Per-step union of which params produce SPARSE grads on ANY rank.
+
+        The fill-in for unused params must issue the same collective type
+        the peers issued, but ``_sparse_params`` only records grads THIS
+        rank has seen — a rank where a sparse-grad param (e.g.
+        ``nn.Embedding(sparse=True)``) is unused would contribute dense
+        zeros against the peers' indices/values allgathers and deadlock.
+        Runs at the START of every synchronize (the reference's controller
+        renegotiates every step for the same reason) so even a sparse param
+        first activated mid-run is known everywhere before any fill-in;
+        cost is one small object round on a path that already pays one
+        round per param per step. Skipped under ``sparse_as_dense`` (all
+        collectives dense by construction)."""
+        from .functions import allgather_object
+        # Local view: history (_sparse_params) plus LIVE grads — on ordered
+        # engines hooks only mark _DEFERRED, so at first-synchronize time
+        # the history is still empty and the grad itself is the evidence.
+        local = {}
+        for group in self.param_groups:
+            for p in group["params"]:
+                pname = self._param_names.get(p)
+                if pname is None:
+                    continue
+                sd = self._sparse_params.get(p)
+                if (sd is None and p.grad is not None and p.grad.is_sparse
+                        and not self._sparse_as_dense):
+                    sd = p.grad.sparse_dim()
+                if sd is not None:
+                    local[pname] = sd
+        # Route through the runtime's executor like every other collective.
+        # Name-keyed engines rendezvous it independently of in-flight grad
+        # ops; on order-matched engines hooks DEFER all submissions (see
+        # _ordered_engine), so this is provably the first op of the step on
+        # every rank — the same queue position everywhere.
+        rt = _ops._rt()
+        handle = rt.submit(
+            "allgather_object", f"sparse_param_meta.{self._sync_count}",
+            lambda name: allgather_object(local, name=name))
+        name_to_param = {v: k for k, v in self._param_names.items()}
+        for peer_map in _ops.synchronize(handle):
+            for pname, sd in peer_map.items():
+                p = name_to_param.get(pname)
+                if p is not None:
+                    self._sparse_params.setdefault(p, sd)
+
     def synchronize(self):
         """Wait for all outstanding gradient allreduces. Parameters whose
         hook never fired (unused this step) are reduced here with a zero
         gradient so every rank issues the same collective set — the
         reference's missing-handle path in ``synchronize()``."""
         if _ops.size() > 1:
+            if not self._sparse_as_dense:
+                self._exchange_sparse_param_meta()
+            self._sync_count += 1
             for group in self.param_groups:
                 for p in group["params"]:
-                    if p.requires_grad and p not in self._handles:
+                    if not p.requires_grad:
+                        continue
+                    if p not in self._handles:
                         if self._passes.get(p, 0) != 0:
                             continue  # mid local aggregation: not due yet
                         if p.grad is None:
@@ -130,6 +208,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                     p.shape)
                             else:
                                 p.grad = torch.zeros_like(p)
+                        self._handles[p] = _DEFERRED
+                    if self._handles[p] is _DEFERRED:
+                        # Hook-marked or filled-in: submit HERE, in
+                        # canonical param-group order — on order-matched
+                        # engines this makes every rank's submission
+                        # sequence identical even when ready-order or op
+                        # sets diverged during backward.
                         self._handles[p] = self._allreduce_grad_async(p)
             for p, handle in list(self._handles.items()):
                 if isinstance(handle, tuple) and handle[0] == "sparse":

@@ -90,7 +90,9 @@ def test_dataset_drop_last_and_len():
     ds2 = Dataset((X,), batch_size=8, drop_last=False, rank=0,
                   num_replicas=1)
     assert len(ds2) == 4
-    assert sum(len(b[0]) for b in ds2) == 30
+    batches = list(ds2)
+    assert all(len(b[0]) == 8 for b in batches)   # tail padded: one shape
+    assert set(np.concatenate([b[0] for b in batches])) == set(X)
 
 
 def test_dataset_validates():
@@ -131,9 +133,11 @@ def test_end_to_end_train_with_pipeline():
     assert int(state.step) == 4
 
 
-def test_dataset_tail_pads_to_equal_process_shards():
+def test_dataset_tail_pads_to_full_batch():
     # 42 rows, batch 32, 4 processes, drop_last=False: the 10-row tail pads
-    # to 12 by wrapping so every process gets 3 (equal shapes across hosts).
+    # to the FULL global batch (32) by wrapping, so every process sees the
+    # same local size on EVERY step — one shape, no jit recompile on the
+    # final batch.
     X = np.arange(42, dtype=np.float32)
     sizes = []
     seen = []
@@ -143,10 +147,10 @@ def test_dataset_tail_pads_to_equal_process_shards():
         batches = list(ds)
         sizes.append([len(b[0]) for b in batches])
         seen.append(np.concatenate([b[0] for b in batches]))
-    assert all(sz == [8, 3] for sz in sizes)          # equal per-process
+    assert all(sz == [8, 8] for sz in sizes)          # constant shape
     allv = np.concatenate(seen)
     assert set(allv) == set(X)                        # nothing lost
-    assert len(allv) == 44                            # 2 wrapped pads
+    assert len(allv) == 64                            # 22 wrapped pads
 
 
 def test_prefetcher_stops_not_hangs_after_error():

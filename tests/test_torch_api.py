@@ -682,6 +682,21 @@ def test_distributed_optimizer_sparse_embedding(sparse_as_dense):
     torch.testing.assert_close(sparse_out[0], dense_out[0])
 
 
+class _EmbLin(torch.nn.Module):
+    """Sparse embedding + dense linear with deterministic init (rank threads
+    run concurrently, so torch.manual_seed would interleave draws)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = torch.nn.Embedding(6, 3, sparse=True)
+        self.lin = torch.nn.Linear(3, 1)
+        with torch.no_grad():
+            self.emb.weight.copy_(torch.arange(18, dtype=torch.float32)
+                                  .reshape(6, 3))
+            self.lin.weight.fill_(0.5)
+            self.lin.bias.zero_()
+
+
 def test_sparse_param_unused_on_one_rank_no_deadlock():
     """Rank 1 skips the sparse embedding for a step: its fill-in must be an
     EMPTY sparse contribution (same collective type as rank 0), not dense
@@ -689,32 +704,129 @@ def test_sparse_param_unused_on_one_rank_no_deadlock():
     n = 2
 
     def fit(rank):
-        emb = torch.nn.Embedding(6, 3, sparse=True)
-        with torch.no_grad():
-            emb.weight.copy_(torch.arange(18, dtype=torch.float32)
-                             .reshape(6, 3))
-        lin = torch.nn.Linear(3, 1)
-        with torch.no_grad():
-            lin.weight.fill_(0.5)
-            lin.bias.zero_()
-        params = list(emb.parameters()) + list(lin.parameters())
-        opt = torch.optim.SGD(params, lr=0.1)
+        m = _EmbLin()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
         dopt = hvd.DistributedOptimizer(
-            opt, named_parameters=(list(emb.named_parameters())
-                                   + list(lin.named_parameters())))
+            opt, named_parameters=m.named_parameters())
         for step in range(2):
             dopt.zero_grad()
             if rank == 0 or step == 0:       # rank 1 skips emb on step 1
-                loss = lin(emb(torch.tensor([rank, 3]))).sum()
+                loss = m.lin(m.emb(torch.tensor([rank, 3]))).sum()
             else:
-                loss = lin(torch.ones(2, 3)).sum()
+                loss = m.lin(torch.ones(2, 3)).sum()
             loss.backward()
             dopt.step()
-        return emb.weight.detach().clone(), lin.weight.detach().clone()
+        return m.emb.weight.detach().clone(), m.lin.weight.detach().clone()
 
     outs = run_parallel(n, fit)
     torch.testing.assert_close(outs[0][0], outs[1][0])
     torch.testing.assert_close(outs[0][1], outs[1][1])
+
+
+def test_sparse_param_unused_from_first_step_no_deadlock():
+    """Rank 1 NEVER uses the sparse embedding: its per-rank sparse history
+    is empty at the first synchronize, so only the up-front sparse-param
+    metadata exchange can tell it to contribute an EMPTY sparse gradient
+    instead of dense zeros (which would never rendezvous with rank 0's
+    indices/values allgathers — collective-type mismatch → stall)."""
+    n = 2
+
+    def fit(rank):
+        m = _EmbLin()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        dopt = hvd.DistributedOptimizer(
+            opt, named_parameters=m.named_parameters())
+        for step in range(2):
+            dopt.zero_grad()
+            if rank == 0:                    # rank 1 never touches emb
+                loss = m.lin(m.emb(torch.tensor([0, 3]))).sum()
+            else:
+                loss = m.lin(torch.ones(2, 3)).sum()
+            loss.backward()
+            dopt.step()
+        return m.emb.weight.detach().clone(), m.lin.weight.detach().clone()
+
+    outs = run_parallel(n, fit)
+    torch.testing.assert_close(outs[0][0], outs[1][0])
+    torch.testing.assert_close(outs[0][1], outs[1][1])
+
+
+def test_sparse_param_activated_midrun_no_deadlock():
+    """A sparse param unused by EVERY rank at step 0 and first touched at
+    step 1 (and only by rank 0): the per-step metadata exchange must tell
+    rank 1 before its fill-in, or it would contribute dense zeros against
+    rank 0's sparse allgathers."""
+    n = 2
+
+    def fit(rank):
+        m = _EmbLin()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        dopt = hvd.DistributedOptimizer(
+            opt, named_parameters=m.named_parameters())
+        for step in range(3):
+            dopt.zero_grad()
+            if rank == 0 and step >= 1:      # emb activates at step 1
+                loss = m.lin(m.emb(torch.tensor([0, 3]))).sum()
+            else:
+                loss = m.lin(torch.ones(2, 3)).sum()
+            loss.backward()
+            dopt.step()
+        return m.emb.weight.detach().clone(), m.lin.weight.detach().clone()
+
+    outs = run_parallel(n, fit)
+    torch.testing.assert_close(outs[0][0], outs[1][0])
+    torch.testing.assert_close(outs[0][1], outs[1][1])
+
+
+def test_ordered_engine_deferred_submission_alignment():
+    """Order-matched engines (``requires_ordered_submission``, e.g. the
+    multi-host JaxProcessEngine) pair collectives POSITIONALLY across
+    ranks, so every rank must submit the identical sequence even when
+    backward-ready order and op sets diverge (param unused on one rank,
+    sparse fill-ins). Hooks defer; synchronize() replays in canonical
+    param-group order — this asserts the per-rank submission logs match."""
+    from horovod_tpu.torch.engine import ThreadSimEngine
+    n = 2
+
+    class OrderedSim(ThreadSimEngine):
+        requires_ordered_submission = True
+
+        def __init__(self, n):
+            super().__init__(n)
+            self.log = {r: [] for r in range(n)}
+
+        def allreduce(self, name, arr, op, members=None):
+            self.log[self.rank()].append(("allreduce", name))
+            return super().allreduce(name, arr, op, members)
+
+        def allgather(self, name, arr, members=None):
+            self.log[self.rank()].append(("allgather", name))
+            return super().allgather(name, arr, members)
+
+    eng = OrderedSim(n)
+
+    def fit(rank):
+        m = _EmbLin()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        dopt = hvd.DistributedOptimizer(
+            opt, named_parameters=m.named_parameters())
+        for step in range(2):
+            dopt.zero_grad()
+            if rank == 0:                    # rank 1 never touches emb
+                loss = m.lin(m.emb(torch.tensor([0, 3]))).sum()
+            else:
+                loss = m.lin(torch.ones(2, 3)).sum()
+            loss.backward()
+            dopt.step()
+        return m.emb.weight.detach().clone(), m.lin.weight.detach().clone()
+
+    outs = run_parallel(n, fit, engine=eng)
+    torch.testing.assert_close(outs[0][0], outs[1][0])
+    torch.testing.assert_close(outs[0][1], outs[1][1])
+    assert eng.log[0] == eng.log[1], (
+        "ranks submitted different collective sequences — positional "
+        "pairing would cross-match ops on a real ordered engine")
+    assert len(eng.log[0]) > 0
 
 
 def test_grouped_reducescatter():
