@@ -34,6 +34,7 @@ AxisName = Union[str, Tuple[str, ...]]
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from horovod_tpu.core import context_api as _ctx
@@ -414,6 +415,29 @@ def grouped_allreduce(tensors: Any, op: str = Average, *,
         member=member)
 
 
+def _ragged_set(process_set: Optional[ProcessSet], axis) -> bool:
+    """True when ``process_set`` is a proper subset whose complement cannot
+    be partitioned into equal-size groups — the case XLA's
+    ``axis_index_groups`` cannot express for shape-changing collectives."""
+    if _is_global(process_set):
+        return False
+    if isinstance(axis, tuple):
+        return False  # _groups raises its own NotImplementedError
+    world = lax.axis_size(axis)
+    k = len(process_set.ranks)
+    return (world - k) % k != 0
+
+
+def _member_pos(process_set: ProcessSet, axis):
+    """Traced position of this device within the (sorted) member list;
+    0 for non-members (callers mask their output)."""
+    idx = lax.axis_index(axis)
+    pos = jnp.zeros((), jnp.int32)
+    for i, r in enumerate(sorted(process_set.ranks)):
+        pos = jnp.where(idx == r, i, pos)
+    return pos
+
+
 def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
               axis_name: Optional[str] = None) -> Any:
     """Gather along dim 0 from every rank, concatenated in rank order.
@@ -422,10 +446,28 @@ def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
     static shape; for per-rank varying first dims use
     ``collectives.dynamic.allgather_v`` (pad-to-max + size side channel,
     SURVEY.md §7 "hard parts").
+
+    Process sets whose complement doesn't split into equal groups (e.g.
+    5 of 8 ranks — inexpressible as ``axis_index_groups``) fall back to a
+    full-axis gather + static member-row selection: every device (members
+    AND non-members) receives the members' concatenation. The reference has
+    no equal-partition constraint; this removes ours at the cost of
+    gathering world-size instead of set-size bytes on that rare path.
     """
     axis = _axis(axis_name)
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
+    if not _is_global(process_set) and _ragged_set(process_set, axis):
+        members = sorted(process_set.ranks)
+
+        def ragged_leaf(x):
+            m = x.shape[0]
+            g = lax.all_gather(x, axis, axis=0, tiled=True)
+            rows = np.concatenate(
+                [np.arange(r * m, (r + 1) * m) for r in members])
+            return g[rows]
+
+        return jax.tree_util.tree_map(ragged_leaf, tensor)
     groups = _groups(process_set, axis, require_equal=True)
 
     def leaf(x):
@@ -500,6 +542,29 @@ def alltoall(tensor: Any, splits: Optional[Sequence[int]] = None, *,
     axis = _axis(axis_name)
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
+    if not _is_global(process_set) and _ragged_set(process_set, axis):
+        # Ragged set: gather every member's full tensor, then each member
+        # picks its own chunk from each member's contribution (shape is
+        # preserved, so non-members just keep their input).
+        members = sorted(process_set.ranks)
+        k = len(members)
+        member = _member_mask(process_set, axis)
+        pos = _member_pos(process_set, axis)
+
+        def ragged_leaf(x):
+            if x.shape[0] % k != 0:
+                raise ValueError(
+                    f"alltoall dim0 ({x.shape[0]}) must be divisible by the "
+                    f"participant count ({k}); pass explicit splits for "
+                    "uneven exchange")
+            c = x.shape[0] // k
+            g = lax.all_gather(x, axis, axis=0, tiled=False)  # [world, ...]
+            picks = [lax.dynamic_slice_in_dim(g[r], pos * c, c, axis=0)
+                     for r in members]
+            out = jnp.concatenate(picks, axis=0)
+            return jnp.where(member, out, x)
+
+        return jax.tree_util.tree_map(ragged_leaf, tensor)
     groups = _groups(process_set, axis, require_equal=True)
 
     def leaf(x):
@@ -528,6 +593,26 @@ def reducescatter(tensor: Any, op: str = Sum, *,
     axis = _axis(axis_name)
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
+    if not _is_global(process_set) and _ragged_set(process_set, axis):
+        # Ragged set: member-masked full-axis psum, then each member slices
+        # its own chunk of the reduced tensor (non-members get chunk 0 —
+        # the reference leaves non-participant output undefined).
+        k = len(process_set.ranks)
+        member = _member_mask(process_set, axis)
+        pos = _member_pos(process_set, axis)
+
+        def ragged_leaf(x):
+            if x.shape[0] % k != 0:
+                raise ValueError(
+                    f"reducescatter dim0 ({x.shape[0]}) must be divisible "
+                    f"by {k}")
+            c = x.shape[0] // k
+            contrib = jnp.where(member, x, jnp.zeros_like(x))
+            s = lax.psum(contrib, axis)
+            y = lax.dynamic_slice_in_dim(s, pos * c, c, axis=0)
+            return y / k if op == Average else y
+
+        return jax.tree_util.tree_map(ragged_leaf, tensor)
     groups = _groups(process_set, axis, require_equal=True)
     n = _set_size(process_set, axis)
 

@@ -232,6 +232,49 @@ def test_allgather_process_set_groups():
     np.testing.assert_array_equal(out[2, :, 0], [0, 2, 4, 6])
 
 
+def test_allgather_ragged_process_set():
+    """5-of-8 set: the complement (3 ranks) can't form equal groups, so the
+    op falls back to full-gather + member-row selection; every device
+    (members and non-members) receives the members' concatenation. The
+    reference has no equal-partition constraint — neither do we now."""
+    ps = hvd.add_process_set([0, 1, 2, 3, 4])
+    x = np.arange(N, dtype=np.float32).reshape(N, 1)
+    out = np.asarray(eager.allgather(jnp.asarray(x), process_set=ps))
+    assert out.shape == (N, 5, 1)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r, :, 0], [0, 1, 2, 3, 4])
+
+
+def test_alltoall_ragged_process_set():
+    """5-of-8 alltoall: member i receives chunk i from every member, in
+    member order; non-members keep their input."""
+    ps = hvd.add_process_set([0, 1, 2, 3, 4])
+    k = 5
+    x = np.zeros((N, k), np.float32)
+    for r in range(N):
+        x[r] = r * 10 + np.arange(k)
+    out = np.asarray(eager.alltoall(jnp.asarray(x), process_set=ps))
+    for i, r in enumerate([0, 1, 2, 3, 4]):
+        np.testing.assert_array_equal(out[r], [m * 10 + i for m in range(k)])
+    for r in (5, 6, 7):
+        np.testing.assert_array_equal(out[r], x[r])
+
+
+def test_reducescatter_ragged_process_set():
+    """5-of-8 reducescatter: member i gets chunk i of the member-sum."""
+    ps = hvd.add_process_set([0, 1, 2, 3, 4])
+    k = 5
+    x = np.arange(N * k, dtype=np.float32).reshape(N, k)
+    out = np.asarray(eager.reducescatter(jnp.asarray(x), op=hvd.Sum,
+                                         process_set=ps))
+    assert out.shape == (N, 1)
+    expect = x[:k].sum(0)   # member-sum per chunk
+    for i in range(k):
+        np.testing.assert_allclose(out[i, 0], expect[i])
+    for r in (5, 6, 7):     # non-members: chunk 0 of the member-sum
+        np.testing.assert_allclose(out[r, 0], expect[0])
+
+
 def test_adasum_prescale_applied():
     x = np.random.RandomState(7).randn(N, 6).astype(np.float32)
     base = np.asarray(eager.allreduce(jnp.asarray(x), op=hvd.Adasum))
