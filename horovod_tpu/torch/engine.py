@@ -471,6 +471,7 @@ class JaxProcessEngine(CollectiveEngine):
                 "use SingleProcessEngine")
         self._lock = threading.RLock()
         self._joined = False
+        self._device_fns: dict = {}  # (len, dtype, op, scatter) -> jitted
 
     #: mpi_ops keys on this to serialize submission (program order).
     requires_ordered_submission = True
@@ -557,7 +558,7 @@ class JaxProcessEngine(CollectiveEngine):
         with self._lock:
             headers = self._gather_obj(header)
             active = [r for r, h in enumerate(headers) if not h["joined"]]
-            ops = {(h["kind"], h["name"])
+            ops = {(h["kind"], h["name"], h.get("op"))
                    for h in headers if not h["joined"]}
             if len(ops) > 1:
                 raise RuntimeError(
@@ -573,6 +574,70 @@ class JaxProcessEngine(CollectiveEngine):
             payloads = self._gather_var(payload, shape1, ref["dtype"])
             return headers, payloads
 
+    # -- device-backed reduction payload -------------------------------------
+
+    _JNP_REDUCE = {Sum: "sum", Average: "sum", Min: "min", Max: "max",
+                   Product: "prod"}
+
+    @staticmethod
+    def _identity_contribution(op, dtype, length) -> np.ndarray:
+        """A joined rank's contribution: the op's identity element, so the
+        device reduction over ALL processes equals the reduction over the
+        active ones (the old gather path dropped joined rows instead)."""
+        dt = np.dtype(dtype)
+        if op in (Sum, Average):
+            return np.zeros(length, dt)
+        if op == Product:
+            return np.ones(length, dt)
+        if dt.kind == "b":  # bool min/max = logical and/or
+            return np.full(length, op == Min, dt)
+        big = np.finfo(dt).max if dt.kind == "f" else np.iinfo(dt).max
+        small = np.finfo(dt).min if dt.kind == "f" else np.iinfo(dt).min
+        return np.full(length, big if op == Min else small, dt)
+
+    def _device_reduce(self, flat: np.ndarray, op: str,
+                       scatter_shape=None) -> np.ndarray:
+        """ONE jitted XLA collective over a one-device-per-process mesh.
+
+        This is the data plane VERDICT r1 flagged: the old path allgathered
+        every rank's full payload to all ranks (~N x the wire bytes, plus a
+        size round) and reduced in numpy; here the payload rides a single
+        psum/reduce-scatter-shaped XLA program over DCN — ring wire cost,
+        reduction on device, numpy only at the local-shard boundary. The
+        header round (mismatch safety, join bookkeeping) is unchanged.
+        Compiled once per (size, dtype, op) and cached — gradient shapes
+        are stable across steps.
+        """
+        jax = self._jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        n = self.size()
+        key = (flat.shape[0], str(flat.dtype), op, scatter_shape)
+        entry = self._device_fns.get(key)
+        if entry is None:
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            mesh = Mesh(np.asarray([per_proc[i] for i in range(n)]), ("p",))
+            reducer = getattr(jnp, self._JNP_REDUCE[op])
+
+            def f(x):
+                y = reducer(x, axis=0)
+                if scatter_shape is not None:
+                    y = y.reshape(scatter_shape)
+                return y
+
+            out_spec = P("p") if scatter_shape is not None else P()
+            fn = jax.jit(f, out_shardings=NamedSharding(mesh, out_spec))
+            entry = (fn, mesh)
+            self._device_fns[key] = entry
+        fn, mesh = entry
+        from jax.experimental import multihost_utils
+        gx = multihost_utils.host_local_array_to_global_array(
+            flat[None], mesh, P("p"))
+        out = fn(gx)
+        return np.asarray(out.addressable_shards[0].data)
+
     # -- collectives ---------------------------------------------------------
 
     def _header(self, kind, name, arr, extra=None):
@@ -584,8 +649,49 @@ class JaxProcessEngine(CollectiveEngine):
         h.update(extra or {})
         return h
 
+    def _reduce_header_round(self, kind, name, flat, op, extra=None):
+        """Header exchange + sanity for the device-reduction ops: returns
+        the ACTIVE count. Unlike the gather path, the device payload needs
+        identical shape/dtype on every active rank (no pad-to-max), so the
+        divergence the padding used to mask becomes an explicit error."""
+        ex = {"op": op}
+        ex.update(extra or {})
+        headers = self._gather_obj(self._header(kind, name, flat, ex))
+        active = [h for h in headers if not h["joined"]]
+        ops = {(h["kind"], h["name"], h.get("op")) for h in active}
+        if len(ops) > 1:
+            raise RuntimeError(
+                f"collective mismatch across processes: {sorted(ops)} "
+                "(each process must issue the same op; reference "
+                "controller would stall here)")
+        sigs = {(tuple(h["shape"]), h["dtype"]) for h in active}
+        if len(sigs) > 1:
+            raise RuntimeError(
+                f"{kind} {name!r}: shape/dtype differs across processes: "
+                f"{sorted(sigs)}")
+        return len(active)
+
     def allreduce(self, name, arr, op, members=None):
         self._no_subgroup(members)
+        arr = np.asarray(arr)
+        if op == Adasum:
+            # Adasum's pairwise tree reduction stays on the host gather
+            # path (the combine is not an elementwise monoid XLA's
+            # reduce lowers to).
+            return self._gather_allreduce(name, arr, op)
+        flat = arr.reshape(1, -1)
+        with self._lock:
+            n_active = self._reduce_header_round("allreduce", name, flat, op)
+            red = self._device_reduce(flat.ravel(), op)
+            if op == Average:
+                red = (red / n_active).astype(arr.dtype, copy=False)
+            return red.reshape(arr.shape)
+
+    def _gather_allreduce(self, name, arr, op):
+        """The pre-r2 payload path (full N-way gather + host reduce): kept
+        for Adasum and as the A/B baseline in benchmarks/torch_engine_bw.py
+        — the device path's win is exactly this path's O(N*bytes) wire
+        cost."""
         arr = np.asarray(arr)
         flat = arr.reshape(1, -1)
         headers, payloads = self._round(
@@ -643,21 +749,21 @@ class JaxProcessEngine(CollectiveEngine):
     def reducescatter(self, name, arr, op, members=None):
         self._no_subgroup(members)
         arr = np.asarray(arr)
-        flat = arr.reshape(1, -1)
-        headers, payloads = self._round(
-            self._header("reducescatter", name, flat, {"op": op}), flat)
-        arrays = [payloads[r][0] for r, h in enumerate(headers)
-                  if not h["joined"] and len(payloads[r])]
-        red = reduce_arrays(arrays, Sum if op == Average else op)
-        if op == Average:
-            red = (red / len(arrays)).astype(red.dtype, copy=False)
-        red = red.reshape(arr.shape)
         n = self.size()
-        if red.shape[0] % n:
+        if arr.shape[0] % n:
             raise ValueError(
-                f"reducescatter first dim {red.shape[0]} not divisible by "
+                f"reducescatter first dim {arr.shape[0]} not divisible by "
                 f"size {n}")
-        return np.split(red, n)[self.rank()].copy()
+        flat = arr.reshape(1, -1)
+        with self._lock:
+            n_active = self._reduce_header_round(
+                "reducescatter", name, flat, op,
+                {"orig_shape": tuple(arr.shape)})
+            red = self._device_reduce(flat.ravel(), op,
+                                      scatter_shape=tuple(arr.shape))
+            if op == Average:
+                red = (red / n_active).astype(arr.dtype, copy=False)
+            return red
 
     def barrier(self, name="barrier", members=None):
         self._no_subgroup(members)
@@ -682,7 +788,8 @@ class JaxProcessEngine(CollectiveEngine):
                 # round will follow; participate via the op path. The
                 # active rank's _round treats our header as joined and
                 # excludes our zero payload.
-                ops = {(h["kind"], h["name"]) for h in active}
+                ops = {(h["kind"], h["name"], h.get("op"))
+                       for h in active}
                 if len(ops) > 1:
                     # Active ranks raised a mismatch and will not issue the
                     # payload round — raise here too instead of hanging.
@@ -692,9 +799,30 @@ class JaxProcessEngine(CollectiveEngine):
                 ref = active[0]
                 if ref["kind"] == "join_poll":
                     continue  # it will re-enter; loop again
-                shape1 = tuple(ref["shape"][1:])
-                self._gather_var(
-                    np.zeros((0,) + shape1, dtype=ref["dtype"]),
-                    shape1, ref["dtype"])
+                if (ref["kind"] in ("allreduce", "reducescatter")
+                        and ref.get("op") != Adasum):
+                    # Mirror the active ranks' shape/dtype sanity check:
+                    # if THEY are about to raise in _reduce_header_round,
+                    # entering the device collective here would hang this
+                    # joined process forever.
+                    sigs = {(tuple(h["shape"]), h["dtype"]) for h in active}
+                    if len(sigs) > 1:
+                        raise RuntimeError(
+                            f"{ref['kind']} {ref['name']!r}: shape/dtype "
+                            f"differs across processes: {sorted(sigs)}")
+                    # Device-reduction payload: EVERY process must execute
+                    # the same XLA program — contribute the op's identity
+                    # element so the active ranks' result is unchanged.
+                    length = int(np.prod(ref["shape"]))
+                    contrib = self._identity_contribution(
+                        ref["op"], ref["dtype"], length)
+                    scatter = (tuple(ref["orig_shape"])
+                               if ref["kind"] == "reducescatter" else None)
+                    self._device_reduce(contrib, ref["op"], scatter)
+                else:
+                    shape1 = tuple(ref["shape"][1:])
+                    self._gather_var(
+                        np.zeros((0,) + shape1, dtype=ref["dtype"]),
+                        shape1, ref["dtype"])
         finally:
             self._joined = False

@@ -115,12 +115,19 @@ thvd.init()
 t = thvd.allreduce(torch.tensor([float(thvd.rank() + 1)]), name="mp_ar")
 g = thvd.allgather(torch.tensor([[thvd.rank()]]), name="mp_ag")
 o = thvd.allgather_object(("r", thvd.rank()))
+# device-backed payload path (engine._device_reduce): min-reduce and
+# reducescatter over the process mesh
+tmin = thvd.allreduce(torch.tensor([float(thvd.rank() + 1)]), name="mp_min",
+                      op="min")
+trs = thvd.reducescatter(torch.arange(4, dtype=torch.float32) * (thvd.rank() + 1),
+                         name="mp_rs")
 
 print(json.dumps({
     "rank": hvd.rank(), "size": hvd.size(),
     "reduced": local.tolist(), "objs": objs, "bobj": bobj,
     "torch_ar": float(t), "torch_ag": g.flatten().tolist(),
     "torch_objs": o,
+    "torch_min": float(tmin), "torch_rs": trs.flatten().tolist(),
 }))
 """
 
@@ -147,6 +154,10 @@ def test_hvdrun_two_process_collectives(tmp_path):
         assert out["torch_ar"] == 1.5                   # mean of 1, 2
         assert out["torch_ag"] == [0, 1]
         assert [tuple(x) for x in out["torch_objs"]] == [("r", 0), ("r", 1)]
+        assert out["torch_min"] == 1.0                  # min of 1, 2
+        # sum of [0,1,2,3] and [0,2,4,6] = [0,3,6,9]; rank r keeps chunk r
+        assert out["torch_rs"] == ([0.0, 3.0] if out["rank"] == 0
+                                   else [6.0, 9.0])
 
 
 @pytest.mark.integration

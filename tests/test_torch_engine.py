@@ -73,6 +73,22 @@ class _FakeJaxEngine(JaxProcessEngine):
     def _allgather_fixed(self, arr):
         return self._bus.allgather(self._rank_v, arr)
 
+    def _device_reduce(self, flat, op, scatter_shape=None):
+        # The real engine runs ONE jitted XLA collective over a one-device-
+        # per-process mesh; threads in one process can't form that mesh, so
+        # the fake reduces over the bus with identical semantics (identity
+        # contributions from joined ranks already included by the caller).
+        from horovod_tpu.torch.engine import (Average, Max, Min, Product,
+                                              Sum)
+        g = self._bus.allgather(self._rank_v, flat)
+        fn = {Sum: np.sum, Average: np.sum, Min: np.min, Max: np.max,
+              Product: np.prod}[op]
+        red = fn(g, axis=0).astype(flat.dtype)
+        if scatter_shape is not None:
+            red = red.reshape(scatter_shape)
+            return np.split(red, self._size_v)[self._rank_v].copy()
+        return red
+
 
 def _run_engines(n, fn):
     bus = _Bus(n)
