@@ -1,0 +1,81 @@
+"""One-off probe: ResNet-50 throughput vs per-chip batch on the real TPU,
+with XLA cost-analysis FLOPs and MFU. Not part of the bench contract —
+exploration tool behind VERDICT r1 "report and raise ResNet-50 MFU".
+
+Usage (real chip): python benchmarks/mfu_probe.py [batch ...]
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import peak_flops, slope_time_paired
+
+S_SHORT, S_LONG = 4, 16
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    hvd.init()
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}, peak bf16 ~{peak_flops(dev)/1e12:.0f} TF/s",
+          flush=True)
+
+    batches = [int(b) for b in sys.argv[1:]] or [64, 128, 256]
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    model = ResNet50(axis_name=hvd.RANK_AXIS, dtype=jnp.bfloat16)
+    dopt = distributed(optax.sgd(0.1, momentum=0.9))
+    rng = np.random.RandomState(0)
+
+    for batch in batches:
+        images = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+        state0 = create_train_state(model, jax.random.PRNGKey(0),
+                                    images[:1], dopt)
+        steps = {}
+        flops_per_step = None
+        for k in (S_SHORT, S_LONG):
+            fn = make_train_step(model, dopt, loss_fn, scan_steps=k,
+                                 donate=False)
+            lowered = jax.jit(fn).lower(state0, images, labels) \
+                if not hasattr(fn, "lower") else fn.lower(state0, images, labels)
+            compiled = lowered.compile()
+            if k == S_LONG:
+                try:
+                    ca = compiled.cost_analysis()
+                    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                    flops_per_step = float(ca.get("flops", float("nan"))) / k
+                except Exception as e:
+                    print("  cost_analysis unavailable:", e, flush=True)
+            steps[k] = compiled
+
+        def run(k, _s=steps, _st=state0, _x=images, _y=labels):
+            _, loss = _s[k](_st, _x, _y)
+            np.asarray(loss)
+
+        sec, _ = slope_time_paired({"m": run}, S_SHORT, S_LONG,
+                                   return_rounds=True)
+        ips = batch / sec["m"]
+        line = f"batch {batch:4d}: {ips:8.1f} img/s  step {sec['m']*1e3:7.2f} ms"
+        if flops_per_step and np.isfinite(flops_per_step):
+            mfu = flops_per_step / sec["m"] / peak_flops(dev)
+            line += (f"  xla_flops/img {flops_per_step/batch/1e9:.2f} G"
+                     f"  MFU {100*mfu:.1f}%")
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()
