@@ -209,23 +209,51 @@ def _reduce_leaf(x, op: str, axis: str, groups, nparticipants: int,
 
 
 def _fused_reduce(tensors, compression: Compressor, reduce_flat,
-                  member=None):
+                  member=None, max_bucket_bytes: Optional[int] = None):
     """The compile-time fusion buffer: flatten a pytree's leaves into one
     contiguous flat buffer per wire dtype, apply ``reduce_flat`` to each, and
     split/decompress back. Shared by ``grouped_allreduce`` and
     ``hierarchical_allreduce``. ``member`` (traced bool) restores each
-    non-member leaf to its input (process-set passthrough semantics)."""
+    non-member leaf to its input (process-set passthrough semantics).
+
+    ``max_bucket_bytes`` caps each collective's payload — the in-graph
+    rendering of ``HOROVOD_FUSION_THRESHOLD`` (the reference's fusion-buffer
+    size, fusion_buffer_manager.cc): a buffer larger than the cap is split
+    into several independent collectives, which XLA's scheduler can overlap
+    with the producing backward computation; one giant buffer serializes
+    behind its last producer. This is the knob the transparent autotuner
+    (tools/autotune.py) searches.
+    """
     leaves, treedef = jax.tree_util.tree_flatten(tensors)
     if not leaves:
         return tensors
     compressed = [compression.compress(x) for x in leaves]
+    if max_bucket_bytes == 0:
+        # Fusion disabled (HOROVOD_FUSION_THRESHOLD=0, reference semantics):
+        # one collective per tensor.
+        out0: List[Any] = []
+        for i, (cx, cctx) in enumerate(compressed):
+            y = compression.decompress(
+                reduce_flat(cx.ravel()).reshape(cx.shape), cctx)
+            if member is not None:
+                y = jnp.where(member, y, leaves[i])
+            out0.append(y)
+        return jax.tree_util.tree_unflatten(treedef, out0)
     buckets: dict = {}
     for i, (cx, _) in enumerate(compressed):
         buckets.setdefault(cx.dtype, []).append(i)
     out: List[Any] = [None] * len(leaves)
     for dtype, idxs in buckets.items():
         flat = jnp.concatenate([compressed[i][0].ravel() for i in idxs])
-        red = reduce_flat(flat)
+        red = None
+        if max_bucket_bytes:
+            step = max(1, int(max_bucket_bytes) // flat.dtype.itemsize)
+            if flat.size > step:
+                red = jnp.concatenate(
+                    [reduce_flat(flat[s:s + step])
+                     for s in range(0, flat.size, step)])
+        if red is None:
+            red = reduce_flat(flat)
         off = 0
         for i in idxs:
             cx, cctx = compressed[i]
@@ -237,6 +265,38 @@ def _fused_reduce(tensors, compression: Compressor, reduce_flat,
             out[i] = y
             off += sz
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+_fusion_override = _threading.local()
+
+
+@_contextlib.contextmanager
+def fusion_threshold_override(bytes_: Optional[int]):
+    """Thread-locally scope the fusion threshold to the traces performed
+    inside this context — used by the transparent autotuner so a TRIAL
+    value never contaminates other steps traced while tuning is in flight
+    (and nothing leaks if the loop ends before convergence)."""
+    prev = getattr(_fusion_override, "value", None)
+    _fusion_override.value = bytes_
+    try:
+        yield
+    finally:
+        _fusion_override.value = prev
+
+
+def _fusion_threshold() -> Optional[int]:
+    """Trace-time fusion threshold (``HOROVOD_FUSION_THRESHOLD``, bytes).
+    Semantics match the reference: ``0`` disables fusion (one collective
+    per tensor); a positive value caps each fused buffer; None (no
+    context) = one uncapped buffer. An active
+    :func:`fusion_threshold_override` wins over the config."""
+    ov = getattr(_fusion_override, "value", None)
+    if ov is not None:
+        return int(ov)
+    if not _ctx.is_initialized():
+        return None
+    t = _ctx.context().config.fusion_threshold_bytes
+    return int(t) if t is not None and t >= 0 else None
 
 
 def _hierarchical_axes(axis, process_set, op: str):
@@ -317,7 +377,8 @@ def hierarchical_allreduce(tensor: Any, op: str = Average, *,
     return _fused_reduce(
         tensor, compression,
         lambda flat: _hier_reduce_flat(flat, op, intra_axis, cross, n_total,
-                                       prescale_factor, postscale_factor))
+                                       prescale_factor, postscale_factor),
+        max_bucket_bytes=_fusion_threshold())
 
 
 def allreduce(tensor: Any, op: str = Average, *,
@@ -412,7 +473,7 @@ def grouped_allreduce(tensors: Any, op: str = Average, *,
         tensors, compression,
         lambda flat: _reduce_leaf(flat, op, axis, groups, n,
                                   prescale_factor, postscale_factor),
-        member=member)
+        member=member, max_bucket_bytes=_fusion_threshold())
 
 
 def _ragged_set(process_set: Optional[ProcessSet], axis) -> bool:

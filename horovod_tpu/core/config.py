@@ -73,9 +73,14 @@ class Config:
     stall_check_disable: bool = False
     stall_check_warning_sec: float = 60.0
     stall_check_shutdown_sec: float = 0.0  # 0 = never hard-shutdown
-    # Autotune. Reference: parameter_manager.cc.
+    # Autotune. Reference: parameter_manager.cc (+ its env surface:
+    # HOROVOD_AUTOTUNE_WARMUP_SAMPLES / _STEPS_PER_SAMPLE /
+    # _BAYES_OPT_MAX_SAMPLES).
     autotune: bool = False
     autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_max_samples: int = 20
     # Adasum numerics. Reference: ops/adasum/adasum.h.
     adasum_accumulate_dtype: str = "float32"
     # Debug-mode collective-signature mismatch detector (TPU-new; SURVEY §5.2).
@@ -108,6 +113,12 @@ class Config:
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
             autotune=_env_bool("HOROVOD_AUTOTUNE", False),
             autotune_log=autotune_log,
+            autotune_warmup_samples=_env_int(
+                "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int(
+                "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+            autotune_max_samples=_env_int(
+                "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
             adasum_accumulate_dtype=adasum_dtype,
             mismatch_check=_env_bool("HOROVOD_MISMATCH_CHECK", False),
             elastic_timeout_sec=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),

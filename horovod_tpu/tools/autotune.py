@@ -304,6 +304,9 @@ class StepAutotuner:
         out = self._fn(*args, **kwargs)
         if self.chosen is not None:
             return out
+        return self._after_trial_step(out)
+
+    def _after_trial_step(self, out):
         self._count += 1
         if self._count == self.skip_first and self.skip_first > 0:
             # Timing starts after the compile-bearing first step(s).
@@ -323,3 +326,9 @@ class StepAutotuner:
             else:
                 self._begin_trial()
         return out
+
+    # Drop-in for the plain jitted step: make_train_step returns a
+    # StepAutotuner under HOROVOD_AUTOTUNE=1, and user loops call it like
+    # any step function.
+    def __call__(self, *args, **kwargs):
+        return self.step(*args, **kwargs)

@@ -58,7 +58,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     axis_name: Optional[str] = None,
                     mesh=None,
                     donate: bool = True,
-                    scan_steps: Optional[int] = None):
+                    scan_steps: Optional[int] = None,
+                    autotune: Optional[bool] = None):
     """Build the jitted DP train step: ``step(state, batch, labels) ->
     (state, loss)``. ``batch``/``labels`` are sharded over the rank axis,
     state is replicated; the gradient allreduce happens inside ``optimizer``
@@ -66,7 +67,22 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
     ``scan_steps=k`` wraps k consecutive steps in a device-side ``lax.scan``
     over the same batch (one dispatch, one sync) — used by benchmarks to
-    measure pure device throughput without host dispatch in the loop."""
+    measure pure device throughput without host dispatch in the loop.
+
+    ``autotune``: when True — or by default when ``HOROVOD_AUTOTUNE=1`` is
+    set (the reference's zero-user-code transparent tuning,
+    parameter_manager.cc) — the returned step is a
+    :class:`~horovod_tpu.tools.autotune.StepAutotuner` that tunes the
+    gradient-fusion bucket size (``HOROVOD_FUSION_THRESHOLD``) against live
+    throughput while training, logging trials to ``HOROVOD_AUTOTUNE_LOG``
+    and locking in the best knobs after convergence. Same call contract;
+    the chosen knobs are readable as ``step.chosen``."""
+    if autotune is None:
+        autotune = _ctx.is_initialized() and _ctx.context().config.autotune
+    if autotune:
+        return _autotuned_train_step(
+            model, optimizer, loss_fn, axis_name=axis_name, mesh=mesh,
+            donate=donate, scan_steps=scan_steps)
     mesh = mesh if mesh is not None else _ctx.mesh()
     if axis_name is not None:
         axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
@@ -144,6 +160,47 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             out_specs=(P(), P()),
             check_vma=False)
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def _autotuned_train_step(model, optimizer, loss_fn, **build_kw):
+    """HOROVOD_AUTOTUNE=1 engagement: wrap the step in a StepAutotuner that
+    searches the fusion bucket size (the reference tunes its fusion buffer
+    + cycle time the same propose→measure→report way). Each trial mutates
+    ``Config.fusion_threshold_bytes`` and re-traces the step — collectives
+    read the threshold at trace time (``collectives/ops.py::
+    _fusion_threshold``), so the knob genuinely changes the emitted HLO."""
+    from .core.logging import get_logger
+    from .collectives.ops import fusion_threshold_override
+    from .tools.autotune import Autotuner, LogIntDim, StepAutotuner
+
+    cfg = _ctx.context().config
+
+    def build(fusion_threshold_bytes):
+        inner = make_train_step(model, optimizer, loss_fn, autotune=False,
+                                **build_kw)
+        thr = int(fusion_threshold_bytes)
+
+        def stepped(*args, **kwargs):
+            # jit traces lazily (on first call), so the trial threshold is
+            # scoped around every invocation — it reaches THIS step's trace
+            # and never leaks into other functions traced while tuning.
+            with fusion_threshold_override(thr):
+                return inner(*args, **kwargs)
+        return stepped
+
+    space = {"fusion_threshold_bytes": LogIntDim(1 << 20, 1 << 28)}
+    tuner = Autotuner(space, warmup_trials=cfg.autotune_warmup_samples,
+                      max_trials=cfg.autotune_max_samples,
+                      log_path=cfg.autotune_log)
+    get_logger().info(
+        "HOROVOD_AUTOTUNE: tuning fusion threshold live "
+        "(%d warmup / %d max samples, %d steps each%s)",
+        cfg.autotune_warmup_samples, cfg.autotune_max_samples,
+        cfg.autotune_steps_per_sample,
+        f", log={cfg.autotune_log}" if cfg.autotune_log else "")
+    return StepAutotuner(build, space,
+                         steps_per_trial=cfg.autotune_steps_per_sample,
+                         tuner=tuner)
 
 
 # ---------------------------------------------------------------------------
