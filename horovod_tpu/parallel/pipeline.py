@@ -1,10 +1,20 @@
-"""Pipeline parallelism: GPipe-style microbatched stage execution.
+"""Pipeline parallelism: GPipe-style microbatched stage execution + training.
 
 Capability-NEW vs the reference (SURVEY.md §2.6: "PP — absent"). TPU-native
 shape: each device along the ``pp`` mesh axis owns one stage's parameters;
 activations hand off between neighbouring stages with ``lax.ppermute`` (one
 ICI hop); microbatches keep every stage busy except the fill/drain bubble
 (bubble fraction = (n_stages-1)/(n_micro+n_stages-1)).
+
+Training: the forward loop is a ``lax.scan`` (reverse-AD-capable), so
+``jax.grad`` through :func:`pipeline` differentiates the whole schedule —
+the transpose of ``ppermute`` is the inverted permutation, i.e. the
+BACKWARD pipeline (activations flow stage i→i+1 forward, cotangents flow
+i+1→i in the transposed scan), and the transpose of the scan replays
+microbatches in reverse: exactly GPipe's fill/drain backward, derived
+rather than hand-scheduled. :func:`pipeline_value_and_grad` packages this
+into a per-stage gradient step; microbatch gradient accumulation falls out
+of the sum over microbatches inside the loss.
 
 This is the explicit shard_map rendering (every transfer visible, in the
 spirit of this framework); run it inside ``shard_map`` over the pp axis.
@@ -21,7 +31,7 @@ from jax import lax
 
 def pipeline(stage_fn: Callable, stage_params, x_microbatches,
              axis_name: str):
-    """Run microbatches through the pipeline.
+    """Run microbatches through the pipeline (differentiable).
 
     stage_fn(params, x) -> y     (all stages same signature/shapes)
     stage_params: this device's stage parameters (stage i on rank i)
@@ -39,10 +49,10 @@ def pipeline(stage_fn: Callable, stage_params, x_microbatches,
     outs = jnp.zeros((M,) + x_microbatches.shape[1:],
                      x_microbatches.dtype)
 
-    def body(t, carry):
+    def body(carry, t):
         buf, outs = carry
         # stage 0 ingests microbatch t (while t < M); others use received buf
-        feed = jnp.where(t < M, t, M - 1)
+        feed = jnp.clip(t, 0, M - 1)
         x_in = jnp.where(idx == 0, x_microbatches[feed], buf)
         y = stage_fn(stage_params, x_in)
         # last stage records its result for microbatch (t - n + 1)
@@ -54,7 +64,41 @@ def pipeline(stage_fn: Callable, stage_params, x_microbatches,
                                             0),
             outs)
         buf = lax.ppermute(y, axis_name, fwd_perm)
-        return buf, outs
+        return (buf, outs), None
 
-    _, outs = lax.fori_loop(0, total, body, (buf, outs))
+    (_, outs), _ = lax.scan(body, (buf, outs), jnp.arange(total))
     return outs
+
+
+def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
+                            axis_name: str):
+    """Build ``vg(stage_params, x_microbatches, targets) -> (loss, grads)``
+    for pipeline TRAINING inside ``shard_map`` over ``axis_name``.
+
+    ``loss_fn(outs, targets)`` scores the last stage's [M, ...] outputs
+    (targets are replicated; only the last rank's loss counts — it is
+    psum-masked so every rank returns the same scalar). ``grads`` is each
+    rank's gradient for ITS OWN stage parameters, produced by reverse-mode
+    AD through the scan + ppermute chain (the derived backward pipeline).
+    Apply any optax update per-rank; no cross-stage averaging is wanted —
+    stages are different parameters, not replicas.
+    """
+    def vg(stage_params, x_microbatches, targets):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+
+        def loss_of(p):
+            outs = pipeline(stage_fn, p, x_microbatches, axis_name)
+            l = loss_fn(outs, targets)
+            # Mask WITHOUT a psum: differentiating a psum would seed one
+            # cotangent per device and scale every gradient by n (each
+            # device's replicated output gets grad 1). The last rank's seed
+            # alone flows back through the ppermute transposes to every
+            # stage; the masked-zero ranks seed into constants.
+            return jnp.where(idx == n - 1, l, jnp.zeros_like(l))
+
+        loss, grads = jax.value_and_grad(loss_of)(stage_params)
+        # Replicate the scalar AFTER differentiation.
+        return lax.psum(loss, axis_name), grads
+
+    return vg

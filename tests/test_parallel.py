@@ -179,3 +179,82 @@ def test_pipeline_matches_sequential():
     out = np.asarray(f(jnp.asarray(Ws), jnp.asarray(bs), jnp.asarray(xs)))
     # result lands on the last stage (rank N-1)
     np.testing.assert_allclose(out[N - 1], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    """Reverse-mode AD through the pipeline scan+ppermute equals the
+    gradient of the sequential composition, per stage."""
+    from horovod_tpu.parallel.pipeline import pipeline_value_and_grad
+    rng = np.random.RandomState(6)
+    D, M = 3, 5
+    Ws = rng.randn(N, D, D).astype(np.float32) * 0.4
+    xs = rng.randn(M, 2, D).astype(np.float32)
+    ts = rng.randn(M, 2, D).astype(np.float32)
+
+    def stage_fn(W, x):
+        return jnp.tanh(x @ W)
+
+    def loss_fn(outs, targets):
+        return jnp.mean((outs - targets) ** 2)
+
+    # oracle: sequential composition, grad per stage weight
+    def seq_loss(Ws_all):
+        h = jnp.asarray(xs)
+        for s in range(N):
+            h = jnp.tanh(h @ Ws_all[s])
+        return jnp.mean((h - jnp.asarray(ts)) ** 2)
+
+    ref_loss, ref_grads = jax.value_and_grad(seq_loss)(jnp.asarray(Ws))
+
+    mesh = create_mesh({"pp": N})
+    vg = pipeline_value_and_grad(stage_fn, loss_fn, "pp")
+
+    def body(W, x, t):
+        loss, g = vg(W[0], x, t)
+        return loss[None], g[None]
+
+    f = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P(), P()),
+        out_specs=(P("pp"), P("pp")), check_vma=False))
+    loss, grads = f(jnp.asarray(Ws), jnp.asarray(xs), jnp.asarray(ts))
+    np.testing.assert_allclose(np.asarray(loss), ref_loss, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ref_grads),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_training_loss_decreases():
+    """3 SGD steps through the pipelined value-and-grad: loss decreases
+    (the dryrun's pp case runs the same shape)."""
+    from horovod_tpu.parallel.pipeline import pipeline_value_and_grad
+    rng = np.random.RandomState(7)
+    D, M = 4, 6
+    Ws = rng.randn(N, D, D).astype(np.float32) * 0.3
+    xs = rng.randn(M, 2, D).astype(np.float32)
+    ts = rng.randn(M, 2, D).astype(np.float32)
+
+    def stage_fn(W, x):
+        return jnp.tanh(x @ W)
+
+    def loss_fn(outs, targets):
+        return jnp.mean((outs - targets) ** 2)
+
+    mesh = create_mesh({"pp": N})
+    vg = pipeline_value_and_grad(stage_fn, loss_fn, "pp")
+
+    def train(W, x, t):
+        def body(carry, _):
+            Wc = carry
+            loss, g = vg(Wc, x, t)
+            return Wc - 2.0 * g, loss
+        Wf, losses = jax.lax.scan(body, W[0], None, length=8)
+        return Wf[None], losses[None]
+
+    f = jax.jit(shard_map(
+        train, mesh=mesh, in_specs=(P("pp"), P(), P()),
+        out_specs=(P("pp"), P("pp")), check_vma=False))
+    _, losses = f(jnp.asarray(Ws), jnp.asarray(xs), jnp.asarray(ts))
+    losses = np.asarray(losses)[0]  # replicated scalar per step
+    # 8 stacked tanh stages gradient-starve the early ranks, so progress
+    # per step is small; monotone decrease is the training signal.
+    assert np.all(np.diff(losses) < 0), losses
+    assert losses[-1] < losses[0], losses
