@@ -33,6 +33,12 @@ class Store:
     def logs_path(self, run_id: str) -> str:
         return f"{self._prefix}/{run_id}/logs"
 
+    def train_data_path(self, run_id: str) -> str:
+        """Materialised training data (reference: Store.get_train_data_path
+        — where the estimator's intermediate parquet lives; here fixed-
+        record part files, spark/data_store.py)."""
+        return f"{self._prefix}/{run_id}/train_data"
+
     def runs_path(self) -> str:
         return self._prefix
 

@@ -20,8 +20,10 @@ lazily and raise a clear error when absent.
 """
 
 from .runner import run  # noqa: F401
+from .data_store import StoreDataset, materialize_to_store  # noqa: F401
 from .estimator import JaxEstimator, JaxModel  # noqa: F401
 from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
 
 __all__ = ["run", "JaxEstimator", "JaxModel", "TorchEstimator",
+           "StoreDataset", "materialize_to_store",
            "TorchModel"]

@@ -198,6 +198,10 @@ class JaxEstimator:
                 f"batch_size {self.batch_size} must be divisible by the "
                 f"mesh size {n} (global batch shards over the rank axis)")
 
+        from .data_store import StoreDataset
+        if isinstance(data, StoreDataset):
+            return self._fit_store(data)
+
         feats, labels = _materialize(data, self.feature_col, self.label_col)
         rng = np.random.RandomState(self.seed)
         feats, labels, val = _validation_split(feats, labels,
@@ -229,6 +233,56 @@ class JaxEstimator:
                 entry["val_loss"] = self._eval(state, val)
             self.history.append(entry)
             log.info("JaxEstimator epoch %d: %s", epoch, entry)
+
+        fitted = JaxModel(self.model, state.params, state.batch_stats,
+                          feature_col=self.feature_col,
+                          output_col=self.output_col)
+        if self.store is not None:
+            fitted.save(self.store, self.run_id)
+        return fitted
+
+    def _fit_store(self, ds) -> JaxModel:
+        """Streaming fit from a :class:`~horovod_tpu.spark.data_store.
+        StoreDataset`: batches flow store → native RecordPipeline →
+        device, never holding the dataset in RAM (reference: the
+        estimator's Petastorm reader loop, SURVEY §2.5)."""
+        import jax
+        from ..optimizer import distributed
+        from ..train import create_train_state, make_train_step
+
+        if self.validation:
+            raise ValueError(
+                "validation split is not supported with a StoreDataset; "
+                "materialise a separate validation run_id and evaluate "
+                "with JaxModel.predict")
+        steps_per_epoch = ds.steps_per_epoch(self.batch_size)
+        if steps_per_epoch < 1:
+            raise ValueError(
+                f"need at least one global batch ({self.batch_size}) of "
+                f"rows, got {ds.n_rows}")
+
+        dopt = distributed(self.optimizer)
+        state = create_train_state(
+            self.model, jax.random.PRNGKey(self.seed),
+            ds.sample_features(1), dopt)
+        step = make_train_step(self.model, dopt, self.loss, donate=False)
+
+        log = get_logger()
+        for epoch in range(self.epochs):
+            epoch_loss, count = 0.0, 0
+            it = ds.batches(self.batch_size, shuffle=self.shuffle,
+                            seed=self.seed + epoch)
+            try:
+                for feats, labels in it:
+                    state, loss = step(state, feats, labels)
+                    epoch_loss += float(loss)
+                    count += 1
+            finally:
+                it.close()  # release prefetch threads even on a failed step
+            entry = {"epoch": epoch, "loss": epoch_loss / max(1, count)}
+            self.history.append(entry)
+            log.info("JaxEstimator epoch %d (store-streamed): %s",
+                     epoch, entry)
 
         fitted = JaxModel(self.model, state.params, state.batch_stats,
                           feature_col=self.feature_col,

@@ -143,6 +143,10 @@ class TorchEstimator:
                 f"world size {n} (global batch shards over ranks)")
         local_batch = self.batch_size // n
 
+        from .data_store import StoreDataset
+        if isinstance(data, StoreDataset):
+            return self._fit_store(data, local_batch)
+
         feats, labels = _materialize(data, self.feature_col, self.label_col)
         rng = np.random.RandomState(self.seed)
         feats, labels, val = _validation_split(feats, labels,
@@ -152,19 +156,7 @@ class TorchEstimator:
                 f"need at least one global batch ({self.batch_size}) of "
                 f"rows, got {len(feats)}")
 
-        # Reference startup sequence: broadcast params + optimizer state
-        # from rank 0, then hook the optimizer (optimizer.py parity).
-        hvd.broadcast_parameters(self.model.state_dict(), root_rank=0)
-        hvd.broadcast_optimizer_state(self.optimizer, root_rank=0)
-        if self._dopt is None:
-            # Wrap exactly once: DistributedOptimizer registers grad hooks
-            # on the model's parameters, and a second fit() must not stack
-            # a second set (duplicate in-flight names / double reduction).
-            self._dopt = hvd.DistributedOptimizer(
-                self.optimizer,
-                named_parameters=self.model.named_parameters(),
-                backward_passes_per_step=self.backward_passes_per_step)
-        dopt = self._dopt
+        dopt = self._setup_distributed()
 
         log = get_logger()
         steps_per_epoch = len(feats) // self.batch_size
@@ -200,6 +192,77 @@ class TorchEstimator:
             # Rank-0-only save (reference semantics): params are identical
             # on every rank after the averaged updates, and concurrent
             # writes to one Store path would race.
+            fitted.save(self.store, self.run_id)
+        return fitted
+
+    def _setup_distributed(self):
+        """Reference startup sequence: broadcast params + optimizer state
+        from rank 0, then hook the optimizer (optimizer.py parity). Wraps
+        exactly once: DistributedOptimizer registers grad hooks on the
+        model's parameters, and a second fit() must not stack a second set
+        (duplicate in-flight names / double reduction)."""
+        from .. import torch as hvd
+
+        hvd.broadcast_parameters(self.model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(self.optimizer, root_rank=0)
+        if self._dopt is None:
+            self._dopt = hvd.DistributedOptimizer(
+                self.optimizer,
+                named_parameters=self.model.named_parameters(),
+                backward_passes_per_step=self.backward_passes_per_step)
+        return self._dopt
+
+    def _fit_store(self, ds, local_batch: int) -> TorchModel:
+        """Streaming fit: each rank reads ITS shard of the store's part
+        files through the native RecordPipeline (reference: per-executor
+        Petastorm readers); every rank runs the same step count so the
+        gradient collectives stay paired."""
+        import itertools
+
+        import torch
+
+        from .. import torch as hvd
+
+        if self.validation:
+            raise ValueError(
+                "validation split is not supported with a StoreDataset; "
+                "materialise a separate validation run_id")
+        n = hvd.size()
+        steps = ds.min_steps(local_batch, n)
+        if steps < 1:
+            raise ValueError(
+                f"need at least one local batch ({local_batch}) per rank, "
+                f"got shard rows "
+                f"{[ds.shard_rows(r, n) for r in range(n)]}")
+
+        dopt = self._setup_distributed()
+
+        log = get_logger()
+        self.model.train()
+        for epoch in range(self.epochs):
+            it = ds.batches(local_batch, shuffle=self.shuffle,
+                            seed=self.seed + epoch, rank=hvd.rank(),
+                            num_replicas=n)
+            epoch_loss = 0.0
+            try:
+                for feats, labels in itertools.islice(it, steps):
+                    dopt.zero_grad()
+                    out = self.model(torch.as_tensor(feats,
+                                                     dtype=torch.float32))
+                    loss = self.loss(out, _label_tensor(labels))
+                    loss.backward()
+                    dopt.step()
+                    epoch_loss += float(loss.detach())
+            finally:
+                it.close()  # release prefetch threads even on a failed step
+            entry = {"epoch": epoch, "loss": epoch_loss / max(1, steps)}
+            self.history.append(entry)
+            log.info("TorchEstimator epoch %d (store-streamed): %s",
+                     epoch, entry)
+
+        fitted = TorchModel(self.model, feature_col=self.feature_col,
+                            output_col=self.output_col)
+        if self.store is not None and hvd.rank() == 0:
             fitted.save(self.store, self.run_id)
         return fitted
 

@@ -235,3 +235,101 @@ def test_torch_estimator_float64_labels_and_refit(tmp_path):
     est.fit((X, y.astype(np.float64)))   # refit: no second hook stack
     assert est._dopt is first_dopt
     assert len(est.history) == 4
+
+
+# ---------------- store-backed data path (VERDICT r1 item 6) ----------------
+
+def test_materialize_to_store_chunked_spill(tmp_path):
+    """Chunks spill into bounded part files; meta round-trips; peak memory
+    is one part (the fake-ctx seam: an iterator of partitions, the shape a
+    Spark toLocalIterator source produces)."""
+    from horovod_tpu.spark import StoreDataset, materialize_to_store
+    from horovod_tpu.checkpoint.store import LocalStore
+
+    X, y = _toy_data(100)
+    store = LocalStore(str(tmp_path))
+
+    def partitions():
+        for s in range(0, 100, 25):          # 4 partitions of 25 rows
+            yield X[s:s + 25], y[s:s + 25]
+
+    ds = materialize_to_store(partitions(), store, "spill",
+                              rows_per_part=30)
+    # 4 incoming chunks of 25 rows -> 4 parts (chunks are split, not
+    # merged, so memory never exceeds one incoming chunk)
+    assert ds.n_rows == 100
+    assert len(ds.meta["parts"]) == 4
+    assert ds.feature_shape == (3,) and ds.feature_dtype == np.float32
+    import os as _os
+    part0 = _os.path.join(ds.base, ds.meta["parts"][0]["name"])
+    assert _os.path.getsize(part0) == 25 * ds.record_bytes
+
+    # every row comes back exactly once, bit-identical
+    seen_f, seen_l = [], []
+    for f, l in ds.batches(10, shuffle=False, drop_remainder=False):
+        seen_f.append(f)
+        seen_l.append(l)
+    got_f = np.concatenate(seen_f)
+    got_l = np.concatenate(seen_l)
+    order = np.lexsort(got_f.T)
+    ref_order = np.lexsort(X.T)
+    np.testing.assert_array_equal(got_f[order], X[ref_order])
+    np.testing.assert_allclose(got_l[order], y[ref_order])
+
+
+def test_jax_estimator_trains_from_store(tmp_path):
+    """fit(StoreDataset) streams from the store dir and converges without a
+    driver-RAM copy of the dataset."""
+    from horovod_tpu.spark import JaxEstimator, materialize_to_store
+    from horovod_tpu.checkpoint.store import LocalStore
+
+    X, y = _toy_data(256)
+    store = LocalStore(str(tmp_path))
+    ds = materialize_to_store((X, y), store, "stream", rows_per_part=64)
+    est = JaxEstimator(model=_TinyNet(), optimizer=optax.adam(0.1),
+                       loss=_mse, batch_size=64, epochs=20,
+                       store=store, run_id="stream")
+    fitted = est.fit(ds)
+    assert est.history[-1]["loss"] < est.history[0]["loss"] * 0.5
+    preds = fitted.predict(X[:8])
+    assert preds.shape == (8,)
+    assert store.exists(store.checkpoint_path("stream") + "/model.pkl")
+
+
+def test_jax_estimator_store_rejects_validation(tmp_path):
+    from horovod_tpu.spark import JaxEstimator, materialize_to_store
+    from horovod_tpu.checkpoint.store import LocalStore
+
+    X, y = _toy_data(64)
+    store = LocalStore(str(tmp_path))
+    ds = materialize_to_store((X, y), store, "v", rows_per_part=32)
+    est = JaxEstimator(model=_TinyNet(), optimizer=optax.adam(0.1),
+                       loss=_mse, batch_size=32, validation=0.1)
+    with pytest.raises(ValueError, match="validation"):
+        est.fit(ds)
+
+
+def test_torch_estimator_trains_from_store(tmp_path):
+    """Torch path: each rank streams its own shard of part files; step
+    counts stay paired across ranks."""
+    import torch as _torch
+    from horovod_tpu.spark import TorchEstimator, materialize_to_store
+    from horovod_tpu.checkpoint.store import LocalStore
+    from horovod_tpu import torch as thvd
+
+    X, y = _toy_data(240)
+    store = LocalStore(str(tmp_path))
+    ds = materialize_to_store((X, y), store, "tstream", rows_per_part=60)
+
+    thvd.shutdown()
+    thvd.init()   # single process engine
+    net = _torch.nn.Sequential(_torch.nn.Linear(3, 1), _torch.nn.Flatten(0))
+    est = TorchEstimator(model=net,
+                         optimizer=_torch.optim.Adam(net.parameters(),
+                                                     lr=0.05),
+                         loss=_torch.nn.functional.mse_loss,
+                         batch_size=60, epochs=15,
+                         store=store, run_id="tstream")
+    est.fit(ds)
+    assert est.history[-1]["loss"] < est.history[0]["loss"] * 0.5
+    thvd.shutdown()
