@@ -197,10 +197,23 @@ def _reduce_leaf(x, op: str, axis: str, groups, nparticipants: int,
     elif op == Max:
         y = lax.pmax(x, axis, axis_index_groups=groups)
     elif op == Product:
-        # No product collective in XLA: gather then reduce. O(N) memory on a
-        # rarely-used op; reference does the same via MPI_PROD on host.
-        g = lax.all_gather(x, axis, axis=0, axis_index_groups=groups)
-        y = jnp.prod(g, axis=0)
+        # No product collective in XLA. Power-of-2 global reduces use a
+        # log2(N) XOR butterfly over ppermute — O(1) extra memory; process
+        # sets / ragged worlds fall back to gather+prod (O(N) memory,
+        # matching the reference's host MPI_PROD in effect).
+        world = static_axis_size(axis)
+        if (groups is None and not isinstance(axis, tuple)
+                and world is not None and world & (world - 1) == 0):
+            y = x
+            d = 1
+            while d < world:
+                recv = lax.ppermute(y, axis,
+                                    [(r, r ^ d) for r in range(world)])
+                y = y * recv
+                d <<= 1
+        else:
+            g = lax.all_gather(x, axis, axis=0, axis_index_groups=groups)
+            y = jnp.prod(g, axis=0)
     else:
         raise ValueError(f"unsupported reduce op: {op}")
     if postscale_factor != 1.0:
@@ -529,6 +542,21 @@ def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
             return g[rows]
 
         return jax.tree_util.tree_map(ragged_leaf, tensor)
+    if (_is_global(process_set) and isinstance(axis, tuple) and len(axis) >= 2
+            and _ctx.is_initialized()
+            and _ctx.context().config.hierarchical_allgather):
+        # HOROVOD_HIERARCHICAL_ALLGATHER (reference: the NCCL-intra →
+        # cross-node staged gather): gather over the ICI axis first, then
+        # the DCN axes — same bytes, but the DCN hop moves intra-complete
+        # blocks, and XLA schedules the two phases independently. Output
+        # row order (outer-major) matches the flat tuple-axis gather.
+        cross, intra = axis[:-1], axis[-1]
+
+        def hier_leaf(x):
+            y = lax.all_gather(x, intra, axis=0, tiled=True)
+            return lax.all_gather(y, cross, axis=0, tiled=True)
+
+        return jax.tree_util.tree_map(hier_leaf, tensor)
     groups = _groups(process_set, axis, require_equal=True)
 
     def leaf(x):

@@ -134,7 +134,16 @@ def get_ssh_command(a: HostAssignment, command: Sequence[str],
 
 
 def is_local(hostname: str) -> bool:
-    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+    # Any 127.0.0.0/8 IP is this machine (lets tests fake an N-host
+    # topology on one box: localhost, 127.0.0.1, 127.0.0.2, ...). Parse
+    # strictly so a DNS name that merely STARTS with "127." stays remote.
+    if hostname in ("localhost", socket.gethostname()):
+        return True
+    try:
+        import ipaddress
+        return ipaddress.ip_address(hostname).is_loopback
+    except ValueError:
+        return False
 
 
 def routable_local_addr(remote_host: str) -> str:

@@ -63,10 +63,13 @@ def _row_chunks(data, feature_col: str, label_col: str,
     except ImportError:
         pass
     if hasattr(data, "columns") and hasattr(data, "__getitem__"):
-        X = np.stack([np.asarray(v) for v in data[feature_col]])
-        y = np.asarray(data[label_col])
-        for s in range(0, len(X), rows_per_part):
-            yield X[s:s + rows_per_part], y[s:s + rows_per_part]
+        # Stack per WINDOW, not the whole column — peak memory stays one
+        # part, the bound this module promises.
+        fcol, lcol = data[feature_col], data[label_col]
+        for s in range(0, len(fcol), rows_per_part):
+            window = fcol[s:s + rows_per_part]
+            yield (np.stack([np.asarray(v) for v in window]),
+                   np.asarray(lcol[s:s + rows_per_part]))
         return
     if isinstance(data, (Iterator,)) or (isinstance(data, Iterable)
                                          and not hasattr(data, "shape")):

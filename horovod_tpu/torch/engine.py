@@ -476,18 +476,19 @@ class JaxProcessEngine(CollectiveEngine):
     #: mpi_ops keys on this to serialize submission (program order).
     requires_ordered_submission = True
 
-    def _no_subgroup(self, members) -> None:
-        """Subgroup rounds would deadlock: every op here is a collective
-        over ALL processes (multihost_utils has no sub-communicators).
-        Process sets on pods belong to the JAX API (``axis_index_groups``
-        lower to partitioned ICI collectives, core/process_sets.py)."""
-        if members is not None and len(members) != self.size():
-            raise NotImplementedError(
-                "process sets are not supported by the multi-host torch "
-                "engine; use the JAX API's process sets "
-                "(horovod_tpu.add_process_set) for in-graph subgroup "
-                "collectives")
+    def _norm_members(self, members):
+        """Canonical member tuple for a proper subgroup, or None for the
+        global set. Non-members calling a subgroup op raise (reference
+        process_set.cc semantics). Subgroup rounds run ONLY among members:
+        header + payload ride device collectives over a mesh of the member
+        processes (the reference's MPI_Comm_split role), so the other
+        processes are free to run their own ops concurrently — but a
+        subgroup op and ``join()`` must not be mixed on overlapping ranks
+        (join answers GLOBAL rounds only, as in the reference)."""
         self._check_member(members)
+        if members is None or len(members) == self.size():
+            return None
+        return tuple(sorted(members))
 
     def rank(self) -> int:
         return self._jax.process_index()
@@ -512,53 +513,91 @@ class JaxProcessEngine(CollectiveEngine):
 
     # -- primitives (overridden by the test fake) ---------------------------
 
-    def _allgather_fixed(self, arr: np.ndarray) -> np.ndarray:
-        """[...]-shaped array from each process → [size, ...] stack. The
-        ONLY transport primitive; everything else is protocol."""
+    def _allgather_fixed(self, arr: np.ndarray, members=None) -> np.ndarray:
+        """[...]-shaped array from each (member) process → [k, ...] stack
+        in member order. The ONLY transport primitive; everything else is
+        protocol. ``members=None`` = all processes."""
+        if members is not None:
+            return self._device_gather(np.asarray(arr), members)
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(
             np.asarray(arr), tiled=False))
 
+    def _member_mesh(self, members):
+        """One-device-per-member-process mesh (the reference's
+        MPI_Comm_split communicator role). ``members=None`` = all."""
+        jax = self._jax
+        from jax.sharding import Mesh
+        procs = tuple(members) if members is not None \
+            else tuple(range(self.size()))
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        return Mesh(np.asarray([per_proc[p] for p in procs]), ("p",))
+
+    def _device_gather(self, arr: np.ndarray, members) -> np.ndarray:
+        """All-gather over the member mesh: one jitted XLA collective."""
+        jax = self._jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        key = ("gather", arr.shape, str(arr.dtype), tuple(members))
+        entry = self._device_fns.get(key)
+        if entry is None:
+            mesh = self._member_mesh(members)
+            fn = jax.jit(lambda x: x,
+                         out_shardings=NamedSharding(mesh, P()))
+            entry = (fn, mesh)
+            self._device_fns[key] = entry
+        fn, mesh = entry
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+        gx = multihost_utils.host_local_array_to_global_array(
+            arr[None], mesh, P("p"))
+        out = fn(gx)
+        return np.asarray(out.addressable_shards[0].data)
+
     # -- protocol helpers ----------------------------------------------------
 
-    def _gather_obj(self, obj) -> list:
+    def _gather_obj(self, obj, members=None) -> list:
         """Small-object allgather via pickle + pad-to-max (the reference's
-        RequestList serialization role, flatbuffers → pickle)."""
+        RequestList serialization role, flatbuffers → pickle). With
+        ``members``, only those processes meet (member order)."""
         import pickle
         blob = np.frombuffer(
             pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
             dtype=np.uint8).copy()
         sizes = self._allgather_fixed(
-            np.asarray([blob.shape[0]], dtype=np.int64))
+            np.asarray([blob.shape[0]], dtype=np.int64), members)
         m = int(sizes.max())
         padded = np.zeros(m, dtype=np.uint8)
         padded[:blob.shape[0]] = blob
-        g = self._allgather_fixed(padded)
+        g = self._allgather_fixed(padded, members)
         return [pickle.loads(g[i, :int(sizes[i, 0])].tobytes())
                 for i in range(g.shape[0])]
 
-    def _gather_var(self, arr: np.ndarray, shape1, dtype) -> List[np.ndarray]:
+    def _gather_var(self, arr: np.ndarray, shape1, dtype,
+                    members=None) -> List[np.ndarray]:
         """Variable-first-dim payload gather (pad to max rows)."""
         arr = np.asarray(arr, dtype=dtype).reshape((-1,) + tuple(shape1))
         sizes = self._allgather_fixed(
-            np.asarray([arr.shape[0]], dtype=np.int64))
+            np.asarray([arr.shape[0]], dtype=np.int64), members)
         m = max(1, int(sizes.max()))
         padded = np.zeros((m,) + tuple(shape1), dtype=dtype)
         padded[:arr.shape[0]] = arr
-        g = self._allgather_fixed(padded)
+        g = self._allgather_fixed(padded, members)
         return [g[i, :int(sizes[i, 0])] for i in range(g.shape[0])]
 
-    def _round(self, header: dict, payload: np.ndarray):
+    def _round(self, header: dict, payload: np.ndarray, members=None):
         """One negotiated round: header exchange → payload gather.
 
-        Returns (headers, per_rank_payloads). Active ranks must all carry
-        the same (kind, name) — otherwise every rank raises the mismatch
-        error the silent cross-pairing would have hidden.
+        Returns (headers, per_rank_payloads) in member order (global rank
+        order when ``members`` is None). Active ranks must all carry the
+        same (kind, name) — otherwise every rank raises the mismatch error
+        the silent cross-pairing would have hidden.
         """
         with self._lock:
-            headers = self._gather_obj(header)
+            headers = self._gather_obj(header, members)
             active = [r for r, h in enumerate(headers) if not h["joined"]]
-            ops = {(h["kind"], h["name"], h.get("op"))
+            ops = {(h["kind"], h["name"], h.get("op"), h.get("root"))
                    for h in headers if not h["joined"]}
             if len(ops) > 1:
                 raise RuntimeError(
@@ -571,7 +610,8 @@ class JaxProcessEngine(CollectiveEngine):
             shape1 = tuple(ref["shape"][1:])
             if header["joined"]:
                 payload = np.zeros((0,) + shape1, dtype=ref["dtype"])
-            payloads = self._gather_var(payload, shape1, ref["dtype"])
+            payloads = self._gather_var(payload, shape1, ref["dtype"],
+                                        members)
             return headers, payloads
 
     # -- device-backed reduction payload -------------------------------------
@@ -596,7 +636,7 @@ class JaxProcessEngine(CollectiveEngine):
         return np.full(length, big if op == Min else small, dt)
 
     def _device_reduce(self, flat: np.ndarray, op: str,
-                       scatter_shape=None) -> np.ndarray:
+                       scatter_shape=None, members=None) -> np.ndarray:
         """ONE jitted XLA collective over a one-device-per-process mesh.
 
         This is the data plane VERDICT r1 flagged: the old path allgathered
@@ -610,15 +650,12 @@ class JaxProcessEngine(CollectiveEngine):
         """
         jax = self._jax
         import jax.numpy as jnp
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        n = self.size()
-        key = (flat.shape[0], str(flat.dtype), op, scatter_shape)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        key = (flat.shape[0], str(flat.dtype), op, scatter_shape,
+               None if members is None else tuple(members))
         entry = self._device_fns.get(key)
         if entry is None:
-            per_proc = {}
-            for d in jax.devices():
-                per_proc.setdefault(d.process_index, d)
-            mesh = Mesh(np.asarray([per_proc[i] for i in range(n)]), ("p",))
+            mesh = self._member_mesh(members)
             reducer = getattr(jnp, self._JNP_REDUCE[op])
 
             def f(x):
@@ -649,14 +686,16 @@ class JaxProcessEngine(CollectiveEngine):
         h.update(extra or {})
         return h
 
-    def _reduce_header_round(self, kind, name, flat, op, extra=None):
+    def _reduce_header_round(self, kind, name, flat, op, extra=None,
+                             members=None):
         """Header exchange + sanity for the device-reduction ops: returns
         the ACTIVE count. Unlike the gather path, the device payload needs
         identical shape/dtype on every active rank (no pad-to-max), so the
         divergence the padding used to mask becomes an explicit error."""
         ex = {"op": op}
         ex.update(extra or {})
-        headers = self._gather_obj(self._header(kind, name, flat, ex))
+        headers = self._gather_obj(self._header(kind, name, flat, ex),
+                                   members)
         active = [h for h in headers if not h["joined"]]
         ops = {(h["kind"], h["name"], h.get("op")) for h in active}
         if len(ops) > 1:
@@ -672,22 +711,23 @@ class JaxProcessEngine(CollectiveEngine):
         return len(active)
 
     def allreduce(self, name, arr, op, members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         arr = np.asarray(arr)
         if op == Adasum:
             # Adasum's pairwise tree reduction stays on the host gather
             # path (the combine is not an elementwise monoid XLA's
             # reduce lowers to).
-            return self._gather_allreduce(name, arr, op)
+            return self._gather_allreduce(name, arr, op, members)
         flat = arr.reshape(1, -1)
         with self._lock:
-            n_active = self._reduce_header_round("allreduce", name, flat, op)
-            red = self._device_reduce(flat.ravel(), op)
+            n_active = self._reduce_header_round("allreduce", name, flat, op,
+                                                 members=members)
+            red = self._device_reduce(flat.ravel(), op, members=members)
             if op == Average:
                 red = (red / n_active).astype(arr.dtype, copy=False)
             return red.reshape(arr.shape)
 
-    def _gather_allreduce(self, name, arr, op):
+    def _gather_allreduce(self, name, arr, op, members=None):
         """The pre-r2 payload path (full N-way gather + host reduce): kept
         for Adasum and as the A/B baseline in benchmarks/torch_engine_bw.py
         — the device path's win is exactly this path's O(N*bytes) wire
@@ -695,37 +735,48 @@ class JaxProcessEngine(CollectiveEngine):
         arr = np.asarray(arr)
         flat = arr.reshape(1, -1)
         headers, payloads = self._round(
-            self._header("allreduce", name, flat, {"op": op}), flat)
+            self._header("allreduce", name, flat, {"op": op}), flat,
+            members)
         arrays = [payloads[r][0] for r, h in enumerate(headers)
                   if not h["joined"] and len(payloads[r])]
         return reduce_arrays(arrays, op).reshape(arr.shape)
 
     def allgather(self, name, arr, members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         arr = np.asarray(arr)
         headers, payloads = self._round(
-            self._header("allgather", name, arr), arr)
+            self._header("allgather", name, arr), arr, members)
         return np.concatenate([p for p in payloads if p.shape[0]]
                               if any(p.shape[0] for p in payloads)
                               else [arr[:0]])
 
     def broadcast(self, name, arr, root_rank, members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         arr = None if arr is None else np.asarray(arr)
         payload = arr[None] if arr is not None else None
         headers, payloads = self._round(
             self._header("broadcast", name, payload,
-                         {"root": root_rank}), payload)
-        if headers[root_rank]["joined"]:
+                         {"root": root_rank}), payload, members)
+        # headers/payloads are in member order; root_rank is a GLOBAL rank.
+        if members is not None:
+            if root_rank not in members:
+                raise ValueError(
+                    f"broadcast root {root_rank} not in process set "
+                    f"{sorted(members)}")
+            root_pos = members.index(root_rank)
+        else:
+            root_pos = root_rank
+        if headers[root_pos]["joined"]:
             raise RuntimeError(
                 f"broadcast root {root_rank} has already joined")
-        return payloads[root_rank][0]
+        return payloads[root_pos][0]
 
     def alltoall(self, name, arr, splits, members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         arr = np.asarray(arr)
-        n = self.size()
-        me = self.rank()
+        n = self.size() if members is None else len(members)
+        me = self.rank() if members is None \
+            else members.index(self.rank())
         sp = None if splits is None else np.asarray(splits, dtype=np.int64)
         if sp is None:
             if arr.shape[0] % n:
@@ -735,7 +786,7 @@ class JaxProcessEngine(CollectiveEngine):
             sp = np.asarray([arr.shape[0] // n] * n, dtype=np.int64)
         headers, payloads = self._round(
             self._header("alltoall", name, arr,
-                         {"splits": sp.tolist()}), arr)
+                         {"splits": sp.tolist()}), arr, members)
         parts = []
         for src, h in enumerate(headers):
             if h["joined"]:
@@ -747,9 +798,9 @@ class JaxProcessEngine(CollectiveEngine):
                 np.asarray([p.shape[0] for p in parts], dtype=np.int64))
 
     def reducescatter(self, name, arr, op, members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         arr = np.asarray(arr)
-        n = self.size()
+        n = self.size() if members is None else len(members)
         if arr.shape[0] % n:
             raise ValueError(
                 f"reducescatter first dim {arr.shape[0]} not divisible by "
@@ -758,17 +809,18 @@ class JaxProcessEngine(CollectiveEngine):
         with self._lock:
             n_active = self._reduce_header_round(
                 "reducescatter", name, flat, op,
-                {"orig_shape": tuple(arr.shape)})
+                {"orig_shape": tuple(arr.shape)}, members=members)
             red = self._device_reduce(flat.ravel(), op,
-                                      scatter_shape=tuple(arr.shape))
+                                      scatter_shape=tuple(arr.shape),
+                                      members=members)
             if op == Average:
                 red = (red / n_active).astype(arr.dtype, copy=False)
             return red
 
     def barrier(self, name="barrier", members=None):
-        self._no_subgroup(members)
+        members = self._norm_members(members)
         self._round(self._header("barrier", name, None),
-                    np.zeros((1, 0), dtype=np.float32))
+                    np.zeros((1, 0), dtype=np.float32), members)
 
     def join(self) -> int:
         """Reference JoinOp over rounds: keep answering active ranks'

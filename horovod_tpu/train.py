@@ -159,7 +159,26 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
             in_specs=(P(), P(axis), P(axis)),
             out_specs=(P(), P()),
             check_vma=False)
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def marked(*args, **kwargs):
+        # Per-step host-side timeline record (the reference's MARK_CYCLES):
+        # dispatch span + cycle marker; device phases live in the
+        # jax.profiler xplane (tools/profiler.py merges both views). The
+        # timeline is read PER CALL (a runtime check, like the reference's)
+        # so start_timeline/stop_timeline work in any order relative to
+        # building the step, and a closed timeline is never written to.
+        tl = _ctx.context().timeline if _ctx.is_initialized() else None
+        if tl is None or getattr(tl, "_closed", False):
+            return jitted(*args, **kwargs)
+        tl.activity_start("TRAIN_STEP", "DISPATCH")
+        out = jitted(*args, **kwargs)
+        tl.activity_end("TRAIN_STEP", "DISPATCH")
+        tl.mark_cycle()
+        return out
+
+    marked.lower = jitted.lower  # keep AOT introspection available
+    return marked
 
 
 def _autotuned_train_step(model, optimizer, loss_fn, **build_kw):

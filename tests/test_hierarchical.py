@@ -144,6 +144,24 @@ def test_process_set_on_tuple_axis_raises():
         run_allreduce(m2, x, hvd.Sum, process_set=ps)
 
 
+def test_hierarchical_allgather_matches_flat():
+    """HOROVOD_HIERARCHICAL_ALLGATHER on a 2-axis mesh stages the gather
+    (ICI then DCN) with the same rank-order result as the flat gather."""
+    x = np.random.RandomState(9).randn(8, 2, 3).astype(np.float32)
+    outs = {}
+    for flag in (False, True):
+        m2 = init_hier(False, hierarchical_allgather=flag)
+        f = shard_map(lambda t: ops.allgather(t), mesh=m2,
+                      in_specs=P(("cross", "intra")),
+                      out_specs=P(("cross", "intra")))
+        outs[flag] = np.asarray(jax.jit(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-6)
+    # every device's block is the full 8-row gather in global rank order
+    blocks = outs[False].reshape(8, 8, 2, 3)
+    for d in range(8):
+        np.testing.assert_allclose(blocks[d], x, rtol=1e-6)
+
+
 def test_env_var_engages_hierarchical(monkeypatch):
     """HOROVOD_HIERARCHICAL_ALLREDUCE=1 alone must flip the config
     (reference env surface: env_parser.cc)."""

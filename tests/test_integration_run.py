@@ -121,6 +121,12 @@ tmin = thvd.allreduce(torch.tensor([float(thvd.rank() + 1)]), name="mp_min",
                       op="min")
 trs = thvd.reducescatter(torch.arange(4, dtype=torch.float32) * (thvd.rank() + 1),
                          name="mp_rs")
+# process-set ops on the multi-host engine (member-mesh rounds): a
+# singleton set while the OTHER rank does nothing — previously
+# NotImplementedError on this engine
+ps_solo = thvd.add_process_set([thvd.rank()])
+tps = thvd.allreduce(torch.tensor([10.0 + thvd.rank()]), name=f"mp_ps{thvd.rank()}",
+                     process_set=ps_solo)
 
 print(json.dumps({
     "rank": hvd.rank(), "size": hvd.size(),
@@ -128,6 +134,7 @@ print(json.dumps({
     "torch_ar": float(t), "torch_ag": g.flatten().tolist(),
     "torch_objs": o,
     "torch_min": float(tmin), "torch_rs": trs.flatten().tolist(),
+    "torch_ps": float(tps),
 }))
 """
 
@@ -158,6 +165,53 @@ def test_hvdrun_two_process_collectives(tmp_path):
         # sum of [0,1,2,3] and [0,2,4,6] = [0,3,6,9]; rank r keeps chunk r
         assert out["torch_rs"] == ([0.0, 3.0] if out["rank"] == 0
                                    else [6.0, 9.0])
+        # singleton process set: each rank averaged only with itself
+        assert out["torch_ps"] == 10.0 + out["rank"]
+
+
+MP3_WORKER = """
+import json
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import torch
+import horovod_tpu as hvd
+from horovod_tpu import torch as thvd
+hvd.init()
+thvd.init()
+r = thvd.rank()
+if r in (0, 2):
+    # proper multi-member subset: rounds ride a mesh that EXCLUDES rank 1,
+    # which is concurrently free (it goes straight to the global op below)
+    ps = thvd.add_process_set([0, 2])
+    sub = float(thvd.allreduce(torch.tensor([float(r + 1)]), name="sub",
+                               process_set=ps))
+else:
+    sub = -1.0
+g = thvd.allgather(torch.tensor([[r]]), name="all")   # global op after
+print(json.dumps({"rank": r, "sub": sub, "all": g.flatten().tolist()}))
+"""
+
+
+@pytest.mark.integration
+def test_hvdrun_three_process_subgroup(tmp_path):
+    """REAL 3-process run: a {0,2} process-set allreduce over the member
+    mesh while rank 1 is outside it — the multi-host subgroup transport
+    (engine._member_mesh) with genuinely partial process participation."""
+    script = tmp_path / "mp3_worker.py"
+    script.write_text(MP3_WORKER)
+    r = _run_hvdrun(["-np", "3",
+                     "-H", "localhost:1,127.0.0.1:1,127.0.0.2:1",
+                     sys.executable, str(script)], timeout=360)
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3
+    for out in lines:
+        assert out["all"] == [0, 1, 2]
+        if out["rank"] in (0, 2):
+            assert out["sub"] == 2.0        # mean of 1 and 3
+        else:
+            assert out["sub"] == -1.0
 
 
 @pytest.mark.integration

@@ -263,3 +263,44 @@ def test_step_autotuner_skip_first_zero_times_correctly():
     # Scores are steps/sec from a per-trial window, not seconds-since-epoch
     # garbage: all positive and sane.
     assert all(0 < y < 1e9 for y in tuner.tuner._y)
+
+
+def test_train_step_marks_timeline(tmp_path):
+    """With a timeline attached, make_train_step records a per-step
+    dispatch span + cycle marker (the reference's MARK_CYCLES)."""
+    import json
+    import optax
+    from flax import linen as nn
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    import jax
+
+    path = str(tmp_path / "tl.json")
+    hvd.start_timeline(path, mark_cycles=True)
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(2)(x)
+
+    def loss_fn(out, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, labels).mean()
+
+    opt = distributed(optax.sgd(0.1))
+    xs = jnp.asarray(np.random.RandomState(0).randn(8, 3).astype(np.float32))
+    ys = jnp.asarray(np.random.RandomState(1).randint(0, 2, size=(8,)))
+    state = create_train_state(M(), jax.random.PRNGKey(0), xs[:1], opt,
+                               broadcast=False)
+    step = make_train_step(M(), opt, loss_fn, donate=False)
+    for _ in range(3):
+        state, _ = step(state, xs, ys)
+    hvd.stop_timeline()
+
+    events = [e for e in json.load(open(path)) if isinstance(e, dict)]
+    spans = [e for e in events if e.get("cat") == "TRAIN_STEP"
+             and e.get("ph") == "B"]
+    cycles = [e for e in events if e.get("name") == "CYCLE"]
+    assert len(spans) >= 3, events[:20]
+    assert len(cycles) >= 3, events[:20]
