@@ -1,13 +1,16 @@
 from .mesh import AXIS_ORDER, axis_size, create_hybrid_mesh, create_mesh
 from .moe import (RouterOutput, expert_alltoall, expert_alltoall_back,
                   routed_experts, topk_router)
-from .pipeline import pipeline
+from .pipeline import (pipeline, pipeline_1f1b_value_and_grad,
+                       pipeline_value_and_grad)
 from .ring import local_attention, ring_attention
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 
 __all__ = [
     "AXIS_ORDER", "axis_size", "create_hybrid_mesh", "create_mesh",
     "RouterOutput", "expert_alltoall", "expert_alltoall_back",
-    "routed_experts", "topk_router", "pipeline", "local_attention",
+    "routed_experts", "topk_router", "pipeline",
+    "pipeline_value_and_grad", "pipeline_1f1b_value_and_grad",
+    "local_attention",
     "ring_attention", "heads_to_seq", "seq_to_heads", "ulysses_attention",
 ]

@@ -102,3 +102,101 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
         return lax.psum(loss, axis_name), grads
 
     return vg
+
+
+def pipeline_1f1b_value_and_grad(stage_fn: Callable, loss_fn: Callable,
+                                 axis_name: str):
+    """1F1B pipeline training: hand-scheduled forward/backward interleave.
+
+    The GPipe path (:func:`pipeline_value_and_grad`) differentiates the
+    whole forward scan, so reverse-mode keeps every microbatch's
+    activations live — O(M) memory. This schedule interleaves one
+    backward with each forward in lockstep SPMD ticks, so at most
+    ``2(n-1)+1`` microbatch INPUTS are held per stage (a rolling ring) and
+    the stage forward is recomputed inside its backward (activation
+    rematerialisation, the standard TPU trade) — O(n) memory, M-free.
+
+    Schedule (tick t, stage r, n stages, M microbatches):
+      forward of microbatch ``t - r``          (GPipe-style fill)
+      backward of microbatch ``t - 2(n-1) + r`` (cotangents flow last→first
+      via the inverse ppermute; the last stage seeds them from its own
+      same-tick forward through ``loss_fn``)
+    Total ticks: ``M + 2(n-1)``. Note the lockstep tick does one forward
+    AND one backward, so fill/drain idles each slot for ``2(n-1)`` ticks —
+    bubble ``2(n-1)/(M+2(n-1))``, roughly double the AD-GPipe path's for
+    large M, on top of the recompute cost. Choose this form for MEMORY
+    (large M), the GPipe form for throughput at small M.
+
+    ``loss_fn(y_mb, target_mb) -> scalar`` scores ONE microbatch; the
+    returned loss (and the gradients) correspond to the MEAN over
+    microbatches. Returns ``(loss, grads)`` with ``grads`` each rank's
+    gradient for its own stage parameters.
+    """
+    def vg(stage_params, x_microbatches, targets):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        M = x_microbatches.shape[0]
+        K = 2 * (n - 1) + 1  # max in-flight inputs per stage (+1 slack)
+        fwd_perm = [(r, (r + 1) % n) for r in range(n)]
+        bwd_perm = [(r, (r - 1) % n) for r in range(n)]
+        T = M + 2 * (n - 1)
+        inv_m = 1.0 / M
+
+        x0 = x_microbatches[0]
+        carry0 = (
+            jnp.zeros_like(x0),                              # fwd_buf
+            jnp.zeros_like(x0),                              # bwd_buf
+            jnp.zeros((K,) + x0.shape, x0.dtype),            # input ring
+            jax.tree_util.tree_map(jnp.zeros_like,
+                                   stage_params),            # grad acc
+            jnp.zeros((), jnp.float32),                      # loss acc
+        )
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, ring, gacc, lacc = carry
+
+            # ---- forward phase ----
+            mb_f = t - idx
+            valid_f = (mb_f >= 0) & (mb_f < M)
+            mb_f_c = jnp.clip(mb_f, 0, M - 1)
+            x_in = jnp.where(idx == 0, x_microbatches[mb_f_c], fwd_buf)
+            y = stage_fn(stage_params, x_in)
+            ring = jnp.where(
+                valid_f,
+                lax.dynamic_update_index_in_dim(ring, x_in, mb_f_c % K, 0),
+                ring)
+
+            # ---- backward phase (activation remat: ONE stage vjp) ----
+            mb_b = t - 2 * (n - 1) + idx
+            valid_b = (mb_b >= 0) & (mb_b < M)
+            mb_b_c = jnp.clip(mb_b, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(ring, mb_b_c % K, 0,
+                                               keepdims=False)
+            y2, vjp_fn = jax.vjp(stage_fn, stage_params, x_saved)
+            # Cotangent seed: the last stage derives it from the loss on
+            # its own (just recomputed) output — its backward microbatch IS
+            # this tick's forward one; other stages use the cotangent
+            # received from the next stage.
+            lval, dy = jax.value_and_grad(
+                lambda yy: loss_fn(yy, targets[mb_b_c]) * inv_m)(y2)
+            last = idx == n - 1
+            g_in = jnp.where(last, dy, bwd_buf).astype(y2.dtype)
+            dp, dx = vjp_fn(g_in)
+            gacc = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(valid_b, d, jnp.zeros_like(d)),
+                gacc, dp)
+            lacc = lacc + jnp.where(last & valid_b, lval.astype(jnp.float32),
+                                    0.0)
+
+            fwd_buf = lax.ppermute(y, axis_name, fwd_perm)
+            bwd_buf = lax.ppermute(
+                jnp.where(valid_b, dx, jnp.zeros_like(dx)),
+                axis_name, bwd_perm)
+            return (fwd_buf, bwd_buf, ring, gacc, lacc), None
+
+        (f, b, ring, grads, lacc), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        # Loss lives on the last stage's accumulator; replicate it.
+        return lax.psum(jnp.where(idx == n - 1, lacc, 0.0), axis_name), grads
+
+    return vg
