@@ -127,6 +127,9 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, loss_fn: Callable,
     large M, on top of the recompute cost. Choose this form for MEMORY
     (large M), the GPipe form for throughput at small M.
 
+    ``stage_fn(params, x) -> y`` must preserve x's shape/dtype (all
+    stages same signature, like :func:`pipeline` — the activation and
+    cotangent buffers are single fixed-shape ring slots).
     ``loss_fn(y_mb, target_mb) -> scalar`` scores ONE microbatch; the
     returned loss (and the gradients) correspond to the MEAN over
     microbatches. Returns ``(loss, grads)`` with ``grads`` each rank's

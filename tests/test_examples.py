@@ -34,6 +34,10 @@ EXAMPLES = [
                          "--steps", "2", "--warmup", "1"], "tokens/sec"),
     ("torch_synthetic.py", ["--steps", "2", "--warmup", "1",
                             "--fp16-allreduce"], "images/sec"),
+    ("train_pipeline.py", ["--steps", "3", "--microbatches", "4"],
+     "schedule=1f1b"),
+    ("train_pipeline.py", ["--steps", "3", "--microbatches", "4",
+                           "--schedule", "gpipe"], "schedule=gpipe"),
 ]
 
 
