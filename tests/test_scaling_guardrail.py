@@ -18,10 +18,18 @@ def test_scaling_guardrail_emits_sane_efficiency():
         [sys.executable, os.path.join(REPO, "benchmarks", "scaling.py")],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
     assert out.returncode == 0, out.stderr[-2000:]
-    line = out.stdout.strip().splitlines()[-1]
-    rec = json.loads(line)
-    assert rec["metric"] == "dp8_virtual_scaling_efficiency"
+    recs = {}
+    for line in out.stdout.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            recs[rec["metric"]] = rec
+    assert "dp8_virtual_scaling_efficiency" in recs
+    assert "dp8_hierarchical_scaling_efficiency" in recs
     # Ideal is 1.0 on the shared-core CPU mesh; fail loudly if the
     # distributed machinery ever costs >35% of compute at this tiny size
-    # (r2 measured ~1.01).
-    assert 0.65 <= rec["value"] <= 1.6, rec
+    # (r2 measured ~1.01 flat, hierarchical similar).
+    for rec in recs.values():
+        assert 0.65 <= rec["value"] <= 1.6, rec
