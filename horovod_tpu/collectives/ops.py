@@ -69,7 +69,10 @@ def _groups(process_set: Optional[ProcessSet], axis: AxisName,
         # collectives on a hierarchical mesh should run over one axis.
         raise NotImplementedError(
             "process sets are not supported over a multi-axis (hierarchical) "
-            "rank axis; pass a single axis_name for sub-world collectives")
+            "rank axis; pass a single axis_name for sub-world collectives, "
+            "or init() with a 1-D mesh (e.g. unset "
+            "HOROVOD_HIERARCHICAL_ALLREDUCE, whose zero-config path builds "
+            "a 2-axis mesh on multi-process worlds)")
     world = lax.axis_size(axis)
     members = list(process_set.ranks)
     rest = [r for r in range(world) if r not in process_set.ranks]

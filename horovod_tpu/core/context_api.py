@@ -161,7 +161,38 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                 axis_name = mesh.axis_names[0]
         else:
             devs = list(devices) if devices is not None else jax.devices()
-            mesh = Mesh(np.asarray(devs), (axis_name,))
+            if (cfg.hierarchical_allreduce and devices is None
+                    and jax.process_count() > 1):
+                # Reference parity: HOROVOD_HIERARCHICAL_ALLREDUCE needs no
+                # topology input from the user — node boundaries are known.
+                # Here the analog is the process boundary: build a
+                # (cross=process over DCN) x (intra=local devices over ICI)
+                # mesh automatically when the world is homogeneous, so the
+                # env var alone reshapes the gradient exchange.
+                by_proc: dict = {}
+                for d in devs:
+                    by_proc.setdefault(d.process_index, []).append(d)
+                counts = {len(v) for v in by_proc.values()}
+                if len(by_proc) == jax.process_count() and len(counts) == 1:
+                    names = (f"{axis_name}_cross", f"{axis_name}_intra")
+                    mesh = Mesh(
+                        np.asarray([by_proc[p] for p in sorted(by_proc)]),
+                        names)
+                    axis_name = names
+                    get_logger().info(
+                        "hierarchical allreduce: auto mesh %s over %d "
+                        "process(es) x %d local device(s); NOTE process "
+                        "sets need a single-axis mesh — pass mesh=/devices= "
+                        "explicitly to combine them with this flag", names,
+                        len(by_proc), counts.pop())
+                else:
+                    get_logger().warning(
+                        "HOROVOD_HIERARCHICAL_ALLREDUCE=1 ignored: process "
+                        "topology is not homogeneous (per-process device "
+                        "counts %s) — using a flat 1-D mesh",
+                        {p: len(v) for p, v in sorted(by_proc.items())})
+            if mesh is None:
+                mesh = Mesh(np.asarray(devs), (axis_name,))
         ctx = Context(mesh, cfg, axis_name)
         ctx.timeline = timeline
         get_logger().info(

@@ -27,8 +27,8 @@ print(json.dumps({
 """
 
 
-def _run_hvdrun(args, timeout=240):
-    env = dict(os.environ)
+def _run_hvdrun(args, timeout=240, env_extra=None):
+    env = dict(os.environ, **(env_extra or {}))
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -212,6 +212,93 @@ def test_hvdrun_three_process_subgroup(tmp_path):
             assert out["sub"] == 2.0        # mean of 1 and 3
         else:
             assert out["sub"] == -1.0
+
+
+HIER_WORKER = """
+import json
+import os
+os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import numpy as np
+import horovod_tpu as hvd
+from jax.sharding import PartitionSpec as P
+from jax.experimental import multihost_utils
+try:
+    from jax import shard_map
+    _kw = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+    _kw = {"check_rep": False}
+
+hvd.init()   # env var alone: auto cross x intra mesh
+ctx = hvd.core.context()
+assert isinstance(ctx.axis_name, tuple), ctx.axis_name
+f = jax.jit(shard_map(lambda x: hvd.allreduce(x, hvd.Sum), mesh=ctx.mesh,
+                      in_specs=P(ctx.axis_name), out_specs=P(), **_kw))
+x = np.arange(hvd.size() * 2, dtype=np.float32).reshape(hvd.size(), 2)
+gx = multihost_utils.host_local_array_to_global_array(
+    x[hvd.rank():hvd.rank() + 1], ctx.mesh, P(ctx.axis_name))
+local = np.asarray(multihost_utils.global_array_to_host_local_array(
+    f(gx), ctx.mesh, P()))
+print(json.dumps({"rank": hvd.rank(), "axes": list(ctx.axis_name),
+                  "reduced": local.tolist()}))
+"""
+
+
+@pytest.mark.integration
+def test_hvdrun_hierarchical_env_auto_mesh(tmp_path):
+    """HOROVOD_HIERARCHICAL_ALLREDUCE=1 with NO other input: init() builds
+    the cross x intra mesh from the process topology and the default
+    allreduce reduces over it — the reference's zero-config contract."""
+    script = tmp_path / "hier_worker.py"
+    script.write_text(HIER_WORKER)
+    r = _run_hvdrun(["-np", "2", "-H", "localhost:1,127.0.0.1:1",
+                     sys.executable, str(script)], timeout=360)
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 2
+    for out in lines:
+        assert out["axes"] == ["hvd_cross", "hvd_intra"]
+        assert out["reduced"] == [[2.0, 4.0]]   # sum of rows [0,1]+[2,3]
+
+
+ELASTIC_WORKER = """
+import os
+import sys
+marker = os.environ["ELASTIC_TEST_MARKER"]
+if not os.path.exists(marker):
+    with open(marker, "w") as f:
+        f.write("gen0 failed")
+    print("worker: failing first generation", flush=True)
+    sys.exit(1)
+print("worker: recovered-in-generation-2", flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_hvdrun_elastic_relaunches_failed_generation(tmp_path):
+    """REAL elastic launch: --host-discovery-script drives the
+    ElasticDriver; the worker crashes in generation 0, the driver retires
+    the generation and relaunches, generation 1 succeeds — the reference's
+    elastic recovery loop (SURVEY §3.4) end-to-end with live processes."""
+    disco = tmp_path / "discover.sh"
+    disco.write_text("#!/bin/sh\necho localhost:1\n")
+    disco.chmod(0o755)
+    worker = tmp_path / "elastic_worker.py"
+    worker.write_text(ELASTIC_WORKER)
+    marker = tmp_path / "marker"
+    r = _run_hvdrun(["-np", "1", "--min-np", "1", "--max-np", "1",
+                     "--host-discovery-script", str(disco),
+                     sys.executable, str(worker)],
+                    env_extra={"ELASTIC_TEST_MARKER": str(marker)})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    assert marker.exists()
+    combined = r.stdout + r.stderr
+    assert "failing first generation" in combined
+    assert "recovered-in-generation-2" in combined
 
 
 @pytest.mark.integration
