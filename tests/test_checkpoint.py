@@ -87,6 +87,31 @@ def test_restore_onto_different_sharding(tmp_path, mesh8):
                                    np.asarray(state["params"]["w"]))
 
 
+def test_restore_onto_smaller_mesh(tmp_path, mesh8):
+    """Resume after the WORLD RESIZED — save sharded over 8 devices,
+    restore sharded over 4 (the elastic slice-shrink scenario: a new
+    generation with fewer chips reloads the same global arrays)."""
+    from jax.sharding import Mesh
+    state = _sharded_state(mesh8)
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]), (hvd.RANK_AXIS,))
+    with CheckpointManager(str(tmp_path / "c")) as mgr:
+        mgr.save(0, state)
+        mgr.wait_until_finished()
+        like = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=NamedSharding(
+                    mesh4, P(hvd.RANK_AXIS)
+                    if x.shape and x.shape[0] % 4 == 0 else P())), state)
+        out = mgr.restore(like=like)
+    w = out["params"]["w"]
+    assert w.sharding.mesh.devices.size == 4
+    np.testing.assert_allclose(np.asarray(w),
+                               np.asarray(state["params"]["w"]))
+    np.testing.assert_allclose(np.asarray(out["params"]["b"]),
+                               np.asarray(state["params"]["b"]))
+
+
 def test_restore_and_broadcast_single_process(tmp_path):
     loaded = {"lr": 0.1, "epoch": 4}
     calls = []
