@@ -11,8 +11,11 @@ to TPU/XLA and document the mapping for the ones XLA subsumes:
   compiled graph. We forward the value to XLA at ``init()``.
 - ``HOROVOD_CYCLE_TIME`` → no analog (no background drain loop under SPMD);
   accepted and ignored with a debug log for script compatibility.
-- ``HOROVOD_CACHE_CAPACITY`` → no analog (no negotiation → no response
-  cache); accepted and ignored.
+- ``HOROVOD_CACHE_CAPACITY`` → no analog for the in-graph path (no
+  negotiation → no response cache). REAL for the torch multi-host engine:
+  caps its steady-state signature cache (``torch/engine.py``), which
+  replaces the per-op pickled header round with one fixed-size hash
+  mini-round; ``0`` disables it (reference semantics).
 - ``HOROVOD_TIMELINE`` → host-side Chrome-trace writer (tools/timeline.py).
 - ``HOROVOD_AUTOTUNE`` / ``HOROVOD_AUTOTUNE_LOG`` → tools/autotune.py
   (tunes combiner threshold + microbatching instead of fusion/cycle-time).

@@ -472,9 +472,98 @@ class JaxProcessEngine(CollectiveEngine):
         self._lock = threading.RLock()
         self._joined = False
         self._device_fns: dict = {}  # (len, dtype, op, scatter) -> jitted
+        self._cache_init()
 
     #: mpi_ops keys on this to serialize submission (program order).
     requires_ordered_submission = True
+
+    # -- steady-state signature cache ----------------------------------------
+    #
+    # The reference controller's response cache (``response_cache.cc``,
+    # SURVEY.md §2.1) collapses steady-state negotiation to a per-cycle bit
+    # vector: once a tensor's request has been seen everywhere, ranks only
+    # exchange "cache hit" bits instead of full requests. The analog here:
+    # every negotiated op opens with ONE fixed-size int64 allgather (the
+    # "mini round": [signature-hash, joined, want-full]) instead of the
+    # two-gather pickled header round. When every rank reports the same
+    # already-seen signature hash and nobody is joined or asking for a full
+    # round, the header round is skipped — its entire job (op identity +
+    # shape/dtype agreement) is implied by the hash agreement. Any first
+    # occurrence, joined rank, capacity overflow, verification tick
+    # (``HOROVOD_CACHE_VERIFY_EVERY``), or uncacheable op (alltoall: headers
+    # carry per-rank splits) falls back to the full header round, so ``join``
+    # and mismatch diagnostics keep working. ``HOROVOD_CACHE_CAPACITY=0``
+    # (reference env) disables the cache AND the mini round — the pre-cache
+    # wire protocol, byte for byte (must be set uniformly across ranks, as
+    # in the reference).
+
+    def _cache_init(self) -> None:
+        import collections
+        from ..core.config import _env_int
+        self._cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", 1024)
+        self._cache_verify_every = _env_int("HOROVOD_CACHE_VERIFY_EVERY", 0)
+        # signature -> occurrences, LRU-ordered (reference response_cache.cc
+        # evicts too — otherwise one-shot startup ops like a per-parameter
+        # broadcast_parameters() sweep would permanently fill the cache and
+        # silently push the steady-state gradient ops back onto full
+        # rounds). Eviction is local-only and safe: a rank that evicted a
+        # signature re-sends -1/want-full, which drags everyone onto the
+        # full round for that op (the protocol's normal asymmetric path).
+        self._sig_seen: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+
+    @staticmethod
+    def _sig_hash(sig: tuple) -> int:
+        """Deterministic-across-processes positive signature id (the
+        response cache's bit position, widened so no id coordination round
+        is needed). 31-bit so it survives the device transport unmangled —
+        JAX demotes int64 arrays to int32 when x64 is off. Collisions only
+        matter among live cached signatures (≤ capacity, default 1024):
+        P(any collision) ≈ 1024²/2³² ≈ 0.02%, and even a collision is only
+        observable when ranks ALSO diverge on which op they issue (already
+        a program bug) — it would mask that mismatch diagnostic."""
+        import hashlib
+        h = hashlib.blake2b(repr(sig).encode(), digest_size=4).digest()
+        return int.from_bytes(h, "little") & 0x7FFFFFFF
+
+    def _negotiate_mini(self, sig, members=None) -> bool:
+        """The mini round. Returns True when every rank agreed on the same
+        cached signature (header round skippable); False when the full
+        header round must follow. Raises on a steady-state signature
+        mismatch — two ranks issuing different cached ops — which is the
+        cheap form of the header round's mismatch error."""
+        count = 0 if sig is None else self._sig_seen.get(sig, 0)
+        want_full = (sig is None or count == 0
+                     or (self._cache_verify_every > 0
+                         and count % self._cache_verify_every == 0))
+        hid = -1 if sig is None or count == 0 else self._sig_hash(sig)
+        mine = np.asarray(
+            [hid, 1 if self._joined else 0, 1 if want_full else 0],
+            dtype=np.int64)
+        g = self._allgather_fixed(mine, members)
+        if (g[:, 1] != 0).any() or (g[:, 2] != 0).any():
+            return False
+        ids = g[:, 0]
+        if (ids < 0).any() or (ids != ids[0]).any():
+            raise RuntimeError(
+                "collective mismatch across processes: cached signature ids "
+                f"{sorted(set(ids.tolist()))} differ — each process must "
+                "issue the same op in the same order (reference "
+                "response_cache.cc bit-vector check)")
+        return True
+
+    def _sig_commit(self, sig) -> None:
+        """Record one successful occurrence (post-validation, so a raising
+        round is never cached)."""
+        if sig is None or self._cache_capacity <= 0:
+            return
+        c = self._sig_seen.get(sig)
+        if c is None:
+            c = 0
+            while len(self._sig_seen) >= self._cache_capacity:
+                self._sig_seen.popitem(last=False)  # evict LRU
+        self._sig_seen[sig] = c + 1
+        self._sig_seen.move_to_end(sig)
 
     def _norm_members(self, members):
         """Canonical member tuple for a proper subgroup, or None for the
@@ -586,15 +675,32 @@ class JaxProcessEngine(CollectiveEngine):
         g = self._allgather_fixed(padded, members)
         return [g[i, :int(sizes[i, 0])] for i in range(g.shape[0])]
 
-    def _round(self, header: dict, payload: np.ndarray, members=None):
+    def _round(self, header: dict, payload: np.ndarray, members=None,
+               sig=None):
         """One negotiated round: header exchange → payload gather.
 
         Returns (headers, per_rank_payloads) in member order (global rank
         order when ``members`` is None). Active ranks must all carry the
         same (kind, name) — otherwise every rank raises the mismatch error
         the silent cross-pairing would have hidden.
+
+        ``sig``: cacheable signature of everything the header round would
+        establish (see the signature-cache block above). On a clean mini
+        round the pickled header exchange is skipped and headers are
+        synthesized from the local header — valid because hash agreement
+        implies every rank carries the identical signature and nobody is
+        joined. ``sig=None`` = uncacheable (alltoall's per-rank splits,
+        shape-unknown broadcast receivers).
         """
         with self._lock:
+            if self._cache_capacity > 0:
+                if self._negotiate_mini(sig, members):
+                    self._sig_commit(sig)
+                    k = self.size() if members is None else len(members)
+                    shape1 = tuple(header["shape"][1:])
+                    payloads = self._gather_var(
+                        payload, shape1, header["dtype"], members)
+                    return [dict(header, joined=False)] * k, payloads
             headers = self._gather_obj(header, members)
             active = [r for r, h in enumerate(headers) if not h["joined"]]
             ops = {(h["kind"], h["name"], h.get("op"), h.get("root"))
@@ -612,6 +718,7 @@ class JaxProcessEngine(CollectiveEngine):
                 payload = np.zeros((0,) + shape1, dtype=ref["dtype"])
             payloads = self._gather_var(payload, shape1, ref["dtype"],
                                         members)
+            self._sig_commit(sig)
             return headers, payloads
 
     # -- device-backed reduction payload -------------------------------------
@@ -694,6 +801,17 @@ class JaxProcessEngine(CollectiveEngine):
         divergence the padding used to mask becomes an explicit error."""
         ex = {"op": op}
         ex.update(extra or {})
+        sig = None
+        if self._cache_capacity > 0:
+            flat = np.asarray(flat)
+            sig = ("reduce", kind, name, tuple(flat.shape), str(flat.dtype),
+                   op, tuple(sorted((extra or {}).items())), members)
+            if self._negotiate_mini(sig, members):
+                # Clean mini: hash agreement implies every active rank has
+                # the identical (kind, name, shape, dtype, op) — the full
+                # checks below would pass — and no rank is joined.
+                self._sig_commit(sig)
+                return self.size() if members is None else len(members)
         headers = self._gather_obj(self._header(kind, name, flat, ex),
                                    members)
         active = [h for h in headers if not h["joined"]]
@@ -708,6 +826,7 @@ class JaxProcessEngine(CollectiveEngine):
             raise RuntimeError(
                 f"{kind} {name!r}: shape/dtype differs across processes: "
                 f"{sorted(sigs)}")
+        self._sig_commit(sig)
         return len(active)
 
     def allreduce(self, name, arr, op, members=None):
@@ -736,7 +855,9 @@ class JaxProcessEngine(CollectiveEngine):
         flat = arr.reshape(1, -1)
         headers, payloads = self._round(
             self._header("allreduce", name, flat, {"op": op}), flat,
-            members)
+            members,
+            sig=("gather", "allreduce", name, tuple(flat.shape),
+                 str(flat.dtype), op, members))
         arrays = [payloads[r][0] for r, h in enumerate(headers)
                   if not h["joined"] and len(payloads[r])]
         return reduce_arrays(arrays, op).reshape(arr.shape)
@@ -745,7 +866,9 @@ class JaxProcessEngine(CollectiveEngine):
         members = self._norm_members(members)
         arr = np.asarray(arr)
         headers, payloads = self._round(
-            self._header("allgather", name, arr), arr, members)
+            self._header("allgather", name, arr), arr, members,
+            sig=("gather", "allgather", name, tuple(arr.shape[1:]),
+                 str(arr.dtype), members))
         return np.concatenate([p for p in payloads if p.shape[0]]
                               if any(p.shape[0] for p in payloads)
                               else [arr[:0]])
@@ -754,9 +877,15 @@ class JaxProcessEngine(CollectiveEngine):
         members = self._norm_members(members)
         arr = None if arr is None else np.asarray(arr)
         payload = arr[None] if arr is not None else None
+        # Shape-unknown receivers (arr=None) can't sign the round — they
+        # learn shape/dtype from the root's header, so they force the full
+        # round every time (rare: parameter broadcasts pass tensors).
+        sig = None if arr is None else (
+            "gather", "broadcast", name, tuple(arr.shape), str(arr.dtype),
+            root_rank, members)
         headers, payloads = self._round(
             self._header("broadcast", name, payload,
-                         {"root": root_rank}), payload, members)
+                         {"root": root_rank}), payload, members, sig=sig)
         # headers/payloads are in member order; root_rank is a GLOBAL rank.
         if members is not None:
             if root_rank not in members:
@@ -820,7 +949,8 @@ class JaxProcessEngine(CollectiveEngine):
     def barrier(self, name="barrier", members=None):
         members = self._norm_members(members)
         self._round(self._header("barrier", name, None),
-                    np.zeros((1, 0), dtype=np.float32), members)
+                    np.zeros((1, 0), dtype=np.float32), members,
+                    sig=("gather", "barrier", name, members))
 
     def join(self) -> int:
         """Reference JoinOp over rounds: keep answering active ranks'
@@ -829,6 +959,13 @@ class JaxProcessEngine(CollectiveEngine):
         self._joined = True
         try:
             while True:
+                if self._cache_capacity > 0:
+                    # Speak the mini-round protocol so active ranks' cached
+                    # ops see our joined bit and fall back to the full
+                    # header round (which is how we learn what op to answer
+                    # with). Never returns True: our own joined flag is in
+                    # the gather.
+                    self._negotiate_mini(None)
                 headers = self._gather_obj(
                     {"kind": "join_poll", "name": "join", "joined": True,
                      "rank": self.rank()})

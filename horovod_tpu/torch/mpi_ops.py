@@ -311,6 +311,36 @@ def grouped_allreduce_(tensors, **kw):
     return synchronize(grouped_allreduce_async_(tensors, **kw))
 
 
+def allreduce_fused_async_(tensors, op: str = Average,
+                           name: Optional[str] = None,
+                           compression=Compression.none,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0,
+                           process_set: Optional[ProcessSet] = None) -> int:
+    """ONE engine collective for a list of same-dtype tensors: concatenate
+    into a flat fusion buffer, allreduce it, scatter the result back into
+    the tensors in place (reference ``fusion_buffer_manager.cc``'s
+    MEMCPY_IN/OUT_OF_FUSION_BUFFER, SURVEY.md §2.1 — the bandwidth/latency
+    form, vs ``grouped_allreduce_`` which issues one *named* engine op per
+    tensor and only guarantees atomicity). On the multi-host engine this is
+    what collapses a P-parameter gradient step from O(P) negotiated rounds
+    to O(buckets)."""
+    rt = _rt()
+    m = _members(process_set)
+
+    def run(nm):
+        flat = torch.cat([t.detach().reshape(-1) for t in tensors])
+        res = _allreduce_impl(flat, op, nm, compression, prescale_factor,
+                              postscale_factor, None, m)
+        off = 0
+        for t in tensors:
+            n = t.numel()
+            t.copy_(res[off:off + n].view_as(t).to(t.dtype))
+            off += n
+        return tensors
+    return rt.submit("allreduce", name, run)
+
+
 def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
     if average is not None and op is not None:
         raise ValueError("specify either average or op, not both "

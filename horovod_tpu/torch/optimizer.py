@@ -57,6 +57,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     p for g in self.param_groups for p in g["params"])}
 
         self._handles = {}
+        self._defer_cached = None  # per-step latch for _defer_submission
         self._passes = {}
         self._sparse_params = {}  # param -> sparse_dim of its grads
         self._sync_count = 0      # distinguishes per-step meta-round names
@@ -85,12 +86,50 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return getattr(_ops._rt().engine, "requires_ordered_submission",
                        False)
 
+    @staticmethod
+    def _fusion_threshold_bytes() -> int:
+        """``HOROVOD_FUSION_THRESHOLD`` (bytes; reference default 64 MiB;
+        0 disables fusion — reference semantics). Resolved through the
+        SAME chain the in-graph path uses (autotuner/thread-local override
+        > initialized context config > env via ``Config.from_env``) so the
+        'one env var, every fusion mechanism' contract in PARITY §4 holds;
+        read per step so a live optimizer can be retuned."""
+        from ..collectives.ops import _fusion_threshold
+        from ..core import context_api as _ctx
+        t = _fusion_threshold()
+        if t is None:
+            if _ctx.is_initialized():
+                return 1 << 62  # context says uncapped: one bucket
+            from ..core.config import Config
+            t = Config.from_env().fusion_threshold_bytes
+        return int(t)
+
+    @property
+    def _defer_submission(self) -> bool:
+        """Fusion buckets are packed in ``synchronize()`` from the full due
+        set, so fusion ALSO defers (on every engine — bucket contents and
+        names are canonical-order-deterministic, which is what name-keyed
+        rendezvous needs too). Adasum stays per-parameter (its coefficients
+        are per-tensor dot products; fusing would change the math —
+        reference runs Adasum on fused buffers but scales each tensor by
+        its own coefficients, which our engines apply per op).
+
+        Resolved once per step (``synchronize()`` clears the latch), not
+        once per hook fire — threshold resolution walks the config chain,
+        too heavy for a per-parameter autograd hook."""
+        if self._defer_cached is None:
+            self._defer_cached = (
+                self._ordered_engine
+                or (self._fusion_threshold_bytes() > 0
+                    and self._op != Adasum))
+        return self._defer_cached
+
     def _make_hook(self):
         def hook(p):
             self._passes[p] += 1
             if self._passes[p] == self.backward_passes_per_step:
                 self._passes[p] = 0
-                if self._ordered_engine:
+                if self._defer_submission:
                     self._handles[p] = _DEFERRED
                 else:
                     self._handles[p] = self._allreduce_grad_async(p)
@@ -185,6 +224,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if not self._sparse_as_dense:
                 self._exchange_sparse_param_meta()
             self._sync_count += 1
+            deferred = []
             for group in self.param_groups:
                 for p in group["params"]:
                     if not p.requires_grad:
@@ -210,19 +250,88 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                 p.grad = torch.zeros_like(p)
                         self._handles[p] = _DEFERRED
                     if self._handles[p] is _DEFERRED:
-                        # Hook-marked or filled-in: submit HERE, in
+                        # Hook-marked or filled-in: submitted below, in
                         # canonical param-group order — on order-matched
                         # engines this makes every rank's submission
                         # sequence identical even when ready-order or op
-                        # sets diverged during backward.
-                        self._handles[p] = self._allreduce_grad_async(p)
+                        # sets diverged during backward; with fusion on it
+                        # additionally makes bucket contents identical.
+                        deferred.append(p)
+            self._submit_deferred(deferred)
+            synced_fused = set()
             for p, handle in list(self._handles.items()):
                 if isinstance(handle, tuple) and handle[0] == "sparse":
                     p.grad = _ops.synchronize(handle[2])
+                elif isinstance(handle, tuple) and handle[0] == "fused":
+                    if handle[1] not in synced_fused:
+                        synced_fused.add(handle[1])
+                        _ops.synchronize(handle[1])
                 else:
                     _ops.synchronize(handle)
             self._handles.clear()
+        self._defer_cached = None  # re-resolve the threshold next step
         self._synchronized = True
+
+    def _submit_deferred(self, params):
+        """Submit deferred gradients in canonical order. Dense gradients
+        are packed into per-dtype fusion buckets capped at
+        ``HOROVOD_FUSION_THRESHOLD`` and each bucket rides ONE fused
+        engine allreduce (reference fusion_buffer_manager.cc /
+        parameter_manager.cc tensor fusion — the mechanism that collapses
+        the P-parameter hot path to O(buckets) collectives per step).
+        Sparse gradients and Adasum keep their per-parameter ops, in the
+        same canonical positions on every rank."""
+        threshold = self._fusion_threshold_bytes()
+        fuse = threshold > 0 and self._op != Adasum
+        buckets: dict = {}      # dtype key -> [params, bytes]
+        bucket_seq: dict = {}   # dtype key -> next bucket index
+
+        def flush(dt):
+            plist, _ = buckets.pop(dt)
+            i = bucket_seq.get(dt, 0)
+            bucket_seq[dt] = i + 1
+            # Stable across steps (no step counter) so the engine's
+            # signature cache gets a steady-state hit.
+            handle = self._fused_allreduce_async(plist,
+                                                 f"fused_grad.{dt}.{i}")
+            for q in plist:
+                self._handles[q] = ("fused", handle)
+
+        for p in params:
+            grad = p.grad
+            if not fuse or grad.is_sparse:
+                self._handles[p] = self._allreduce_grad_async(p)
+                continue
+            dt = str(grad.dtype).replace("torch.", "")
+            nbytes = grad.numel() * grad.element_size()
+            cur = buckets.get(dt)
+            if cur is not None and cur[1] + nbytes > threshold:
+                flush(dt)
+                cur = None
+            if cur is None:
+                buckets[dt] = [[p], nbytes]
+            else:
+                cur[0].append(p)
+                cur[1] += nbytes
+        for dt in list(buckets):
+            flush(dt)
+
+    def _fused_allreduce_async(self, plist, name):
+        """One fused allreduce for a same-dtype bucket, applying the same
+        op/prescale algebra as the per-parameter path (division by
+        ``backward_passes_per_step`` becomes a prescale on the flat
+        buffer — same mean, one pass)."""
+        grads = [p.grad for p in plist]
+        k = self.backward_passes_per_step
+        if self._op == Average and self._gradient_predivide_factor != 1.0:
+            f = self._gradient_predivide_factor
+            return _ops.allreduce_fused_async_(
+                grads, op=Sum, name=name, compression=self._compression,
+                prescale_factor=1.0 / (f * k),
+                postscale_factor=f / _ops.size())
+        return _ops.allreduce_fused_async_(
+            grads, op=self._op, name=name, compression=self._compression,
+            prescale_factor=1.0 / k)
 
     @contextlib.contextmanager
     def skip_synchronize(self):

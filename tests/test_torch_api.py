@@ -842,3 +842,122 @@ def test_grouped_reducescatter():
     torch.testing.assert_close(r0[0], torch.full((2, 2), 3.0))
     torch.testing.assert_close(r1[0], torch.full((2, 2), 3.0))
     torch.testing.assert_close(r0[1], torch.full((1, 3), 3.0))
+
+
+# --- gradient tensor fusion (VERDICT r2 #1) ---------------------------------
+
+class _CountingEngine:
+    """ThreadSimEngine recording every engine-level allreduce name."""
+
+    def __new__(cls, n):
+        import threading as _threading
+        from horovod_tpu.torch.engine import ThreadSimEngine
+
+        class _Impl(ThreadSimEngine):
+            def __init__(self, k):
+                super().__init__(k)
+                self.allreduce_names = []
+                self._count_lock = _threading.Lock()
+
+            def allreduce(self, name, arr, op, members=None):
+                with self._count_lock:
+                    self.allreduce_names.append(name)
+                return super().allreduce(name, arr, op, members=members)
+        return _Impl(n)
+
+
+def _set_fusion_threshold(monkeypatch, value):
+    """The optimizer resolves the threshold through the in-graph chain
+    (override > context config > env), so a live context's config must be
+    patched too — env alone is only read when no context exists."""
+    import horovod_tpu.core.context_api as ctx_api
+    if value is None:
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+    else:
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(value))
+    if ctx_api.is_initialized():
+        monkeypatch.setattr(
+            ctx_api.context().config, "fusion_threshold_bytes",
+            64 * 1024 * 1024 if value is None else value)
+
+
+def _fusion_step(sd, r, lr=0.1):
+    model = _make_model(3)
+    model.load_state_dict(sd)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=lr),
+        named_parameters=model.named_parameters())
+    x = torch.full((2, 4), float(r + 1))
+    model(x).sum().backward()
+    opt.step()
+    return [p.detach().clone() for p in model.parameters()]
+
+
+def test_fused_gradient_hot_path_op_count(monkeypatch):
+    """The gradient hot path is O(buckets), not O(parameters): the default
+    64 MiB HOROVOD_FUSION_THRESHOLD packs all four gradients of the test
+    model into ONE engine allreduce per step, while threshold 0 restores
+    the per-parameter path (reference fusion_buffer_manager.cc semantics),
+    and both produce identical parameters."""
+    n = 2
+    sd = _make_model(3).state_dict()
+
+    def run(threshold):
+        _set_fusion_threshold(monkeypatch, threshold)
+        eng = _CountingEngine(n)
+        outs = run_parallel(n, lambda r: _fusion_step(sd, r), engine=eng)
+        return eng.allreduce_names, outs
+
+    names_fused, outs_fused = run(None)
+    assert len(names_fused) == n * 1, names_fused
+    assert all(nm.startswith("fused_grad.float32.") for nm in names_fused)
+
+    names_unfused, outs_unfused = run(0)
+    assert len(names_unfused) == n * 4, names_unfused
+
+    for a, b in zip(outs_fused[0], outs_unfused[0]):
+        torch.testing.assert_close(a, b)
+    for a, b in zip(*outs_fused):
+        torch.testing.assert_close(a, b)
+
+
+def test_fusion_threshold_shapes_buckets(monkeypatch):
+    """Grads in canonical order are 128/32/32/4 bytes; a 130-byte cap must
+    yield exactly two buckets with stable (cache-friendly) names."""
+    n = 2
+    sd = _make_model(3).state_dict()
+    _set_fusion_threshold(monkeypatch, 130)
+    eng = _CountingEngine(n)
+    outs = run_parallel(n, lambda r: _fusion_step(sd, r), engine=eng)
+    per_rank = sorted(nm for nm in eng.allreduce_names)[::n]
+    assert per_rank == ["fused_grad.float32.0", "fused_grad.float32.1"], (
+        eng.allreduce_names)
+    for a, b in zip(*outs):
+        torch.testing.assert_close(a, b)
+
+
+def test_fused_matches_predivide_and_local_aggregation(monkeypatch):
+    """Fusion composes with gradient_predivide_factor and
+    backward_passes_per_step: fused and unfused runs agree."""
+    n = 2
+    sd = _make_model(4).state_dict()
+
+    def fn(r):
+        model = _make_model(4)
+        model.load_state_dict(sd)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2, gradient_predivide_factor=2.0)
+        for i in range(2):
+            x = torch.full((2, 4), float(r + i + 1))
+            model(x).sum().backward()
+        opt.step()
+        return [p.detach().clone() for p in model.parameters()]
+
+    _set_fusion_threshold(monkeypatch, 64 * 1024 * 1024)
+    fused = run_parallel(n, fn)
+    _set_fusion_threshold(monkeypatch, 0)
+    unfused = run_parallel(n, fn)
+    for a, b in zip(fused[0], unfused[0]):
+        torch.testing.assert_close(a, b)

@@ -63,6 +63,7 @@ class _FakeJaxEngine(JaxProcessEngine):
         self._bus = bus
         self._lock = threading.RLock()
         self._joined = False
+        self._cache_init()
 
     def rank(self):
         return self._rank_v
@@ -281,3 +282,160 @@ def test_threadsim_stall_raises():
     eng.set_rank(0)
     with pytest.raises(RuntimeError, match="stalled"):
         eng.allreduce("lonely", np.ones(2), Sum)
+
+
+# --- steady-state signature cache (VERDICT r2 #1b) ---------------------------
+
+class _CountingFakeEngine(_FakeJaxEngine):
+    """Counts host-side negotiation gathers (``_allgather_fixed``)."""
+
+    def __init__(self, rank, size, bus):
+        super().__init__(rank, size, bus)
+        self.host_rounds = 0
+
+    def _allgather_fixed(self, arr, members=None):
+        self.host_rounds += 1
+        return super()._allgather_fixed(arr, members)
+
+
+def _run_counting(n, fn):
+    bus = _Bus(n)
+    engines = [_CountingFakeEngine(r, n, bus) for r in range(n)]
+    results = [None] * n
+    errors = []
+
+    def worker(r):
+        try:
+            results[r] = fn(engines[r], r)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "engine threads hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def test_cache_allreduce_steady_state_one_host_round(monkeypatch):
+    """First occurrence pays mini + full header round (3 host gathers);
+    every later occurrence pays ONLY the mini round (1 host gather) before
+    the device payload — the response-cache steady state."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
+    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    def fn(eng, r):
+        counts = []
+        for _ in range(3):
+            before = eng.host_rounds
+            eng.allreduce("g", np.full(4, r + 1.0, np.float32), Sum)
+            counts.append(eng.host_rounds - before)
+        return counts
+
+    for counts in _run_counting(2, fn):
+        assert counts == [3, 1, 1], counts
+
+
+def test_cache_allgather_steady_state(monkeypatch):
+    """Gather-path ops skip the pickled header round too: 5 host gathers
+    first (mini + 2 header + 2 payload), 3 after (mini + 2 payload) —
+    and ragged row counts still work on the cached path."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
+    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    def fn(eng, r):
+        first = eng.host_rounds
+        a = eng.allgather("ag", np.full((r + 1, 2), r, np.float32))
+        first = eng.host_rounds - first
+        steady = eng.host_rounds
+        b = eng.allgather("ag", np.full((r + 2, 2), r, np.float32))
+        steady = eng.host_rounds - steady
+        return first, steady, a, b
+
+    for first, steady, a, b in _run_counting(2, fn):
+        assert first == 5 and steady == 3, (first, steady)
+        assert a.shape == (3, 2) and b.shape == (5, 2)
+
+
+def test_cache_steady_state_mismatch_raises(monkeypatch):
+    """Two ranks issuing DIFFERENT cached ops must raise the mismatch
+    error from the mini round itself, not hang or cross-pair."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
+    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    def fn(eng, r):
+        eng.allreduce("a", np.ones(2, np.float32), Sum)
+        eng.allreduce("b", np.ones(2, np.float32), Sum)
+        # now diverge: rank 0 re-issues "a", rank 1 re-issues "b"
+        with pytest.raises(RuntimeError, match="mismatch"):
+            eng.allreduce("a" if r == 0 else "b",
+                          np.ones(2, np.float32), Sum)
+        return True
+
+    assert all(_run_counting(2, fn))
+
+
+def test_cache_capacity_zero_disables_mini_round(monkeypatch):
+    """HOROVOD_CACHE_CAPACITY=0 (reference env) restores the pre-cache
+    wire protocol: no mini round, 2 host gathers per allreduce forever."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "0")
+
+    def fn(eng, r):
+        counts = []
+        for _ in range(2):
+            before = eng.host_rounds
+            eng.allreduce("g", np.ones(3, np.float32), Sum)
+            counts.append(eng.host_rounds - before)
+        # gather-path ops (which pass a sig to _round unconditionally)
+        # must also survive capacity 0 — regression: _sig_commit used to
+        # evict from an empty OrderedDict here.
+        for _ in range(2):
+            before = eng.host_rounds
+            eng.allgather("ag", np.full((r + 1, 2), r, np.float32))
+            counts.append(eng.host_rounds - before)
+        return counts
+
+    for counts in _run_counting(2, fn):
+        assert counts == [2, 2, 4, 4], counts
+
+
+def test_cache_verify_every_reverifies(monkeypatch):
+    """HOROVOD_CACHE_VERIFY_EVERY=2 periodically re-runs the full header
+    round as a divergence audit."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
+    monkeypatch.setenv("HOROVOD_CACHE_VERIFY_EVERY", "2")
+
+    def fn(eng, r):
+        counts = []
+        for _ in range(4):
+            before = eng.host_rounds
+            eng.allreduce("g", np.ones(3, np.float32), Sum)
+            counts.append(eng.host_rounds - before)
+        return counts
+
+    for counts in _run_counting(2, fn):
+        assert counts == [3, 1, 3, 1], counts
+
+
+def test_cache_join_falls_back_to_full_rounds(monkeypatch):
+    """A joined rank forces cached ops back onto the full header round so
+    its zero/identity contributions keep working (steady-state ops before
+    the join, join-covered ops after)."""
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
+    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    def fn(eng, r):
+        out1 = eng.allreduce("g", np.full(2, r + 1.0, np.float32), Sum)
+        out2 = eng.allreduce("g", np.full(2, r + 1.0, np.float32), Sum)
+        if r == 0:
+            eng.join()
+            return out1, out2, None
+        out3 = eng.allreduce("g", np.full(2, 5.0, np.float32), Sum)
+        eng.join()
+        return out1, out2, out3
+
+    outs = _run_counting(2, fn)
+    np.testing.assert_allclose(outs[0][0], [3.0, 3.0])
+    np.testing.assert_allclose(outs[1][1], [3.0, 3.0])
+    np.testing.assert_allclose(outs[1][2], [5.0, 5.0])
