@@ -67,7 +67,9 @@ class Config:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     # Accepted-for-compat knobs with no SPMD analog. Reference: operations.cc.
     cycle_time_ms: float = 1.0
+    # Torch-engine signature cache (response_cache.cc analog; 0 disables).
     cache_capacity: int = 1024
+    cache_verify_every: int = 0  # full-header audit every k-th occurrence
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     # Observability. Reference: timeline.cc, stall_inspector.cc.
@@ -103,6 +105,7 @@ class Config:
                 "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
             cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", 1.0),
             cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
+            cache_verify_every=_env_int("HOROVOD_CACHE_VERIFY_EVERY", 0),
             hierarchical_allreduce=_env_bool(
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool(

@@ -181,9 +181,7 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                     axis_name = names
                     get_logger().info(
                         "hierarchical allreduce: auto mesh %s over %d "
-                        "process(es) x %d local device(s); NOTE process "
-                        "sets need a single-axis mesh — pass mesh=/devices= "
-                        "explicitly to combine them with this flag", names,
+                        "process(es) x %d local device(s)", names,
                         len(by_proc), counts.pop())
                 else:
                     get_logger().warning(

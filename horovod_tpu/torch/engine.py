@@ -499,9 +499,15 @@ class JaxProcessEngine(CollectiveEngine):
 
     def _cache_init(self) -> None:
         import collections
-        from ..core.config import _env_int
-        self._cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", 1024)
-        self._cache_verify_every = _env_int("HOROVOD_CACHE_VERIFY_EVERY", 0)
+        from ..core import context_api as _ctx
+        from ..core.config import Config
+        # The initialized context's config wins (programmatic
+        # Config(cache_capacity=...) stays live); env otherwise — the same
+        # chain the fusion threshold resolves through.
+        cfg = _ctx.context().config if _ctx.is_initialized() \
+            else Config.from_env()
+        self._cache_capacity = int(cfg.cache_capacity)
+        self._cache_verify_every = int(cfg.cache_verify_every)
         # signature -> occurrences, LRU-ordered (reference response_cache.cc
         # evicts too — otherwise one-shot startup ops like a per-parameter
         # broadcast_parameters() sweep would permanently fill the cache and

@@ -286,6 +286,20 @@ def test_threadsim_stall_raises():
 
 # --- steady-state signature cache (VERDICT r2 #1b) ---------------------------
 
+def _pin_cache(monkeypatch, capacity=1024, verify_every=0):
+    """Pin the signature-cache config. The engine resolves it through the
+    context config when one is initialized (programmatic Config wins), so
+    patch both the env and any live context."""
+    import horovod_tpu.core.context_api as ctx_api
+    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", str(capacity))
+    monkeypatch.setenv("HOROVOD_CACHE_VERIFY_EVERY", str(verify_every))
+    if ctx_api.is_initialized():
+        monkeypatch.setattr(ctx_api.context().config, "cache_capacity",
+                            capacity)
+        monkeypatch.setattr(ctx_api.context().config, "cache_verify_every",
+                            verify_every)
+
+
 class _CountingFakeEngine(_FakeJaxEngine):
     """Counts host-side negotiation gathers (``_allgather_fixed``)."""
 
@@ -326,8 +340,7 @@ def test_cache_allreduce_steady_state_one_host_round(monkeypatch):
     """First occurrence pays mini + full header round (3 host gathers);
     every later occurrence pays ONLY the mini round (1 host gather) before
     the device payload — the response-cache steady state."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
-    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    _pin_cache(monkeypatch)
     def fn(eng, r):
         counts = []
         for _ in range(3):
@@ -344,8 +357,7 @@ def test_cache_allgather_steady_state(monkeypatch):
     """Gather-path ops skip the pickled header round too: 5 host gathers
     first (mini + 2 header + 2 payload), 3 after (mini + 2 payload) —
     and ragged row counts still work on the cached path."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
-    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    _pin_cache(monkeypatch)
     def fn(eng, r):
         first = eng.host_rounds
         a = eng.allgather("ag", np.full((r + 1, 2), r, np.float32))
@@ -363,8 +375,7 @@ def test_cache_allgather_steady_state(monkeypatch):
 def test_cache_steady_state_mismatch_raises(monkeypatch):
     """Two ranks issuing DIFFERENT cached ops must raise the mismatch
     error from the mini round itself, not hang or cross-pair."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
-    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    _pin_cache(monkeypatch)
     def fn(eng, r):
         eng.allreduce("a", np.ones(2, np.float32), Sum)
         eng.allreduce("b", np.ones(2, np.float32), Sum)
@@ -380,7 +391,7 @@ def test_cache_steady_state_mismatch_raises(monkeypatch):
 def test_cache_capacity_zero_disables_mini_round(monkeypatch):
     """HOROVOD_CACHE_CAPACITY=0 (reference env) restores the pre-cache
     wire protocol: no mini round, 2 host gathers per allreduce forever."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "0")
+    _pin_cache(monkeypatch, capacity=0)
 
     def fn(eng, r):
         counts = []
@@ -404,8 +415,7 @@ def test_cache_capacity_zero_disables_mini_round(monkeypatch):
 def test_cache_verify_every_reverifies(monkeypatch):
     """HOROVOD_CACHE_VERIFY_EVERY=2 periodically re-runs the full header
     round as a divergence audit."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
-    monkeypatch.setenv("HOROVOD_CACHE_VERIFY_EVERY", "2")
+    _pin_cache(monkeypatch, verify_every=2)
 
     def fn(eng, r):
         counts = []
@@ -423,8 +433,7 @@ def test_cache_join_falls_back_to_full_rounds(monkeypatch):
     """A joined rank forces cached ops back onto the full header round so
     its zero/identity contributions keep working (steady-state ops before
     the join, join-covered ops after)."""
-    monkeypatch.setenv("HOROVOD_CACHE_CAPACITY", "1024")
-    monkeypatch.delenv("HOROVOD_CACHE_VERIFY_EVERY", raising=False)
+    _pin_cache(monkeypatch)
     def fn(eng, r):
         out1 = eng.allreduce("g", np.full(2, r + 1.0, np.float32), Sum)
         out2 = eng.allreduce("g", np.full(2, r + 1.0, np.float32), Sum)
