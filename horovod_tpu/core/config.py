@@ -59,6 +59,23 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.lower() in ("1", "true", "yes", "on")
 
 
+def resolve_fusion_threshold_bytes() -> int:
+    """The fusion threshold every host-side gradient bucketer uses
+    (torch ``DistributedOptimizer``, tf ``DistributedGradientTape``),
+    resolved through the SAME chain as the in-graph path: autotuner
+    thread-local override > initialized context config > env. 0 disables
+    fusion (reference semantics); an uncapped context value means one
+    bucket."""
+    from ..collectives.ops import _fusion_threshold
+    from . import context_api as _ctx
+    t = _fusion_threshold()
+    if t is None:
+        if _ctx.is_initialized():
+            return 1 << 62  # context says uncapped: one bucket
+        t = Config.from_env().fusion_threshold_bytes
+    return int(t)
+
+
 @dataclasses.dataclass
 class Config:
     """Runtime configuration, populated from the ``HOROVOD_*`` env surface."""

@@ -1,0 +1,55 @@
+"""``horovod_tpu.tensorflow`` — the reference's ``horovod.tensorflow``
+API, re-hosted on the TPU-native runtime.
+
+Reference parity: ``horovod/tensorflow/__init__.py`` + ``mpi_ops.py`` +
+``functions.py`` + ``compression.py`` (SURVEY.md §2.3/§2.4). The C++
+custom-op binding + background runtime is replaced by the same pluggable
+process-collective engine that backs ``horovod_tpu.torch``
+(``core/engine.py``) — one runtime, two framework front-ends, the
+reference's own architecture.
+
+Scope note (mirrors the torch module's): tf tensors live on host CPU in
+this build; the TPU compute path is the JAX API (``horovod_tpu.allreduce``
+& friends inside jit — in-graph collectives, the thing the reference's
+``xla_mpi_ops.cc`` CustomCall could not do). This module exists so
+TF-side tooling, input pipelines (tf.data), and reference training
+scripts keep working unchanged against the same runtime.
+"""
+
+from .compression import Compression
+from ..core.engine import (Adasum, Average, CollectiveEngine,  # noqa: F401
+                           JaxProcessEngine, Max, Min, Product,
+                           SingleProcessEngine, Sum, ThreadSimEngine)
+from .functions import (allgather_object, broadcast_object,  # noqa: F401
+                        broadcast_variables)
+from .gradient_tape import (DistributedGradientTape,  # noqa: F401
+                            DistributedOptimizer)
+from .mpi_ops import (ProcessSet, add_process_set, allgather,  # noqa: F401
+                      allreduce, alltoall, barrier, broadcast, broadcast_,
+                      cross_rank, cross_size, global_process_set,
+                      grouped_allgather, grouped_allreduce,
+                      grouped_reducescatter, init, is_initialized, join,
+                      local_rank, local_size, rank, reducescatter,
+                      remove_process_set, shutdown, size)
+
+
+def mpi_enabled() -> bool:
+    """Build-flag probes, reference basics.py parity: there is no
+    MPI/NCCL in the TPU build — transports are the engine layer."""
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,50 @@
+"""fp16 wire compression for the tensorflow API.
+
+Reference parity: ``horovod/tensorflow/compression.py`` (SURVEY.md §2.4)
+— the same four names (``Compression.none/.fp16``, ``NoneCompressor``,
+``FP16Compressor``), compressing the numpy wire payload and casting back
+after the collective. Operates on numpy (the engine wire format), so it
+works identically in eager and ``tf.py_function`` graph contexts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compressor:
+    @staticmethod
+    def compress(arr):
+        """Return (compressed_array, ctx)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(arr, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(arr):
+        return arr, None
+
+    @staticmethod
+    def decompress(arr, ctx):
+        return arr
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(arr):
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr.astype(np.float16), arr.dtype
+        return arr, None
+
+    @staticmethod
+    def decompress(arr, ctx):
+        return arr if ctx is None else arr.astype(ctx)
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

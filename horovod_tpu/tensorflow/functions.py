@@ -1,0 +1,60 @@
+"""Variable/object broadcast helpers for the tensorflow API.
+
+Reference parity: ``horovod/tensorflow/functions.py`` —
+``broadcast_variables`` (the startup-sync primitive behind
+``BroadcastGlobalVariablesCallback``), ``broadcast_object``,
+``allgather_object``.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from . import mpi_ops as _ops
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable the root's value (reference
+    ``hvd.broadcast_variables``): one broadcast per variable, name-keyed
+    by position so ranks match regardless of variable-name differences."""
+    for i, v in enumerate(variables):
+        v.assign(_ops.broadcast(v, root_rank, name=f"broadcast_vars.{i}"))
+
+
+def broadcast_object(obj, root_rank: int = 0,
+                     name: str = "broadcast_object"):
+    """Broadcast an arbitrary picklable object (reference
+    ``hvd.broadcast_object``): size round + padded byte broadcast."""
+    rt = _ops._rt()
+    if rt.engine.rank() == root_rank:
+        blob = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8).copy()
+    else:
+        blob = np.zeros(0, dtype=np.uint8)
+    n = rt.engine.broadcast(f"{name}.size",
+                            np.asarray([blob.shape[0]], dtype=np.int64),
+                            root_rank)
+    padded = np.zeros(int(n[0]), dtype=np.uint8)
+    padded[:blob.shape[0]] = blob
+    data = rt.engine.broadcast(f"{name}.data", padded, root_rank)
+    return pickle.loads(data.tobytes())
+
+
+def allgather_object(obj, name: str = "allgather_object") -> list:
+    """Gather one picklable object per rank; every rank gets the
+    rank-ordered list (reference ``hvd.allgather_object``)."""
+    rt = _ops._rt()
+    payload = np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+        dtype=np.uint8).copy()
+    sizes = rt.engine.allgather(
+        f"{name}.size", np.asarray([payload.shape[0]], dtype=np.int64))
+    data = rt.engine.allgather(f"{name}.data", payload)
+    out, off = [], 0
+    for s in sizes:
+        out.append(pickle.loads(data[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out

@@ -1,0 +1,227 @@
+"""``DistributedGradientTape`` and ``DistributedOptimizer`` for TF2/Keras.
+
+Reference parity: ``horovod/tensorflow/__init__.py``'s
+``DistributedGradientTape`` (the TF2 hot path: ``tape.gradient`` →
+allreduce each gradient) and ``horovod/tensorflow/keras/__init__.py``'s
+``DistributedOptimizer`` (wraps ``apply_gradients`` to allreduce first).
+Gradient allreduce rides the same engine as the torch optimizer, fused
+into per-dtype flat buckets capped at ``HOROVOD_FUSION_THRESHOLD`` —
+O(buckets), not O(P), negotiated rounds per step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from . import mpi_ops as _ops
+from .compression import Compression
+from ..core.engine import Adasum, Average, Sum
+
+
+from ..core.config import resolve_fusion_threshold_bytes \
+    as _fusion_threshold_bytes
+
+
+def _allreduce_grads(grads, op, compression, prescale, postscale,
+                     process_set, name_prefix):
+    """Allreduce a list of gradients (None entries preserved): dense
+    same-dtype grads are packed into fusion buckets, one engine op per
+    bucket; IndexedSlices ride the gather-based sparse path (reference
+    ``_allreduce_cond`` → allgather for IndexedSlices)."""
+    rt = _ops._rt()
+    m = _ops._members(process_set)
+    nparticipants = len(process_set.ranks) if m is not None \
+        else rt.engine.size()
+    threshold = _fusion_threshold_bytes()
+    fuse = threshold > 0 and op != Adasum
+
+    out = [None] * len(grads)
+    buckets = {}  # dtype -> [indices, bytes]
+    bucket_seq = {}
+
+    def flush(dt):
+        idxs, _ = buckets.pop(dt)
+        i = bucket_seq.get(dt, 0)
+        bucket_seq[dt] = i + 1
+        nm = f"{name_prefix}.fused.{dt}.{i}"
+        # Packing stays IN GRAPH (tf.concat / tf.reshape) so this traces
+        # under model.fit / tf.function; only the flat collective crosses
+        # the py_function boundary (one host callback per bucket).
+        shapes = [grads[j].shape.as_list() for j in idxs]
+        flat = tf.concat([tf.reshape(grads[j], [-1]) for j in idxs], 0)
+
+        def np_reduce(arr):
+            carr, ctx = compression.compress(arr)
+            if prescale != 1.0:
+                carr = carr * prescale
+            red = rt.engine.allreduce(nm, carr, op, members=m)
+            if postscale != 1.0:
+                red = red * postscale
+            return compression.decompress(red, ctx).astype(arr.dtype)
+
+        red = _ops._run_op(np_reduce, flat)
+        off = 0
+        for j, shp in zip(idxs, shapes):
+            size = int(np.prod(shp)) if shp else 1
+            out[j] = tf.reshape(red[off:off + size], shp)
+            off += size
+
+    for j, g in enumerate(grads):
+        if g is None:
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            # Reference semantics: sparse grads become allgathered slices
+            # (sum-by-coordinate happens when applied). The allgather is
+            # scale-free, so ALL scaling — Average's 1/n and any pre/post
+            # factors (the predivide path arrives here as op=Sum with
+            # prescale=1/f, postscale=f/n) — applies to the local values.
+            scale = prescale * postscale * (
+                1.0 / nparticipants if op == Average else 1.0)
+            vals = g.values * scale if scale != 1.0 else g.values
+            out[j] = tf.IndexedSlices(
+                _ops.allgather(vals, name=f"{name_prefix}.{j}.values",
+                               process_set=process_set),
+                _ops.allgather(g.indices, name=f"{name_prefix}.{j}.indices",
+                               process_set=process_set),
+                dense_shape=g.dense_shape)
+            continue
+        if not fuse:
+            out[j] = _ops.allreduce(g, op=op, name=f"{name_prefix}.{j}",
+                                    compression=compression,
+                                    prescale_factor=prescale,
+                                    postscale_factor=postscale,
+                                    process_set=process_set)
+            continue
+        shp = g.shape.as_list()
+        if any(d is None for d in shp):
+            # Dynamic shape (rare for variable grads): per-tensor op.
+            out[j] = _ops.allreduce(g, op=op, name=f"{name_prefix}.{j}",
+                                    compression=compression,
+                                    prescale_factor=prescale,
+                                    postscale_factor=postscale,
+                                    process_set=process_set)
+            continue
+        dt = g.dtype.name
+        nbytes = (int(np.prod(shp)) if shp else 1) * g.dtype.size
+        cur = buckets.get(dt)
+        if cur is not None and cur[1] + nbytes > threshold:
+            flush(dt)
+            cur = None
+        if cur is None:
+            buckets[dt] = [[j], nbytes]
+        else:
+            cur[0].append(j)
+            cur[1] += nbytes
+    for dt in list(buckets):
+        flush(dt)
+    return out
+
+
+class _DistributedGradientTape:
+    """Wraps a ``tf.GradientTape``: ``gradient()`` allreduces the result
+    (reference ``DistributedGradientTape``)."""
+
+    def __init__(self, tape, compression=Compression.none,
+                 op=Average, gradient_predivide_factor: float = 1.0,
+                 process_set=None, sparse_as_dense: bool = False):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+        self._sparse_as_dense = sparse_as_dense
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        one = not isinstance(grads, (list, tuple))
+        glist = [grads] if one else list(grads)
+        if self._sparse_as_dense:
+            glist = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g
+                     for g in glist]
+        # Stable names across steps: sequential reuse is safe (ops are
+        # synchronous) and lets the engine's signature cache hit.
+        prefix = "gradtape"
+        if self._op == Average and self._predivide != 1.0:
+            f = self._predivide
+            n = _ops.size() if self._process_set is None \
+                else len(self._process_set.ranks)
+            out = _allreduce_grads(glist, Sum, self._compression,
+                                   1.0 / f, f / n, self._process_set,
+                                   prefix)
+        else:
+            out = _allreduce_grads(glist, self._op, self._compression,
+                                   1.0, 1.0, self._process_set, prefix)
+        return out[0] if one else out
+
+
+def DistributedGradientTape(gradtape, compression=Compression.none,
+                            op=Average,
+                            gradient_predivide_factor: float = 1.0,
+                            process_set=None,
+                            sparse_as_dense: bool = False):
+    """Wrap ``tf.GradientTape`` so ``gradient()`` returns allreduced
+    gradients (reference ``hvd.DistributedGradientTape``)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    return _DistributedGradientTape(gradtape, compression, op,
+                                    gradient_predivide_factor, process_set,
+                                    sparse_as_dense)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none, op=Average,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None, sparse_as_dense: bool = False):
+    """Wrap a Keras optimizer so ``apply_gradients`` allreduces gradients
+    first (reference ``horovod.tensorflow.keras.DistributedOptimizer``).
+    Implemented as a dynamic subclass adopted via ``__class__`` so
+    ``isinstance`` checks and LR schedules keep working (the torch
+    wrapper's construction, adapted to Keras' non-reconstructible
+    optimizers)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+
+    base = optimizer.__class__
+
+    class _Distributed(base):
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            pairs = list(grads_and_vars)
+            grads = [g for g, _ in pairs]
+            hvars = [v for _, v in pairs]
+            if sparse_as_dense:
+                grads = [tf.convert_to_tensor(g)
+                         if isinstance(g, tf.IndexedSlices) else g
+                         for g in grads]
+            prefix = "opt_grad"
+            if op == Average and gradient_predivide_factor != 1.0:
+                f = gradient_predivide_factor
+                n = _ops.size() if process_set is None \
+                    else len(process_set.ranks)
+                reduced = _allreduce_grads(grads, Sum, compression, 1.0 / f,
+                                           f / n, process_set, prefix)
+            else:
+                reduced = _allreduce_grads(grads, op, compression, 1.0, 1.0,
+                                           process_set, prefix)
+            return super().apply_gradients(zip(reduced, hvars), *args,
+                                           **kwargs)
+
+    _Distributed.__name__ = base.__name__
+    optimizer.__class__ = _Distributed
+    return optimizer

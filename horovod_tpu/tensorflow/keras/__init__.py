@@ -1,0 +1,20 @@
+"""``horovod_tpu.tensorflow.keras`` — the reference's
+``horovod.tensorflow.keras`` API.
+
+Reference parity: ``horovod/tensorflow/keras/__init__.py`` +
+``callbacks.py`` (SURVEY.md §2.4 Keras API): ``DistributedOptimizer``
+(gradient allreduce inside ``apply_gradients``) and the four training
+callbacks, implemented as native ``keras.callbacks.Callback`` subclasses
+over the shared engine runtime.
+"""
+
+from __future__ import annotations
+
+from .. import (init, is_initialized, rank, size, local_rank,  # noqa: F401
+                local_size, shutdown, allreduce, allgather, broadcast,
+                broadcast_variables, allgather_object, broadcast_object)
+from ..gradient_tape import DistributedOptimizer  # noqa: F401
+from .callbacks import (BroadcastGlobalVariablesCallback,  # noqa: F401
+                        LearningRateScheduleCallback,
+                        LearningRateWarmupCallback,
+                        MetricAverageCallback)

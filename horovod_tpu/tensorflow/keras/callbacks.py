@@ -1,0 +1,179 @@
+"""Keras training callbacks (reference ``horovod/tensorflow/keras/
+callbacks.py`` / ``horovod/_keras/callbacks.py``).
+
+Native ``keras.callbacks.Callback`` subclasses over the shared engine:
+startup variable broadcast, cross-rank metric averaging, and the
+linear-warmup / schedule pair the reference ships for large-batch
+training (Goyal et al. scaling recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+
+from .. import mpi_ops as _ops
+from ..functions import broadcast_variables
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model + optimizer variables from ``root_rank`` at
+    the start of training (reference semantics: run AFTER restoring a
+    checkpoint on rank 0, so every rank starts identical)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, logs=None):
+        if self._done:
+            return
+        broadcast_variables(self.model.trainable_variables
+                            + self.model.non_trainable_variables,
+                            self.root_rank)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None):
+            broadcast_variables(list(opt.variables), self.root_rank)
+        self._done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks before they reach downstream
+    callbacks/logs (reference: wraps on_epoch_end the same way). Each
+    metric reduces under its OWN name — a rank-divergent log key then
+    fails loudly on that key alone instead of silently misaligning a
+    fused vector (reference behavior)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or _ops.size() == 1:
+            return
+        for k in sorted(logs):
+            if isinstance(logs[k], (int, float, np.floating)):
+                avg = _ops._rt().engine.allreduce(
+                    f"metric_avg.{k}",
+                    np.asarray([float(logs[k])], dtype=np.float64),
+                    _ops.Average)
+                logs[k] = float(avg[0])
+
+
+_warned_momentum = False
+
+
+def _warn_momentum_correction_inert(optimizer) -> None:
+    """Reference LR callbacks transiently rescale SGD momentum around an
+    LR change (``momentum_correction=True``). Keras 3 optimizers capture
+    ``momentum`` as a trace-time constant, so that rescale cannot take
+    effect post-compile — warn ONCE (only when it would have applied)
+    rather than silently diverging from reference training dynamics."""
+    global _warned_momentum
+    if _warned_momentum:
+        return
+    if getattr(optimizer, "momentum", 0.0):
+        import warnings
+        warnings.warn(
+            "momentum_correction is not applied in horovod_tpu's keras "
+            "callbacks: Keras 3 traces optimizer.momentum as a constant, "
+            "so the reference's transient momentum rescale around LR "
+            "changes cannot take effect. Pass momentum_correction=False "
+            "to silence, or rescale momentum manually.", stacklevel=3)
+        _warned_momentum = True
+
+
+class _LrCallback(keras.callbacks.Callback):
+    def _get_lr(self) -> float:
+        return float(keras.ops.convert_to_numpy(
+            self.model.optimizer.learning_rate))
+
+    def _set_lr(self, lr: float) -> None:
+        self.model.optimizer.learning_rate = lr
+
+
+class LearningRateWarmupCallback(_LrCallback):
+    """Linear LR ramp from ``initial_lr / size`` to ``initial_lr`` over
+    ``warmup_epochs`` (reference warmup callback; Goyal et al.)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.momentum_correction = momentum_correction
+        self.verbose = verbose
+        self._epoch = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        if self.momentum_correction and epoch < self.warmup_epochs:
+            _warn_momentum_correction_inert(self.model.optimizer)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self._epoch >= self.warmup_epochs:
+            return
+        spe = self.steps_per_epoch or getattr(
+            self.params, "get", lambda *_: None)("steps") or 1
+        progress = (self._epoch * spe + batch + 1) / (
+            self.warmup_epochs * spe)
+        factor = (1.0 / _ops.size()) + (1.0 - 1.0 / _ops.size()) * min(
+            1.0, progress)
+        self._set_lr(self.initial_lr * factor)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.initial_lr}.")
+
+
+class LearningRateScheduleCallback(_LrCallback):
+    """Multiply the LR by ``multiplier`` inside ``[start_epoch,
+    end_epoch)`` (reference schedule callback; ``multiplier`` may be a
+    float or an epoch->float callable). ``staircase=False`` with
+    ``steps_per_epoch`` feeds the callable FRACTIONAL epochs, updated per
+    batch (reference semantics); with ``staircase=True`` the integer
+    epoch applies for the whole epoch."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.multiplier = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._epoch = 0
+
+    def _in_range(self, epoch) -> bool:
+        return not (epoch < self.start_epoch
+                    or (self.end_epoch is not None
+                        and epoch >= self.end_epoch))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        if not self._in_range(epoch):
+            return
+        if self.momentum_correction:
+            _warn_momentum_correction_inert(self.model.optimizer)
+        lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(lr)
+        if self.verbose:
+            print(f"Epoch {epoch + 1}: learning rate set to {lr}.")
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self._epoch):
+            return
+        spe = self.steps_per_epoch or getattr(
+            self.params, "get", lambda *_: None)("steps")
+        if not spe:
+            return  # no step count known: integer-epoch behavior
+        frac = self._epoch + batch / spe
+        self._set_lr(self.initial_lr * self.multiplier(frac))

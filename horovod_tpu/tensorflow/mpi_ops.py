@@ -1,0 +1,312 @@
+"""TensorFlow tensor collectives over the shared process-collective engine.
+
+Reference parity: ``horovod/tensorflow/mpi_ops.py`` + the custom-op C++
+binding ``horovod/tensorflow/mpi_ops.cc`` (SURVEY.md §2.3): every op takes
+a tf.Tensor per process and returns the collective result, matching across
+processes by name. The C++ custom op + background runtime is replaced by
+the same pluggable engine layer that backs ``horovod_tpu.torch``
+(``core/engine.py``): single-process, thread-simulated (tests), or
+jax.distributed-backed on TPU pods.
+
+Graph mode: ops are wrapped in ``tf.py_function`` when called under
+``tf.function`` tracing, which is exactly the boundary the reference's
+``xla_mpi_ops.cc`` CustomCall escape hatch implemented (SURVEY.md §3.5 —
+its workaround-need on TPU is gone in the JAX path, where collectives are
+in-graph; this binding exists for TF-side tooling and training scripts).
+
+TF2-only, eager-first: the reference dropped TF1 sessions upstream; there
+are no ``*_async`` variants in its TF surface either (ops synchronize
+internally).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..core import engine as _engine
+from ..core.engine import (Adasum, Average, Max, Min, Product, Sum)  # noqa: F401
+from ..core.process_sets import ProcessSet, ProcessSetTable
+from .compression import Compression
+
+_lock = threading.Lock()
+_state = None
+
+
+class _TfRuntime:
+    """Per-process runtime: engine + process sets + name counters."""
+
+    def __init__(self, eng: _engine.CollectiveEngine):
+        self.engine = eng
+        self.process_sets = ProcessSetTable(eng.size())
+        self._counters = {}
+        self._clock = threading.Lock()
+
+    def autoname(self, kind: str, name: Optional[str]) -> str:
+        if name is not None:
+            return name
+        with self._clock:
+            i = self._counters.get(kind, 0)
+            self._counters[kind] = i + 1
+        return f"{kind}.noname.{i}"
+
+
+def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
+    """Initialize the tensorflow API (reference ``hvd.init``). Engine
+    selection mirrors the torch binding: explicit engine (tests) >
+    JaxProcessEngine on multi-host pods > single-process."""
+    global _state
+    with _lock:
+        if _state is not None:
+            return
+        if engine is None:
+            engine = _engine.default_engine()
+        _state = _TfRuntime(engine)
+
+
+def shutdown() -> None:
+    global _state
+    with _lock:
+        if _state is not None:
+            _state.engine.shutdown()
+            _state = None
+
+
+def is_initialized() -> bool:
+    return _state is not None
+
+
+def _rt() -> _TfRuntime:
+    if _state is None:
+        raise RuntimeError(
+            "horovod_tpu.tensorflow not initialized; call hvd.init() first")
+    return _state
+
+
+def rank() -> int:
+    return _rt().engine.rank()
+
+
+def size() -> int:
+    return _rt().engine.size()
+
+
+def local_rank() -> int:
+    return _rt().engine.local_rank()
+
+
+def local_size() -> int:
+    return _rt().engine.local_size()
+
+
+def cross_rank() -> int:
+    return _rt().engine.cross_rank()
+
+
+def cross_size() -> int:
+    return _rt().engine.cross_size()
+
+
+# --- process sets ------------------------------------------------------------
+
+def add_process_set(ranks) -> ProcessSet:
+    return _rt().process_sets.add(ranks)
+
+
+def remove_process_set(ps) -> None:
+    _rt().process_sets.remove(ps)
+
+
+def global_process_set() -> ProcessSet:
+    return _rt().process_sets.global_set
+
+
+def _members(process_set: Optional[ProcessSet]):
+    if process_set is None or process_set.process_set_id == 0:
+        return None
+    return tuple(process_set.ranks)
+
+
+# --- eager/graph adaptation --------------------------------------------------
+
+def _run_op(np_fn, tensor, out_dtype=None):
+    """Run ``np_fn(numpy_array) -> numpy_array`` on a tf.Tensor. Eager:
+    direct. Under tf.function tracing: via ``tf.py_function`` (the
+    host-callback boundary — same escape the reference's TF custom op
+    used; the in-graph path for TPU is horovod_tpu's JAX API).
+
+    ``tf.py_function`` bodies execute on TF's own pool threads, where a
+    thread-registered test engine (ThreadSimEngine) has no rank — so the
+    caller's rank is captured at build time and re-pinned inside the
+    callable."""
+    t = tf.convert_to_tensor(tensor)
+    dt = out_dtype or t.dtype
+    eng = _rt().engine
+    set_rank = getattr(eng, "set_rank", None)
+    my_rank = eng.rank() if set_rank is not None else None
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(np.asarray(np_fn(t.numpy())))
+
+    def body(x):
+        if set_rank is not None:
+            set_rank(my_rank)
+        return tf.convert_to_tensor(np.asarray(np_fn(x.numpy())))
+
+    return tf.py_function(body, [t], Tout=dt)
+
+
+def _op_from_average(average: Optional[bool], op: Optional[str]) -> str:
+    if average is not None and op is not None:
+        raise ValueError("specify either average or op, not both "
+                         "(reference mpi_ops.py contract)")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# --- collectives -------------------------------------------------------------
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[str] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    """Allreduce a tf.Tensor across ranks (reference ``hvd.allreduce``)."""
+    rt = _rt()
+    opname = _op_from_average(average, op)
+    nm = rt.autoname("allreduce", name)
+    m = _members(process_set)
+
+    def fn(arr):
+        carr, ctx = compression.compress(arr)
+        if prescale_factor != 1.0:
+            carr = carr * prescale_factor
+        out = rt.engine.allreduce(nm, carr, opname, members=m)
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        return compression.decompress(out, ctx).astype(arr.dtype)
+
+    return _run_op(fn, tensor)
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      compression=Compression.none,
+                      op: Optional[str] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None):
+    nm = _rt().autoname("grouped_allreduce", name)
+    return [allreduce(t, average, f"{nm}.{i}", compression, op,
+                      prescale_factor, postscale_factor, process_set)
+            for i, t in enumerate(tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    """Gather along dim 0 from every rank, concatenated in rank order
+    (reference ``hvd.allgather``; ragged first dims supported — the
+    engine's variable-row gather)."""
+    rt = _rt()
+    nm = rt.autoname("allgather", name)
+    m = _members(process_set)
+    return _run_op(lambda arr: rt.engine.allgather(nm, arr, members=m),
+                   tensor)
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    nm = _rt().autoname("grouped_allgather", name)
+    return [allgather(t, f"{nm}.{i}", process_set)
+            for i, t in enumerate(tensors)]
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    """Broadcast from ``root_rank`` (reference ``hvd.broadcast``)."""
+    rt = _rt()
+    nm = rt.autoname("broadcast", name)
+    m = _members(process_set)
+    return _run_op(lambda arr: rt.engine.broadcast(nm, arr, root_rank,
+                                                   members=m), tensor)
+
+
+def broadcast_(variable, root_rank: int, name: Optional[str] = None,
+               process_set: Optional[ProcessSet] = None):
+    """In-place broadcast into a tf.Variable (reference ``hvd.broadcast_``)."""
+    variable.assign(broadcast(variable, root_rank, name, process_set))
+    return variable
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    """All-to-all exchange of dim-0 chunks; returns the received tensor,
+    or ``(tensor, received_splits)`` when ``splits`` is given (reference
+    ``hvd.alltoall`` contract)."""
+    rt = _rt()
+    nm = rt.autoname("alltoall", name)
+    m = _members(process_set)
+    t = tf.convert_to_tensor(tensor)
+    eng = rt.engine
+    set_rank = getattr(eng, "set_rank", None)
+    my_rank = eng.rank() if set_rank is not None else None
+
+    if splits is None:
+        return _run_op(lambda arr: eng.alltoall(nm, arr, None,
+                                                members=m)[0], tensor)
+    s = tf.convert_to_tensor(splits)
+    if tf.executing_eagerly():
+        out, recv = eng.alltoall(nm, t.numpy(),
+                                 np.asarray(s.numpy(), dtype=np.int64),
+                                 members=m)
+        return (tf.convert_to_tensor(out),
+                tf.convert_to_tensor(recv.astype(np.int64)))
+
+    def body(x, sp):
+        # splits ride the py_function inputs, so dynamically-computed
+        # splits (tf.math.bincount of destinations, the MoE dispatch
+        # pattern) work under tf.function.
+        if set_rank is not None:
+            set_rank(my_rank)
+        out, recv = eng.alltoall(nm, x.numpy(),
+                                 np.asarray(sp.numpy(), dtype=np.int64),
+                                 members=m)
+        return (tf.convert_to_tensor(out),
+                tf.convert_to_tensor(recv.astype(np.int64)))
+
+    return tf.py_function(body, [t, s], Tout=[t.dtype, tf.int64])
+
+
+def reducescatter(tensor, op: str = Sum, name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None):
+    """Reduce across ranks then scatter dim-0 chunks (reference
+    ``hvd.reducescatter``)."""
+    rt = _rt()
+    nm = rt.autoname("reducescatter", name)
+    m = _members(process_set)
+    return _run_op(lambda arr: rt.engine.reducescatter(nm, arr, op,
+                                                       members=m), tensor)
+
+
+def grouped_reducescatter(tensors, op: str = Sum,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None):
+    nm = _rt().autoname("grouped_reducescatter", name)
+    return [reducescatter(t, op, f"{nm}.{i}", process_set)
+            for i, t in enumerate(tensors)]
+
+
+def join(device: str = "") -> int:
+    """Block until every rank joins; returns the last rank to join
+    (reference ``hvd.join``; the device argument is accepted for
+    signature parity and ignored)."""
+    return _rt().engine.join()
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    _rt().engine.barrier(members=_members(process_set))

@@ -1,0 +1,48 @@
+"""Multi-rank test harness for the tensorflow API (mirror of
+``horovod_tpu.torch.testing``): N simulated ranks as threads over a
+shared :class:`~horovod_tpu.core.engine.ThreadSimEngine` — the reference
+runs its TF tests as N processes over CPU/Gloo (SURVEY.md §4); this is
+the same semantics without multi-process JAX.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from . import mpi_ops as _ops
+from ..core.engine import ThreadSimEngine
+
+
+def run_parallel(n: int, fn: Callable[[int], object],
+                 engine: Optional[ThreadSimEngine] = None) -> List[object]:
+    """Run ``fn(rank)`` on ``n`` simulated ranks; returns per-rank
+    results; re-raises the first rank exception."""
+    eng = engine or ThreadSimEngine(n)
+    _ops.shutdown()
+    _ops.init(engine=eng)
+    results: List[object] = [None] * n
+    errors: List[BaseException] = []
+
+    def worker(r):
+        eng.set_rank(r)
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001 — propagate to caller
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        if any(t.is_alive() for t in threads):
+            raise RuntimeError(
+                "run_parallel: rank threads stalled (collective deadlock?)")
+        if errors:
+            raise errors[0]
+    finally:
+        _ops.shutdown()
+    return results

@@ -1,1023 +1,8 @@
-"""Process-level collective engines backing the ``horovod_tpu.torch`` API.
-
-Reference parity: the role of ``horovod/common/operations.cc``'s background
-runtime + controller as seen FROM the torch binding
-(``horovod/torch/mpi_ops_v2.cc``, SURVEY.md §2.3, §3.2): every process calls
-an op with its own tensor; the runtime matches the op across processes by
-name and executes the collective. Here that runtime is a small pluggable
-*engine* working on host numpy buffers:
-
-- :class:`SingleProcessEngine` — world size 1 (the degenerate case the
-  reference also special-cases); every op is a local identity/reduction.
-- :class:`JaxProcessEngine` — multi-host TPU pods: rank = JAX process
-  index, transport = the jax.distributed coordination service + XLA
-  collectives via ``multihost_utils`` (the DCN path that replaces the
-  reference's MPI/Gloo control+data planes).
-- :class:`ThreadSimEngine` — N simulated ranks as threads in one process,
-  rendezvousing by op name. This is the test backend, playing the role the
-  reference's CPU/Gloo path plays in its parallel test tier (SURVEY.md §4:
-  "CPU+Gloo as the universal fake backend").
-
-Engines speak numpy so they stay framework-neutral; the torch layer
-(``mpi_ops.py``) owns torch<->numpy adaptation and async handles.
-"""
-
-from __future__ import annotations
-
-import threading
-from typing import Dict, List, Optional, Sequence, Tuple
-
-import numpy as np
-
-# Reduction op names — same strings as the in-graph layer
-# (collectives/ops.py) so user code can share constants.
-Sum = "sum"
-Average = "average"
-Min = "min"
-Max = "max"
-Product = "product"
-Adasum = "adasum"
-
-_ELEMENTWISE = {
-    Sum: lambda xs: np.sum(xs, axis=0),
-    Average: lambda xs: np.sum(xs, axis=0),  # divisor applied by caller
-    Min: lambda xs: np.min(xs, axis=0),
-    Max: lambda xs: np.max(xs, axis=0),
-    Product: lambda xs: np.prod(xs, axis=0),
-}
-
-
-def _adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Pairwise Adasum combine; same coefficient formula as
-    ops/fused.py:adasum_coefficients so host and device paths agree."""
-    af = a.astype(np.float64, copy=False)
-    bf = b.astype(np.float64, copy=False)
-    dot = float(np.vdot(af, bf))
-    na = float(np.vdot(af, af))
-    nb = float(np.vdot(bf, bf))
-    ca = 1.0 if na <= 0.0 else 1.0 - dot / (2.0 * na)
-    cb = 1.0 if nb <= 0.0 else 1.0 - dot / (2.0 * nb)
-    return (ca * af + cb * bf).astype(a.dtype, copy=False)
-
-
-def _adasum_tree(chunks: List[np.ndarray]) -> np.ndarray:
-    """Recursive-halving combine over the rank dimension (reference:
-    ops/adasum/adasum.h tree; collectives/adasum.py butterfly — identical
-    result for power-of-two counts, graceful for any count here)."""
-    xs = list(chunks)
-    while len(xs) > 1:
-        nxt = []
-        for i in range(0, len(xs) - 1, 2):
-            nxt.append(_adasum_combine(xs[i], xs[i + 1]))
-        if len(xs) % 2:
-            nxt.append(xs[-1])
-        xs = nxt
-    return xs[0]
-
-
-def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:
-    """Reduce per-rank arrays (joined ranks already excluded by caller)."""
-    xs = np.stack([np.asarray(a) for a in arrays])
-    if op == Adasum:
-        return _adasum_tree([xs[i] for i in range(xs.shape[0])])
-    if op not in _ELEMENTWISE:
-        raise ValueError(f"unknown reduction op: {op!r}")
-    out = _ELEMENTWISE[op](xs)
-    if op == Average:
-        out = out / len(arrays)
-    return out.astype(arrays[0].dtype, copy=False)
-
-
-class CollectiveEngine:
-    """Abstract process-collective transport (numpy payloads)."""
-
-    def rank(self) -> int:
-        raise NotImplementedError
-
-    def size(self) -> int:
-        raise NotImplementedError
-
-    def local_rank(self) -> int:
-        return self.rank()
-
-    def local_size(self) -> int:
-        return self.size()
-
-    def cross_rank(self) -> int:
-        return 0
-
-    def cross_size(self) -> int:
-        return 1
-
-    # Collectives. ``name`` identifies the op across ranks (the reference's
-    # tensor-name negotiation key, SURVEY.md §2.1 controller).
-    # ``members`` (optional tuple of global ranks) restricts the op to a
-    # process set: only members call, only members meet (reference
-    # process_set.cc semantics). Engines that cannot form subgroups raise.
-    def allreduce(self, name: str, arr: np.ndarray, op: str,
-                  members=None) -> np.ndarray:
-        raise NotImplementedError
-
-    def allgather(self, name: str, arr: np.ndarray,
-                  members=None) -> np.ndarray:
-        raise NotImplementedError
-
-    def broadcast(self, name: str, arr: Optional[np.ndarray],
-                  root_rank: int, members=None) -> np.ndarray:
-        raise NotImplementedError
-
-    def alltoall(self, name: str, arr: np.ndarray,
-                 splits: Optional[np.ndarray], members=None
-                 ) -> Tuple[np.ndarray, np.ndarray]:
-        raise NotImplementedError
-
-    def reducescatter(self, name: str, arr: np.ndarray,
-                      op: str, members=None) -> np.ndarray:
-        raise NotImplementedError
-
-    def barrier(self, name: str = "barrier", members=None) -> None:
-        raise NotImplementedError
-
-    def _check_member(self, members) -> None:
-        if members is not None and self.rank() not in members:
-            raise ValueError(
-                f"rank {self.rank()} is not in process set {sorted(members)}"
-                " — only member ranks may call a process-set op"
-                " (reference semantics)")
-
-    def join(self) -> int:
-        """Mark this rank as out of data; block until all ranks joined;
-        return the last rank to join (reference ``hvd.join`` contract)."""
-        raise NotImplementedError
-
-    def shutdown(self) -> None:
-        pass
-
-
-def _alltoall_chunks(arr: np.ndarray, splits: Optional[np.ndarray],
-                     n: int) -> List[np.ndarray]:
-    if splits is None:
-        if arr.shape[0] % n:
-            raise ValueError(
-                f"alltoall first dim {arr.shape[0]} not divisible by "
-                f"size {n} and no splits given")
-        return list(np.split(arr, n))
-    splits = np.asarray(splits, dtype=np.int64)
-    if splits.shape != (n,) or int(splits.sum()) != arr.shape[0]:
-        raise ValueError("splits must have one entry per rank summing to "
-                         "the first dimension")
-    idx = np.cumsum(splits)[:-1]
-    return list(np.split(arr, idx))
-
-
-class SingleProcessEngine(CollectiveEngine):
-    """World size 1: ops are local (what the reference degenerates to when
-    launched with -np 1)."""
-
-    def rank(self) -> int:
-        return 0
-
-    def size(self) -> int:
-        return 1
-
-    def allreduce(self, name, arr, op, members=None):
-        self._check_member(members)
-        if op == Adasum:  # combine with nothing = identity (tree of one)
-            return np.array(arr, copy=True)
-        return reduce_arrays([arr], op)
-
-    def allgather(self, name, arr, members=None):
-        self._check_member(members)
-        return np.array(arr, copy=True)
-
-    def broadcast(self, name, arr, root_rank, members=None):
-        self._check_member(members)
-        if root_rank != 0:
-            raise ValueError(f"root_rank {root_rank} out of range for size 1")
-        return np.array(arr, copy=True)
-
-    def alltoall(self, name, arr, splits, members=None):
-        self._check_member(members)
-        n_recv = np.asarray([arr.shape[0]], dtype=np.int64)
-        return np.array(arr, copy=True), n_recv
-
-    def reducescatter(self, name, arr, op, members=None):
-        self._check_member(members)
-        return reduce_arrays([arr], Sum if op == Average else op)
-
-    def barrier(self, name="barrier", members=None):
-        self._check_member(members)
-        return None
-
-    def join(self) -> int:
-        return 0
-
-
-class _Rendezvous:
-    """Name-keyed meeting point for ThreadSimEngine ranks.
-
-    Plays the controller's role (SURVEY.md §2.1: "rank 0 waits until a
-    tensor is ready on ALL ranks"): an op completes once every *active*
-    (non-joined) rank has contributed under the same key; joined ranks are
-    represented by the compute callback as zero/absent contributions, which
-    is exactly the reference JoinOp behavior. An op some rank never issues
-    raises on the waiting ranks after ``stall_timeout_s`` — the reference's
-    stall inspector (SURVEY.md §2.1) turned from a log line into an error.
-    """
-
-    def __init__(self, n: int, stall_timeout_s: float = 60.0):
-        self.n = n
-        self.stall_timeout_s = stall_timeout_s
-        self.lock = threading.Lock()
-        self.cv = threading.Condition(self.lock)
-        self.pending: Dict[str, dict] = {}
-        self.joined: set = set()
-        self.generation: Dict[str, int] = {}
-
-    def run(self, key: str, rank: int, payload, compute, members=None):
-        import time as _time
-        if members is not None:
-            # Process-set ops meet only their members; fold the member set
-            # into the key so same-named ops on different sets never mix.
-            members = frozenset(members)
-            key = f"{key}|ps{sorted(members)}"
-        with self.cv:
-            gen = self.generation.get(key, 0)
-            slot_key = (key, gen) if (key, gen) not in self.pending or \
-                rank not in self.pending[(key, gen)]["contrib"] else None
-            if slot_key is None:
-                # This rank already contributed to generation `gen` — it is
-                # re-issuing the op before others consumed; start next gen.
-                gen += 1
-                slot_key = (key, gen)
-            slot = self.pending.setdefault(
-                slot_key, {"contrib": {}, "result": None, "done": 0,
-                           "computed": False, "error": None,
-                           "members": members})
-            slot["contrib"][rank] = payload
-            self._maybe_compute(key, gen, slot, compute)
-            deadline = _time.monotonic() + self.stall_timeout_s
-            while not slot["computed"] and slot["error"] is None:
-                self.cv.wait(timeout=min(1.0, self.stall_timeout_s))
-                self._maybe_compute(key, gen, slot, compute)
-                if (not slot["computed"] and slot["error"] is None
-                        and _time.monotonic() > deadline):
-                    slot["error"] = RuntimeError(
-                        f"collective {key!r} stalled for "
-                        f"{self.stall_timeout_s}s: ranks "
-                        f"{sorted(slot['contrib'])} of {self.n} arrived "
-                        "(reference stall_inspector analog)")
-                    self.cv.notify_all()
-            if slot["error"] is not None:
-                raise slot["error"]
-            result = slot["result"]
-            slot["done"] += 1
-            if slot["done"] == len(slot["contrib"]):
-                del self.pending[(key, gen)]
-                self.generation[key] = gen + 1
-            return result
-
-    def _maybe_compute(self, key, gen, slot, compute):
-        world = slot["members"] if slot["members"] is not None \
-            else set(range(self.n))
-        active = set(world) - self.joined
-        if not slot["computed"] and slot["error"] is None \
-                and active <= set(slot["contrib"]):
-            try:
-                slot["result"] = compute(slot["contrib"],
-                                         sorted(self.joined))
-                slot["computed"] = True
-            except BaseException as e:  # propagate to every waiter
-                slot["error"] = e
-            self.cv.notify_all()
-
-    def join(self, rank: int) -> int:
-        import time as _time
-        with self.cv:
-            self.joined.add(rank)
-            # A joining rank may unblock pending collectives that were
-            # waiting only on it; waiters recompute on wake.
-            self.cv.notify_all()
-            deadline = _time.monotonic() + self.stall_timeout_s
-            while len(self.joined) < self.n:
-                self.cv.wait(timeout=min(1.0, self.stall_timeout_s))
-                if _time.monotonic() > deadline:
-                    raise RuntimeError(
-                        f"join() stalled: ranks {sorted(self.joined)} of "
-                        f"{self.n} joined within {self.stall_timeout_s}s")
-            return max(self.joined)
-
-    def reset_join(self):
-        with self.cv:
-            self.joined.clear()
-
-
-class ThreadSimEngine(CollectiveEngine):
-    """N ranks as threads in one process — the test backend (reference
-    analog: CPU/Gloo multi-process test tier, SURVEY.md §4). Use with
-    :func:`horovod_tpu.torch.testing.run_parallel`, which registers each
-    thread's rank in ``self._tls``."""
-
-    def __init__(self, n: int, stall_timeout_s: float = 60.0):
-        if n < 1:
-            raise ValueError("n must be >= 1")
-        self._n = n
-        self._tls = threading.local()
-        self._rv = _Rendezvous(n, stall_timeout_s)
-
-    # -- rank registration (testing harness) --------------------------------
-
-    def set_rank(self, rank: int) -> None:
-        self._tls.rank = rank
-
-    def rank(self) -> int:
-        r = getattr(self._tls, "rank", None)
-        if r is None:
-            raise RuntimeError(
-                "calling thread has no rank; run inside "
-                "horovod_tpu.torch.testing.run_parallel")
-        return r
-
-    def size(self) -> int:
-        return self._n
-
-    # -- collectives ---------------------------------------------------------
-
-    def allreduce(self, name, arr, op, members=None):
-        self._check_member(members)
-
-        def compute(contrib, joined):
-            ranks = sorted(contrib)
-            arrays = [contrib[r] for r in ranks]
-            # Joined ranks contribute zeros; Average divides by the ACTIVE
-            # count (reference join_allreduce semantics, collectives/join.py).
-            return reduce_arrays(arrays, op)
-        out = self._rv.run(f"allreduce.{name}", self.rank(),
-                           np.asarray(arr), compute, members=members)
-        return np.array(out, copy=True)
-
-    def allgather(self, name, arr, members=None):
-        self._check_member(members)
-
-        def compute(contrib, joined):
-            return np.concatenate([contrib[r] for r in sorted(contrib)])
-        out = self._rv.run(f"allgather.{name}", self.rank(),
-                           np.asarray(arr), compute, members=members)
-        return np.array(out, copy=True)
-
-    def broadcast(self, name, arr, root_rank, members=None):
-        self._check_member(members)
-
-        def compute(contrib, joined):
-            if root_rank not in contrib:
-                raise RuntimeError(f"broadcast root {root_rank} joined/absent")
-            return contrib[root_rank]
-        payload = None if arr is None else np.asarray(arr)
-        out = self._rv.run(f"broadcast.{name}", self.rank(), payload, compute,
-                           members=members)
-        return np.array(out, copy=True)
-
-    def alltoall(self, name, arr, splits, members=None):
-        self._check_member(members)
-        me = self.rank()
-        group = len(members) if members is not None else self._n
-
-        def compute(contrib, joined):
-            chunks = {}
-            for r, (a, sp) in contrib.items():
-                chunks[r] = _alltoall_chunks(a, sp, group)
-            out = {}
-            world = sorted(members) if members is not None \
-                else list(range(self._n))
-            for dst in contrib:
-                # Chunk i of each member goes to the i-th member of the SET
-                # (set-local destination order, reference process-set
-                # alltoall); for the global set this is the rank index.
-                parts = [chunks[src][world.index(dst)]
-                         for src in sorted(contrib)]
-                out[dst] = (np.concatenate(parts),
-                            np.asarray([p.shape[0] for p in parts],
-                                       dtype=np.int64))
-            return out
-        payload = (np.asarray(arr), None if splits is None
-                   else np.asarray(splits))
-        out = self._rv.run(f"alltoall.{name}", me, payload, compute,
-                           members=members)
-        recv, recv_splits = out[me]
-        return np.array(recv, copy=True), np.array(recv_splits, copy=True)
-
-    def reducescatter(self, name, arr, op, members=None):
-        self._check_member(members)
-        me = self.rank()
-        group = len(members) if members is not None else self._n
-
-        def compute(contrib, joined):
-            ranks = sorted(contrib)
-            red = reduce_arrays([contrib[r] for r in ranks],
-                                Sum if op == Average else op)
-            if op == Average:
-                red = (red / len(ranks)).astype(red.dtype, copy=False)
-            if red.shape[0] % group:
-                raise ValueError(
-                    f"reducescatter first dim {red.shape[0]} not divisible "
-                    f"by size {group}")
-            world = sorted(members) if members is not None \
-                else list(range(self._n))
-            chunks = np.split(red, group)
-            return {r: chunks[world.index(r)] for r in ranks}
-        out = self._rv.run(f"reducescatter.{name}", me, np.asarray(arr),
-                           compute, members=members)
-        return np.array(out[me], copy=True)
-
-    def barrier(self, name="barrier", members=None):
-        self._check_member(members)
-        self._rv.run(f"barrier.{name}", self.rank(), None,
-                     lambda contrib, joined: True, members=members)
-
-    def join(self) -> int:
-        return self._rv.join(self.rank())
-
-    def reset_join(self) -> None:
-        self._rv.reset_join()
-
-
-class JaxProcessEngine(CollectiveEngine):
-    """Multi-host engine: rank = JAX process index, transport = the
-    jax.distributed coordination service + XLA DCN collectives
-    (``multihost_utils``). This is the production path on TPU pods — the
-    TPU-native replacement for the reference's MPI/Gloo transports
-    (SURVEY.md §2.7): ``jax.distributed.initialize`` is the rendezvous,
-    and the data plane rides the same ICI/DCN fabric as the training step.
-
-    Cross-process matching protocol: the underlying XLA collectives match
-    by **program order**, not by name, so every op here is one *round* —
-    a small header allgather (op kind, name, shape, joined flag) followed
-    by the payload collective. The header round is the reference
-    controller's negotiation (SURVEY.md §2.1) shrunk to its TPU-necessary
-    core: it (a) verifies all active ranks are executing the SAME op and
-    raises a mismatch error instead of silently cross-pairing collectives,
-    and (b) lets ranks that called :meth:`join` answer with zero
-    contributions (the reference JoinOp). Rounds are serialized per
-    process by a lock; the torch layer additionally submits ops from a
-    single worker thread for this engine so program order is well-defined.
-    """
-
-    def __init__(self):
-        import jax
-        self._jax = jax
-        if jax.process_count() == 1:
-            raise RuntimeError(
-                "JaxProcessEngine needs jax.distributed (process_count > 1); "
-                "use SingleProcessEngine")
-        self._lock = threading.RLock()
-        self._joined = False
-        self._device_fns: dict = {}  # (len, dtype, op, scatter) -> jitted
-        self._cache_init()
-
-    #: mpi_ops keys on this to serialize submission (program order).
-    requires_ordered_submission = True
-
-    # -- steady-state signature cache ----------------------------------------
-    #
-    # The reference controller's response cache (``response_cache.cc``,
-    # SURVEY.md §2.1) collapses steady-state negotiation to a per-cycle bit
-    # vector: once a tensor's request has been seen everywhere, ranks only
-    # exchange "cache hit" bits instead of full requests. The analog here:
-    # every negotiated op opens with ONE fixed-size int64 allgather (the
-    # "mini round": [signature-hash, joined, want-full]) instead of the
-    # two-gather pickled header round. When every rank reports the same
-    # already-seen signature hash and nobody is joined or asking for a full
-    # round, the header round is skipped — its entire job (op identity +
-    # shape/dtype agreement) is implied by the hash agreement. Any first
-    # occurrence, joined rank, capacity overflow, verification tick
-    # (``HOROVOD_CACHE_VERIFY_EVERY``), or uncacheable op (alltoall: headers
-    # carry per-rank splits) falls back to the full header round, so ``join``
-    # and mismatch diagnostics keep working. ``HOROVOD_CACHE_CAPACITY=0``
-    # (reference env) disables the cache AND the mini round — the pre-cache
-    # wire protocol, byte for byte (must be set uniformly across ranks, as
-    # in the reference).
-
-    def _cache_init(self) -> None:
-        import collections
-        from ..core import context_api as _ctx
-        from ..core.config import Config
-        # The initialized context's config wins (programmatic
-        # Config(cache_capacity=...) stays live); env otherwise — the same
-        # chain the fusion threshold resolves through.
-        cfg = _ctx.context().config if _ctx.is_initialized() \
-            else Config.from_env()
-        self._cache_capacity = int(cfg.cache_capacity)
-        self._cache_verify_every = int(cfg.cache_verify_every)
-        # signature -> occurrences, LRU-ordered (reference response_cache.cc
-        # evicts too — otherwise one-shot startup ops like a per-parameter
-        # broadcast_parameters() sweep would permanently fill the cache and
-        # silently push the steady-state gradient ops back onto full
-        # rounds). Eviction is local-only and safe: a rank that evicted a
-        # signature re-sends -1/want-full, which drags everyone onto the
-        # full round for that op (the protocol's normal asymmetric path).
-        self._sig_seen: "collections.OrderedDict[tuple, int]" = \
-            collections.OrderedDict()
-
-    @staticmethod
-    def _sig_hash(sig: tuple) -> int:
-        """Deterministic-across-processes positive signature id (the
-        response cache's bit position, widened so no id coordination round
-        is needed). 31-bit so it survives the device transport unmangled —
-        JAX demotes int64 arrays to int32 when x64 is off. Collisions only
-        matter among live cached signatures (≤ capacity, default 1024):
-        P(any collision) ≈ 1024²/2³² ≈ 0.02%, and even a collision is only
-        observable when ranks ALSO diverge on which op they issue (already
-        a program bug) — it would mask that mismatch diagnostic."""
-        import hashlib
-        h = hashlib.blake2b(repr(sig).encode(), digest_size=4).digest()
-        return int.from_bytes(h, "little") & 0x7FFFFFFF
-
-    def _negotiate_mini(self, sig, members=None) -> bool:
-        """The mini round. Returns True when every rank agreed on the same
-        cached signature (header round skippable); False when the full
-        header round must follow. Raises on a steady-state signature
-        mismatch — two ranks issuing different cached ops — which is the
-        cheap form of the header round's mismatch error."""
-        count = 0 if sig is None else self._sig_seen.get(sig, 0)
-        want_full = (sig is None or count == 0
-                     or (self._cache_verify_every > 0
-                         and count % self._cache_verify_every == 0))
-        hid = -1 if sig is None or count == 0 else self._sig_hash(sig)
-        mine = np.asarray(
-            [hid, 1 if self._joined else 0, 1 if want_full else 0],
-            dtype=np.int64)
-        g = self._allgather_fixed(mine, members)
-        if (g[:, 1] != 0).any() or (g[:, 2] != 0).any():
-            return False
-        ids = g[:, 0]
-        if (ids < 0).any() or (ids != ids[0]).any():
-            raise RuntimeError(
-                "collective mismatch across processes: cached signature ids "
-                f"{sorted(set(ids.tolist()))} differ — each process must "
-                "issue the same op in the same order (reference "
-                "response_cache.cc bit-vector check)")
-        return True
-
-    def _sig_commit(self, sig) -> None:
-        """Record one successful occurrence (post-validation, so a raising
-        round is never cached)."""
-        if sig is None or self._cache_capacity <= 0:
-            return
-        c = self._sig_seen.get(sig)
-        if c is None:
-            c = 0
-            while len(self._sig_seen) >= self._cache_capacity:
-                self._sig_seen.popitem(last=False)  # evict LRU
-        self._sig_seen[sig] = c + 1
-        self._sig_seen.move_to_end(sig)
-
-    def _norm_members(self, members):
-        """Canonical member tuple for a proper subgroup, or None for the
-        global set. Non-members calling a subgroup op raise (reference
-        process_set.cc semantics). Subgroup rounds run ONLY among members:
-        header + payload ride device collectives over a mesh of the member
-        processes (the reference's MPI_Comm_split role), so the other
-        processes are free to run their own ops concurrently — but a
-        subgroup op and ``join()`` must not be mixed on overlapping ranks
-        (join answers GLOBAL rounds only, as in the reference)."""
-        self._check_member(members)
-        if members is None or len(members) == self.size():
-            return None
-        return tuple(sorted(members))
-
-    def rank(self) -> int:
-        return self._jax.process_index()
-
-    def size(self) -> int:
-        return self._jax.process_count()
-
-    def local_rank(self) -> int:
-        return 0
-
-    def local_size(self) -> int:
-        return 1
-
-    def cross_rank(self) -> int:
-        # One engine process per host (local_size 1), so the cross-host
-        # topology is the process topology (reference basics.py semantics:
-        # cross_rank = node index, cross_size = node count).
-        return self.rank()
-
-    def cross_size(self) -> int:
-        return self.size()
-
-    # -- primitives (overridden by the test fake) ---------------------------
-
-    def _allgather_fixed(self, arr: np.ndarray, members=None) -> np.ndarray:
-        """[...]-shaped array from each (member) process → [k, ...] stack
-        in member order. The ONLY transport primitive; everything else is
-        protocol. ``members=None`` = all processes."""
-        if members is not None:
-            return self._device_gather(np.asarray(arr), members)
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            np.asarray(arr), tiled=False))
-
-    def _member_mesh(self, members):
-        """One-device-per-member-process mesh (the reference's
-        MPI_Comm_split communicator role). ``members=None`` = all."""
-        jax = self._jax
-        from jax.sharding import Mesh
-        procs = tuple(members) if members is not None \
-            else tuple(range(self.size()))
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        return Mesh(np.asarray([per_proc[p] for p in procs]), ("p",))
-
-    def _device_gather(self, arr: np.ndarray, members) -> np.ndarray:
-        """All-gather over the member mesh: one jitted XLA collective."""
-        jax = self._jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        key = ("gather", arr.shape, str(arr.dtype), tuple(members))
-        entry = self._device_fns.get(key)
-        if entry is None:
-            mesh = self._member_mesh(members)
-            fn = jax.jit(lambda x: x,
-                         out_shardings=NamedSharding(mesh, P()))
-            entry = (fn, mesh)
-            self._device_fns[key] = entry
-        fn, mesh = entry
-        from jax.experimental import multihost_utils
-        from jax.sharding import PartitionSpec as P
-        gx = multihost_utils.host_local_array_to_global_array(
-            arr[None], mesh, P("p"))
-        out = fn(gx)
-        return np.asarray(out.addressable_shards[0].data)
-
-    # -- protocol helpers ----------------------------------------------------
-
-    def _gather_obj(self, obj, members=None) -> list:
-        """Small-object allgather via pickle + pad-to-max (the reference's
-        RequestList serialization role, flatbuffers → pickle). With
-        ``members``, only those processes meet (member order)."""
-        import pickle
-        blob = np.frombuffer(
-            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-            dtype=np.uint8).copy()
-        sizes = self._allgather_fixed(
-            np.asarray([blob.shape[0]], dtype=np.int64), members)
-        m = int(sizes.max())
-        padded = np.zeros(m, dtype=np.uint8)
-        padded[:blob.shape[0]] = blob
-        g = self._allgather_fixed(padded, members)
-        return [pickle.loads(g[i, :int(sizes[i, 0])].tobytes())
-                for i in range(g.shape[0])]
-
-    def _gather_var(self, arr: np.ndarray, shape1, dtype,
-                    members=None) -> List[np.ndarray]:
-        """Variable-first-dim payload gather (pad to max rows)."""
-        arr = np.asarray(arr, dtype=dtype).reshape((-1,) + tuple(shape1))
-        sizes = self._allgather_fixed(
-            np.asarray([arr.shape[0]], dtype=np.int64), members)
-        m = max(1, int(sizes.max()))
-        padded = np.zeros((m,) + tuple(shape1), dtype=dtype)
-        padded[:arr.shape[0]] = arr
-        g = self._allgather_fixed(padded, members)
-        return [g[i, :int(sizes[i, 0])] for i in range(g.shape[0])]
-
-    def _round(self, header: dict, payload: np.ndarray, members=None,
-               sig=None):
-        """One negotiated round: header exchange → payload gather.
-
-        Returns (headers, per_rank_payloads) in member order (global rank
-        order when ``members`` is None). Active ranks must all carry the
-        same (kind, name) — otherwise every rank raises the mismatch error
-        the silent cross-pairing would have hidden.
-
-        ``sig``: cacheable signature of everything the header round would
-        establish (see the signature-cache block above). On a clean mini
-        round the pickled header exchange is skipped and headers are
-        synthesized from the local header — valid because hash agreement
-        implies every rank carries the identical signature and nobody is
-        joined. ``sig=None`` = uncacheable (alltoall's per-rank splits,
-        shape-unknown broadcast receivers).
-        """
-        with self._lock:
-            if self._cache_capacity > 0:
-                if self._negotiate_mini(sig, members):
-                    self._sig_commit(sig)
-                    k = self.size() if members is None else len(members)
-                    shape1 = tuple(header["shape"][1:])
-                    payloads = self._gather_var(
-                        payload, shape1, header["dtype"], members)
-                    return [dict(header, joined=False)] * k, payloads
-            headers = self._gather_obj(header, members)
-            active = [r for r, h in enumerate(headers) if not h["joined"]]
-            ops = {(h["kind"], h["name"], h.get("op"), h.get("root"))
-                   for h in headers if not h["joined"]}
-            if len(ops) > 1:
-                raise RuntimeError(
-                    f"collective mismatch across processes: {sorted(ops)} "
-                    "(each process must issue the same op; reference "
-                    "controller would stall here)")
-            if not active:
-                return headers, None
-            ref = next(h for h in headers if not h["joined"])
-            shape1 = tuple(ref["shape"][1:])
-            if header["joined"]:
-                payload = np.zeros((0,) + shape1, dtype=ref["dtype"])
-            payloads = self._gather_var(payload, shape1, ref["dtype"],
-                                        members)
-            self._sig_commit(sig)
-            return headers, payloads
-
-    # -- device-backed reduction payload -------------------------------------
-
-    _JNP_REDUCE = {Sum: "sum", Average: "sum", Min: "min", Max: "max",
-                   Product: "prod"}
-
-    @staticmethod
-    def _identity_contribution(op, dtype, length) -> np.ndarray:
-        """A joined rank's contribution: the op's identity element, so the
-        device reduction over ALL processes equals the reduction over the
-        active ones (the old gather path dropped joined rows instead)."""
-        dt = np.dtype(dtype)
-        if op in (Sum, Average):
-            return np.zeros(length, dt)
-        if op == Product:
-            return np.ones(length, dt)
-        if dt.kind == "b":  # bool min/max = logical and/or
-            return np.full(length, op == Min, dt)
-        big = np.finfo(dt).max if dt.kind == "f" else np.iinfo(dt).max
-        small = np.finfo(dt).min if dt.kind == "f" else np.iinfo(dt).min
-        return np.full(length, big if op == Min else small, dt)
-
-    def _device_reduce(self, flat: np.ndarray, op: str,
-                       scatter_shape=None, members=None) -> np.ndarray:
-        """ONE jitted XLA collective over a one-device-per-process mesh.
-
-        This is the data plane VERDICT r1 flagged: the old path allgathered
-        every rank's full payload to all ranks (~N x the wire bytes, plus a
-        size round) and reduced in numpy; here the payload rides a single
-        psum/reduce-scatter-shaped XLA program over DCN — ring wire cost,
-        reduction on device, numpy only at the local-shard boundary. The
-        header round (mismatch safety, join bookkeeping) is unchanged.
-        Compiled once per (size, dtype, op) and cached — gradient shapes
-        are stable across steps.
-        """
-        jax = self._jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        key = (flat.shape[0], str(flat.dtype), op, scatter_shape,
-               None if members is None else tuple(members))
-        entry = self._device_fns.get(key)
-        if entry is None:
-            mesh = self._member_mesh(members)
-            reducer = getattr(jnp, self._JNP_REDUCE[op])
-
-            def f(x):
-                y = reducer(x, axis=0)
-                if scatter_shape is not None:
-                    y = y.reshape(scatter_shape)
-                return y
-
-            out_spec = P("p") if scatter_shape is not None else P()
-            fn = jax.jit(f, out_shardings=NamedSharding(mesh, out_spec))
-            entry = (fn, mesh)
-            self._device_fns[key] = entry
-        fn, mesh = entry
-        from jax.experimental import multihost_utils
-        gx = multihost_utils.host_local_array_to_global_array(
-            flat[None], mesh, P("p"))
-        out = fn(gx)
-        return np.asarray(out.addressable_shards[0].data)
-
-    # -- collectives ---------------------------------------------------------
-
-    def _header(self, kind, name, arr, extra=None):
-        h = {"kind": kind, "name": name, "joined": self._joined,
-             "shape": tuple(np.asarray(arr).shape) if arr is not None
-             else (0,),
-             "dtype": str(np.asarray(arr).dtype) if arr is not None
-             else "float32"}
-        h.update(extra or {})
-        return h
-
-    def _reduce_header_round(self, kind, name, flat, op, extra=None,
-                             members=None):
-        """Header exchange + sanity for the device-reduction ops: returns
-        the ACTIVE count. Unlike the gather path, the device payload needs
-        identical shape/dtype on every active rank (no pad-to-max), so the
-        divergence the padding used to mask becomes an explicit error."""
-        ex = {"op": op}
-        ex.update(extra or {})
-        sig = None
-        if self._cache_capacity > 0:
-            flat = np.asarray(flat)
-            sig = ("reduce", kind, name, tuple(flat.shape), str(flat.dtype),
-                   op, tuple(sorted((extra or {}).items())), members)
-            if self._negotiate_mini(sig, members):
-                # Clean mini: hash agreement implies every active rank has
-                # the identical (kind, name, shape, dtype, op) — the full
-                # checks below would pass — and no rank is joined.
-                self._sig_commit(sig)
-                return self.size() if members is None else len(members)
-        headers = self._gather_obj(self._header(kind, name, flat, ex),
-                                   members)
-        active = [h for h in headers if not h["joined"]]
-        ops = {(h["kind"], h["name"], h.get("op")) for h in active}
-        if len(ops) > 1:
-            raise RuntimeError(
-                f"collective mismatch across processes: {sorted(ops)} "
-                "(each process must issue the same op; reference "
-                "controller would stall here)")
-        sigs = {(tuple(h["shape"]), h["dtype"]) for h in active}
-        if len(sigs) > 1:
-            raise RuntimeError(
-                f"{kind} {name!r}: shape/dtype differs across processes: "
-                f"{sorted(sigs)}")
-        self._sig_commit(sig)
-        return len(active)
-
-    def allreduce(self, name, arr, op, members=None):
-        members = self._norm_members(members)
-        arr = np.asarray(arr)
-        if op == Adasum:
-            # Adasum's pairwise tree reduction stays on the host gather
-            # path (the combine is not an elementwise monoid XLA's
-            # reduce lowers to).
-            return self._gather_allreduce(name, arr, op, members)
-        flat = arr.reshape(1, -1)
-        with self._lock:
-            n_active = self._reduce_header_round("allreduce", name, flat, op,
-                                                 members=members)
-            red = self._device_reduce(flat.ravel(), op, members=members)
-            if op == Average:
-                red = (red / n_active).astype(arr.dtype, copy=False)
-            return red.reshape(arr.shape)
-
-    def _gather_allreduce(self, name, arr, op, members=None):
-        """The pre-r2 payload path (full N-way gather + host reduce): kept
-        for Adasum and as the A/B baseline in benchmarks/torch_engine_bw.py
-        — the device path's win is exactly this path's O(N*bytes) wire
-        cost."""
-        arr = np.asarray(arr)
-        flat = arr.reshape(1, -1)
-        headers, payloads = self._round(
-            self._header("allreduce", name, flat, {"op": op}), flat,
-            members,
-            sig=("gather", "allreduce", name, tuple(flat.shape),
-                 str(flat.dtype), op, members))
-        arrays = [payloads[r][0] for r, h in enumerate(headers)
-                  if not h["joined"] and len(payloads[r])]
-        return reduce_arrays(arrays, op).reshape(arr.shape)
-
-    def allgather(self, name, arr, members=None):
-        members = self._norm_members(members)
-        arr = np.asarray(arr)
-        headers, payloads = self._round(
-            self._header("allgather", name, arr), arr, members,
-            sig=("gather", "allgather", name, tuple(arr.shape[1:]),
-                 str(arr.dtype), members))
-        return np.concatenate([p for p in payloads if p.shape[0]]
-                              if any(p.shape[0] for p in payloads)
-                              else [arr[:0]])
-
-    def broadcast(self, name, arr, root_rank, members=None):
-        members = self._norm_members(members)
-        arr = None if arr is None else np.asarray(arr)
-        payload = arr[None] if arr is not None else None
-        # Shape-unknown receivers (arr=None) can't sign the round — they
-        # learn shape/dtype from the root's header, so they force the full
-        # round every time (rare: parameter broadcasts pass tensors).
-        sig = None if arr is None else (
-            "gather", "broadcast", name, tuple(arr.shape), str(arr.dtype),
-            root_rank, members)
-        headers, payloads = self._round(
-            self._header("broadcast", name, payload,
-                         {"root": root_rank}), payload, members, sig=sig)
-        # headers/payloads are in member order; root_rank is a GLOBAL rank.
-        if members is not None:
-            if root_rank not in members:
-                raise ValueError(
-                    f"broadcast root {root_rank} not in process set "
-                    f"{sorted(members)}")
-            root_pos = members.index(root_rank)
-        else:
-            root_pos = root_rank
-        if headers[root_pos]["joined"]:
-            raise RuntimeError(
-                f"broadcast root {root_rank} has already joined")
-        return payloads[root_pos][0]
-
-    def alltoall(self, name, arr, splits, members=None):
-        members = self._norm_members(members)
-        arr = np.asarray(arr)
-        n = self.size() if members is None else len(members)
-        me = self.rank() if members is None \
-            else members.index(self.rank())
-        sp = None if splits is None else np.asarray(splits, dtype=np.int64)
-        if sp is None:
-            if arr.shape[0] % n:
-                raise ValueError(
-                    f"alltoall first dim {arr.shape[0]} not divisible by "
-                    f"size {n} and no splits given")
-            sp = np.asarray([arr.shape[0] // n] * n, dtype=np.int64)
-        headers, payloads = self._round(
-            self._header("alltoall", name, arr,
-                         {"splits": sp.tolist()}), arr, members)
-        parts = []
-        for src, h in enumerate(headers):
-            if h["joined"]:
-                continue
-            ssp = np.asarray(h["splits"], dtype=np.int64)
-            lo = int(ssp[:me].sum())
-            parts.append(payloads[src][lo:lo + int(ssp[me])])
-        return (np.concatenate(parts) if parts else arr[:0],
-                np.asarray([p.shape[0] for p in parts], dtype=np.int64))
-
-    def reducescatter(self, name, arr, op, members=None):
-        members = self._norm_members(members)
-        arr = np.asarray(arr)
-        n = self.size() if members is None else len(members)
-        if arr.shape[0] % n:
-            raise ValueError(
-                f"reducescatter first dim {arr.shape[0]} not divisible by "
-                f"size {n}")
-        flat = arr.reshape(1, -1)
-        with self._lock:
-            n_active = self._reduce_header_round(
-                "reducescatter", name, flat, op,
-                {"orig_shape": tuple(arr.shape)}, members=members)
-            red = self._device_reduce(flat.ravel(), op,
-                                      scatter_shape=tuple(arr.shape),
-                                      members=members)
-            if op == Average:
-                red = (red / n_active).astype(arr.dtype, copy=False)
-            return red
-
-    def barrier(self, name="barrier", members=None):
-        members = self._norm_members(members)
-        self._round(self._header("barrier", name, None),
-                    np.zeros((1, 0), dtype=np.float32), members,
-                    sig=("gather", "barrier", name, members))
-
-    def join(self) -> int:
-        """Reference JoinOp over rounds: keep answering active ranks'
-        collectives with zero contributions until every process has
-        joined; returns the highest-ranked last joiner."""
-        self._joined = True
-        try:
-            while True:
-                if self._cache_capacity > 0:
-                    # Speak the mini-round protocol so active ranks' cached
-                    # ops see our joined bit and fall back to the full
-                    # header round (which is how we learn what op to answer
-                    # with). Never returns True: our own joined flag is in
-                    # the gather.
-                    self._negotiate_mini(None)
-                headers = self._gather_obj(
-                    {"kind": "join_poll", "name": "join", "joined": True,
-                     "rank": self.rank()})
-                active = [h for h in headers if not h.get("joined", False)]
-                if not active:
-                    return max(h.get("rank", 0) if h.get("joined") else -1
-                               for h in headers)
-                # An active rank is mid-collective: its header for the op
-                # round will follow; participate via the op path. The
-                # active rank's _round treats our header as joined and
-                # excludes our zero payload.
-                ops = {(h["kind"], h["name"], h.get("op"))
-                       for h in active}
-                if len(ops) > 1:
-                    # Active ranks raised a mismatch and will not issue the
-                    # payload round — raise here too instead of hanging.
-                    raise RuntimeError(
-                        f"collective mismatch across processes: "
-                        f"{sorted(ops)}")
-                ref = active[0]
-                if ref["kind"] == "join_poll":
-                    continue  # it will re-enter; loop again
-                if (ref["kind"] in ("allreduce", "reducescatter")
-                        and ref.get("op") != Adasum):
-                    # Mirror the active ranks' shape/dtype sanity check:
-                    # if THEY are about to raise in _reduce_header_round,
-                    # entering the device collective here would hang this
-                    # joined process forever.
-                    sigs = {(tuple(h["shape"]), h["dtype"]) for h in active}
-                    if len(sigs) > 1:
-                        raise RuntimeError(
-                            f"{ref['kind']} {ref['name']!r}: shape/dtype "
-                            f"differs across processes: {sorted(sigs)}")
-                    # Device-reduction payload: EVERY process must execute
-                    # the same XLA program — contribute the op's identity
-                    # element so the active ranks' result is unchanged.
-                    length = int(np.prod(ref["shape"]))
-                    contrib = self._identity_contribution(
-                        ref["op"], ref["dtype"], length)
-                    scatter = (tuple(ref["orig_shape"])
-                               if ref["kind"] == "reducescatter" else None)
-                    self._device_reduce(contrib, ref["op"], scatter)
-                else:
-                    shape1 = tuple(ref["shape"][1:])
-                    self._gather_var(
-                        np.zeros((0,) + shape1, dtype=ref["dtype"]),
-                        shape1, ref["dtype"])
-        finally:
-            self._joined = False
+"""Compat shim: the process-collective engines are framework-neutral
+(numpy payloads) and now live in ``horovod_tpu.core.engine`` so the
+tensorflow binding can share them; this module re-exports the public
+surface under its historical name."""
+
+from ..core.engine import (  # noqa: F401
+    Adasum, Average, CollectiveEngine, JaxProcessEngine, Max, Min, Product,
+    SingleProcessEngine, Sum, ThreadSimEngine, _Rendezvous, reduce_arrays)

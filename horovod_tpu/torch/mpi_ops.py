@@ -123,11 +123,8 @@ def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
         if _state is not None:
             return
         if engine is None:
-            import jax
-            if jax.process_count() > 1:
-                engine = _engine.JaxProcessEngine()
-            else:
-                engine = _engine.SingleProcessEngine()
+            from ..core.engine import default_engine
+            engine = default_engine()
         _state = _TorchRuntime(engine)
 
 

@@ -89,20 +89,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     @staticmethod
     def _fusion_threshold_bytes() -> int:
         """``HOROVOD_FUSION_THRESHOLD`` (bytes; reference default 64 MiB;
-        0 disables fusion — reference semantics). Resolved through the
-        SAME chain the in-graph path uses (autotuner/thread-local override
-        > initialized context config > env via ``Config.from_env``) so the
-        'one env var, every fusion mechanism' contract in PARITY §4 holds;
+        0 disables fusion — reference semantics), resolved through the
+        config chain shared with the in-graph path and the tf binding;
         read per step so a live optimizer can be retuned."""
-        from ..collectives.ops import _fusion_threshold
-        from ..core import context_api as _ctx
-        t = _fusion_threshold()
-        if t is None:
-            if _ctx.is_initialized():
-                return 1 << 62  # context says uncapped: one bucket
-            from ..core.config import Config
-            t = Config.from_env().fusion_threshold_bytes
-        return int(t)
+        from ..core.config import resolve_fusion_threshold_bytes
+        return resolve_fusion_threshold_bytes()
 
     @property
     def _defer_submission(self) -> bool:

@@ -34,6 +34,7 @@ EXAMPLES = [
                          "--steps", "2", "--warmup", "1"], "tokens/sec"),
     ("torch_synthetic.py", ["--steps", "2", "--warmup", "1",
                             "--fp16-allreduce"], "images/sec"),
+    ("tensorflow_keras_synthetic.py", ["--steps", "2"], "weight-norm"),
     ("train_pipeline.py", ["--steps", "3", "--microbatches", "4"],
      "schedule=1f1b"),
     ("train_pipeline.py", ["--steps", "3", "--microbatches", "4",

@@ -331,3 +331,54 @@ def test_hvdrun_timeline_flag_reaches_worker(tmp_path):
     assert trace.exists()
     text = trace.read_text()
     assert '"traceEvents"' in text or text.strip().startswith("[")
+
+
+TF_WORKER = """
+import json
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import numpy as np
+import horovod_tpu as hvdj
+hvdj.init()   # brings up jax.distributed from the launcher's env
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+assert hvd.size() == 2
+
+@tf.function
+def step(x):
+    return hvd.allreduce(x, op=hvd.Sum, name="graph_ar") * 2.0
+
+out = step(tf.constant([float(hvd.rank() + 1)])).numpy()
+
+v = tf.Variable(np.full((2,), float(hvd.rank()), np.float32))
+hvd.broadcast_variables([v], root_rank=1)
+
+with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+    w = tf.Variable([2.0])
+    loss = tf.reduce_sum(w * (hvd.rank() + 1.0))
+g = tape.gradient(loss, [w])[0]
+print(json.dumps({"rank": hvd.rank(), "graph": out.tolist(),
+                  "bcast": np.asarray(v).tolist(),
+                  "grad": np.asarray(g).tolist()}))
+"""
+
+
+@pytest.mark.integration
+def test_hvdrun_tensorflow_binding(tmp_path):
+    """The TF binding over the production JaxProcessEngine with 2 real
+    processes: tf.function allreduce (py_function boundary),
+    broadcast_variables, DistributedGradientTape averaging."""
+    script = tmp_path / "tf_worker.py"
+    script.write_text(TF_WORKER)
+    r = _run_hvdrun(["-np", "2", "-H", "localhost:1,127.0.0.1:1",
+                     sys.executable, str(script)], timeout=360)
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 2
+    for out in lines:
+        assert out["graph"] == [6.0]        # (1+2)*2
+        assert out["bcast"] == [1.0, 1.0]   # root 1's value
+        assert out["grad"] == [1.5]         # mean of 1 and 2
