@@ -140,8 +140,44 @@ def median_ratio(rounds, num: str, den: str) -> float:
 
 
 def emit(metric: str, value: float, unit: str,
-         vs_baseline: float | None = None) -> None:
+         vs_baseline: float | None = None, **extra) -> None:
     line = {"metric": metric, "value": round(float(value), 3), "unit": unit}
     if vs_baseline is not None:
         line["vs_baseline"] = round(float(vs_baseline), 4)
+    line.update({k: v for k, v in extra.items() if v is not None})
     print(json.dumps(line), flush=True)
+
+
+def params_count(tree, select=None) -> int:
+    """Total parameter count of a pytree; ``select(path_string) -> bool``
+    filters leaves by their joined key path (lower-cased)."""
+    import jax
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if select is not None:
+            joined = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                              for p in path).lower()
+            if not select(joined):
+                continue
+        total += int(np.prod(leaf.shape)) if hasattr(leaf, "shape") else 0
+    return total
+
+
+def lm_train_flops_per_token(n_params_active: int, n_layers: int, dim: int,
+                             seq: int) -> float:
+    """Analytic training FLOPs per token for a decoder/encoder LM: the
+    6·N parameter term (fwd 2N + bwd 4N, embeddings-in conventional) plus
+    the attention-matmul term 12·L·T·d (QK^T and AV are 2·T·d FLOPs each
+    fwd per layer-token, x3 for training) — the standard MFU accounting
+    (PaLM appendix / scaling-book convention)."""
+    return 6.0 * n_params_active + 12.0 * n_layers * seq * dim
+
+
+def mfu_fields(per_chip_rate: float, flops_per_item: float) -> dict:
+    """``{"mfu": ..., "peak_tflops": ...}`` for the JSON line, or {} when
+    off-TPU / peak unknown (callers splat this into emit(**...))."""
+    peak = peak_flops()
+    if not on_tpu() or not np.isfinite(peak) or flops_per_item <= 0:
+        return {}
+    return {"mfu": round(per_chip_rate * flops_per_item / peak, 4),
+            "peak_tflops": round(peak / 1e12, 1)}

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from common import emit, on_tpu, slope_time, sync
+from common import (emit, mfu_fields, on_tpu, params_count,
+                    slope_time, sync)
 
 
 def main():
@@ -88,9 +89,19 @@ def main():
         sync(loss)
 
     eps = B / slope_time(run, 2, 8)
+    # DLRM FLOPs/example: 6x the DENSE (MLP + interaction-projection)
+    # params — embedding tables are lookups, not FLOPs; the pairwise
+    # feature interaction adds 3 * 2 * F^2 * d (train = 3x fwd batched
+    # dot of the F x d feature matrix).
+    dense_params = params_count(params,
+                                select=lambda p: "table" not in p
+                                and "embed" not in p)
+    n_feats = cfg.num_tables + 1
+    flops_ex = 6.0 * dense_params + 6.0 * n_feats * n_feats * cfg.embed_dim
     emit("dlrm_examples_per_sec_per_chip", eps / n,
          f"examples/sec/chip ({cfg.num_tables} tables x "
-         f"{cfg.rows_per_table} rows, {n} devices)")
+         f"{cfg.rows_per_table} rows, {n} devices)",
+         **mfu_fields(eps / n, flops_ex))
 
 
 if __name__ == "__main__":

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from common import emit, on_tpu, slope_time, sync
+from common import (emit, lm_train_flops_per_token, mfu_fields,
+                    on_tpu, params_count, slope_time, sync)
 
 
 def main():
@@ -68,9 +69,12 @@ def main():
             sync(loss)
 
         tps = batch * seq / slope_time(run, 2, 8)
+        flops_tok = lm_train_flops_per_token(
+            params_count(state.params), cfg.n_layers, cfg.dim, seq)
         emit(f"llama_tokens_per_sec_per_chip_{op_name}", tps / n,
              f"tokens/sec/chip (dim {cfg.dim} x {cfg.n_layers}L, seq "
-             f"{seq}, op={op_name}, {n} devices)")
+             f"{seq}, op={op_name}, {n} devices)",
+             **mfu_fields(tps / n, flops_tok))
 
 
 if __name__ == "__main__":

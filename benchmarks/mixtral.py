@@ -16,7 +16,8 @@ import numpy as np
 import jax.numpy as jnp
 import optax
 
-from common import emit, on_tpu, slope_time, sync
+from common import (emit, lm_train_flops_per_token, mfu_fields,
+                    on_tpu, params_count, slope_time, sync)
 
 
 def main():
@@ -63,10 +64,22 @@ def main():
         sync(loss)
 
     tps = batch * seq / slope_time(run, 2, 8)
+    # Active params per token: non-expert params + top_k/n_experts of the
+    # routed expert bank (the MoE MFU convention — compute follows the
+    # routed fraction, not the resident parameter count).
+    total = params_count(state.params)
+    # The routed expert bank is moe/{w1,w2,w3} (leading E dim); the
+    # router and norms are always-active.
+    expert = params_count(
+        state.params,
+        select=lambda p: "moe" in p and p.rsplit("/", 1)[-1] in
+        ("w1", "w2", "w3"))
+    active = total - expert + expert * cfg.top_k / cfg.n_experts
+    flops_tok = lm_train_flops_per_token(active, cfg.n_layers, cfg.dim, seq)
     emit("mixtral_tokens_per_sec_per_chip", tps / n,
          f"tokens/sec/chip ({cfg.n_experts} experts top-{cfg.top_k}, "
          f"seq {seq}, mesh {dict(zip(mesh.axis_names, mesh.devices.shape))},"
-         f" {n} devices)")
+         f" {n} devices)", **mfu_fields(tps / n, flops_tok))
 
 
 if __name__ == "__main__":

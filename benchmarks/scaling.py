@@ -109,19 +109,43 @@ def main():
     eff = n * median_ratio(rounds, "dp1", "dp8")
     eff_h = n * median_ratio(rounds, "dp1", "hier8")
 
-    print(json.dumps({
+    rec = {
         "metric": "dp8_virtual_scaling_efficiency",
         "value": round(eff, 4),
         "unit": f"n*t1/t8 (shared-core CPU mesh, ResNetTiny, "
                 f"batch {LOCAL_BATCH}/dev; ideal 1.0)",
         "vs_baseline": round(eff, 4),
-    }))
-    print(json.dumps({
+    }
+    rec_h = {
         "metric": "dp8_hierarchical_scaling_efficiency",
         "value": round(eff_h, 4),
         "unit": "n*t1/t8, 2x4 cross/intra mesh, hierarchical allreduce",
         "vs_baseline": round(eff_h, 4),
-    }))
+    }
+    print(json.dumps(rec))
+    print(json.dumps(rec_h))
+    _append_history([rec, rec_h])
+
+
+def _append_history(records) -> None:
+    """Round-over-round MOVEMENT is the signal (module docstring), so each
+    run appends its lines — stamped with git SHA + date — to the committed
+    ``benchmarks/scaling_history.jsonl`` series (VERDICT r2 weak #6: the
+    guardrail previously had no memory)."""
+    import datetime
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True,
+                             cwd=here).stdout.strip() or None
+    except OSError:
+        sha = None
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    with open(os.path.join(here, "scaling_history.jsonl"), "a") as f:
+        for rec in records:
+            f.write(json.dumps({"date": stamp, "git": sha, **rec}) + "\n")
 
 
 if __name__ == "__main__":

@@ -334,6 +334,24 @@ def _fusion_threshold() -> Optional[int]:
     return int(t) if t is not None and t >= 0 else None
 
 
+_hier_override = _threading.local()
+
+
+@_contextlib.contextmanager
+def hierarchical_override(value: Optional[bool]):
+    """Thread-locally force HOROVOD_HIERARCHICAL_ALLREDUCE on/off for the
+    traces inside this context (None = follow the config) — the
+    transparent autotuner's second dimension: hierarchical vs flat is a
+    pure graph-shape choice (identical numerics), so it is safe to search
+    live."""
+    prev = getattr(_hier_override, "value", None)
+    _hier_override.value = value
+    try:
+        yield
+    finally:
+        _hier_override.value = prev
+
+
 def _hierarchical_axes(axis, process_set, op: str):
     """(cross_axes, intra_axis) when HOROVOD_HIERARCHICAL_ALLREDUCE should
     reshape this reduce, else None.
@@ -350,8 +368,13 @@ def _hierarchical_axes(axis, process_set, op: str):
         return None
     if not _is_global(process_set):
         return None
-    if not (_ctx.is_initialized()
-            and _ctx.context().config.hierarchical_allreduce):
+    ov = getattr(_hier_override, "value", None)
+    if ov is not None:
+        enabled = bool(ov)
+    else:
+        enabled = (_ctx.is_initialized()
+                   and _ctx.context().config.hierarchical_allreduce)
+    if not enabled:
         return None
     return axis[:-1], axis[-1]
 

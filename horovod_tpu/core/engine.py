@@ -926,15 +926,20 @@ class JaxProcessEngine(CollectiveEngine):
         me = self.rank() if members is None \
             else members.index(self.rank())
         sp = None if splits is None else np.asarray(splits, dtype=np.int64)
-        if sp is None:
-            if arr.shape[0] % n:
-                raise ValueError(
-                    f"alltoall first dim {arr.shape[0]} not divisible by "
-                    f"size {n} and no splits given")
+        if sp is None and arr.shape[0] % n == 0:
             sp = np.asarray([arr.shape[0] // n] * n, dtype=np.int64)
+        # An indivisible dim-0 with no splits still joins the header round
+        # (splits=None marks it) and raises AFTER it, on every rank —
+        # raising locally first would leave the passing ranks blocked in
+        # the header allgather (ADVICE r2).
         headers, payloads = self._round(
             self._header("alltoall", name, arr,
-                         {"splits": sp.tolist()}), arr, members)
+                         {"splits": None if sp is None else sp.tolist()}),
+            arr, members)
+        if any(h["splits"] is None for h in headers if not h["joined"]):
+            raise ValueError(
+                f"alltoall first dim {arr.shape[0]} not divisible by "
+                f"size {n} and no splits given")
         parts = []
         for src, h in enumerate(headers):
             if h["joined"]:
@@ -949,15 +954,20 @@ class JaxProcessEngine(CollectiveEngine):
         members = self._norm_members(members)
         arr = np.asarray(arr)
         n = self.size() if members is None else len(members)
-        if arr.shape[0] % n:
-            raise ValueError(
-                f"reducescatter first dim {arr.shape[0]} not divisible by "
-                f"size {n}")
         flat = arr.reshape(1, -1)
         with self._lock:
             n_active = self._reduce_header_round(
                 "reducescatter", name, flat, op,
                 {"orig_shape": tuple(arr.shape)}, members=members)
+            # Local validation AFTER the header round (ADVICE r2): the
+            # round has just verified shape agreement, so a failing check
+            # raises on EVERY rank together — raising before it would
+            # leave the passing ranks blocked in the header allgather
+            # whenever shapes diverged such that only some ranks fail.
+            if arr.shape[0] % n:
+                raise ValueError(
+                    f"reducescatter first dim {arr.shape[0]} not divisible "
+                    f"by size {n}")
             red = self._device_reduce(flat.ravel(), op,
                                       scatter_shape=tuple(arr.shape),
                                       members=members)
@@ -1018,6 +1028,15 @@ class JaxProcessEngine(CollectiveEngine):
                         raise RuntimeError(
                             f"{ref['kind']} {ref['name']!r}: shape/dtype "
                             f"differs across processes: {sorted(sigs)}")
+                    if (ref["kind"] == "reducescatter"
+                            and ref["orig_shape"][0] % self.size()):
+                        # Actives will raise their post-round divisibility
+                        # error; entering the device collective here would
+                        # hang this joined process forever.
+                        raise ValueError(
+                            f"reducescatter first dim "
+                            f"{ref['orig_shape'][0]} not divisible by size "
+                            f"{self.size()}")
                     # Device-reduction payload: EVERY process must execute
                     # the same XLA program — contribute the op's identity
                     # element so the active ranks' result is unchanged.

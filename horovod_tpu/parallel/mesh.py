@@ -64,9 +64,19 @@ def create_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     slices over DCN, ``ici_axes`` within each slice over ICI — the
     generalisation of the reference's hierarchical allreduce topology."""
     devices = list(devices) if devices is not None else jax.devices()
-    names = [a for a in AXIS_ORDER if a in dcn_axes or a in ici_axes]
-    names += [a for a in list(dcn_axes) + list(ici_axes)
-              if a not in names]  # user extras (e.g. "cross"/"intra") last
+
+    def ordered(d):
+        out = [a for a in AXIS_ORDER if a in d]
+        return out + [a for a in d if a not in out]  # user extras last
+
+    # DCN-bearing axes are OUTERMOST regardless of canonical-vs-extra
+    # naming: the hierarchical collective paths (`_hierarchical_axes`)
+    # treat axis[-1] as the ICI-contiguous axis, so a user DCN axis
+    # ordered innermost would silently put the bandwidth-heavy
+    # reduce-scatter phase on DCN (ADVICE r2 — a performance inversion,
+    # not a numerics bug). Axes with BOTH extents sort with the DCN group.
+    names = ordered(dcn_axes) + [a for a in ordered(ici_axes)
+                                 if a not in dcn_axes]
     ici = [int(ici_axes.get(a, 1)) for a in names]
     dcn = [int(dcn_axes.get(a, 1)) for a in names]
     from jax.experimental import mesh_utils

@@ -332,3 +332,21 @@ class StepAutotuner:
     # any step function.
     def __call__(self, *args, **kwargs):
         return self.step(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        """AOT introspection passthrough (ADVICE r2: the plain path
+        preserves ``step.lower``; code relying on it must not break only
+        when HOROVOD_AUTOTUNE=1). Lowers the CURRENT knob set's step —
+        the converged choice when tuning has finished. The built step's
+        own ``lower`` is used when present (the transparent-autotune
+        wrapper applies its knob overrides there, so the lowered program
+        is the one this step actually executes)."""
+        if self._fn is None:
+            if self.chosen is not None:
+                self._fn = self.build_step(**self.chosen)
+            else:
+                self._begin_trial()
+        inner = self._fn
+        while not hasattr(inner, "lower") and hasattr(inner, "__wrapped__"):
+            inner = inner.__wrapped__
+        return inner.lower(*args, **kwargs)

@@ -182,32 +182,56 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
 
 def _autotuned_train_step(model, optimizer, loss_fn, **build_kw):
-    """HOROVOD_AUTOTUNE=1 engagement: wrap the step in a StepAutotuner that
-    searches the fusion bucket size (the reference tunes its fusion buffer
-    + cycle time the same propose→measure→report way). Each trial mutates
-    ``Config.fusion_threshold_bytes`` and re-traces the step — collectives
-    read the threshold at trace time (``collectives/ops.py::
-    _fusion_threshold``), so the knob genuinely changes the emitted HLO."""
+    """HOROVOD_AUTOTUNE=1 engagement: wrap the step in a StepAutotuner
+    that searches the GRAPH-SHAPE knobs live (the reference tunes fusion
+    buffer + cycle time + hierarchical flags the same
+    propose→measure→report way, parameter_manager.cc):
+
+    - ``fusion_threshold_bytes`` — gradient bucket size;
+    - ``hierarchical`` — staged reducescatter/allgather vs flat allreduce
+      (only on a multi-axis rank mesh, where the choice exists).
+
+    Both change ONLY the emitted HLO (identical numerics and step
+    contract), so they are safe to search under a live training loop.
+    ``scan_steps`` is deliberately NOT in this space: it changes how many
+    optimizer updates one call performs — a caller-visible contract — so
+    it remains an explicit ``StepAutotuner`` dimension for callers who
+    own their loop (see tools/autotune.py's usage example)."""
     from .core.logging import get_logger
-    from .collectives.ops import fusion_threshold_override
-    from .tools.autotune import Autotuner, LogIntDim, StepAutotuner
+    from .collectives.ops import (fusion_threshold_override,
+                                  hierarchical_override)
+    from .tools.autotune import Autotuner, CatDim, LogIntDim, StepAutotuner
 
     cfg = _ctx.context().config
+    ctx_axis = _ctx.context().axis_name
 
-    def build(fusion_threshold_bytes):
+    def build(fusion_threshold_bytes, hierarchical=None):
         inner = make_train_step(model, optimizer, loss_fn, autotune=False,
                                 **build_kw)
         thr = int(fusion_threshold_bytes)
 
         def stepped(*args, **kwargs):
-            # jit traces lazily (on first call), so the trial threshold is
-            # scoped around every invocation — it reaches THIS step's trace
-            # and never leaks into other functions traced while tuning.
-            with fusion_threshold_override(thr):
+            # jit traces lazily (on first call), so the trial knobs are
+            # scoped around every invocation — they reach THIS step's
+            # trace and never leak into other functions traced while
+            # tuning.
+            with fusion_threshold_override(thr), \
+                    hierarchical_override(hierarchical):
                 return inner(*args, **kwargs)
+
+        def lowered(*args, **kwargs):
+            # AOT introspection must trace under the SAME knobs the step
+            # executes with — lowering outside the overrides would show
+            # the config-default program, not the tuned one.
+            with fusion_threshold_override(thr), \
+                    hierarchical_override(hierarchical):
+                return inner.lower(*args, **kwargs)
+        stepped.lower = lowered
         return stepped
 
     space = {"fusion_threshold_bytes": LogIntDim(1 << 20, 1 << 28)}
+    if isinstance(ctx_axis, tuple) and len(ctx_axis) >= 2:
+        space["hierarchical"] = CatDim((False, True))
     tuner = Autotuner(space, warmup_trials=cfg.autotune_warmup_samples,
                       max_trials=cfg.autotune_max_samples,
                       log_path=cfg.autotune_log)

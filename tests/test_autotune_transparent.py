@@ -140,3 +140,58 @@ def test_example_run_with_env_var_only(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     rows = list(csv.reader(open(log)))
     assert len(rows) - 1 >= 3, rows
+
+
+def test_two_dim_search_on_hierarchical_mesh(tmp_path):
+    """VERDICT r2 #5: a >=2-D transparent search. On a 2-axis mesh the
+    space is fusion_threshold x hierarchical (both graph-shape-only
+    knobs); the search converges, chooses both, and the CSV carries both
+    columns. (scan_steps is deliberately NOT transparent-tunable — it
+    changes how many updates one call performs, a caller-visible
+    contract; see train.py::_autotuned_train_step.)"""
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.tools.autotune import StepAutotuner
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    log = tmp_path / "autotune2d.csv"
+    hvd.shutdown()
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(2, 4), ("cross", "intra"))
+    hvd.init(mesh=mesh, config=Config(
+        autotune=True, autotune_log=str(log), autotune_warmup_samples=2,
+        autotune_steps_per_sample=2, autotune_max_samples=4))
+    model, loss_fn = _mlp_pieces()
+    opt = distributed(optax.sgd(0.1))
+    xs = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    ys = jnp.asarray(np.random.RandomState(1).randint(0, 4, size=(16,)))
+    state = create_train_state(model, jax.random.PRNGKey(0), xs[:2], opt,
+                               broadcast=False)
+    step = make_train_step(model, opt, loss_fn, donate=False)
+    assert isinstance(step, StepAutotuner)
+
+    losses = []
+    for _ in range(16):  # 4 trials x (2 steps + 1 compile) + lock-in
+        state, loss = step(state, xs, ys)
+        losses.append(float(loss))
+    assert step.chosen is not None, "2-D tuner did not converge"
+    assert set(step.chosen) == {"fusion_threshold_bytes", "hierarchical"}
+    assert step.chosen["hierarchical"] in (False, True)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # AOT introspection must survive the autotune wrapper (ADVICE r2) AND
+    # trace under the CHOSEN knobs: the lowered text must equal a plain
+    # step built under the same overrides explicitly (lowering outside
+    # them would show the config-default program).
+    from horovod_tpu.collectives.ops import (fusion_threshold_override,
+                                             hierarchical_override)
+    txt = step.lower(state, xs, ys).as_text()
+    with fusion_threshold_override(step.chosen["fusion_threshold_bytes"]), \
+            hierarchical_override(step.chosen["hierarchical"]):
+        ref = make_train_step(model, opt, loss_fn, donate=False,
+                              autotune=False).lower(state, xs, ys).as_text()
+    assert txt == ref
+
+    rows = list(csv.reader(open(log)))
+    assert rows[0] == ["trial", "fusion_threshold_bytes", "hierarchical",
+                      "score"]
+    assert len(rows) - 1 >= 4
