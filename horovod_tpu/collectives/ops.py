@@ -549,6 +549,26 @@ def _ragged_set(process_set: Optional[ProcessSet], axis) -> bool:
     return (world - k) % k != 0
 
 
+def _padded_member_groups(process_set: ProcessSet, axis):
+    """Equal-size ``axis_index_groups`` with the member set padded by
+    complement ranks to the smallest world-divisor >= set size, as group
+    0 — the wire-cost fix for RAGGED sets (VERDICT r2 #8): a 3-of-8
+    allgather then moves 4 rows/device, not 8. Returns the groups, or
+    None when no divisor beats the full axis (e.g. 5 of 8). On this
+    path only MEMBERS receive meaningful output (the reference leaves
+    non-participant output undefined; shapes stay uniform)."""
+    world = lax.axis_size(axis)
+    members = sorted(process_set.ranks)
+    k = len(members)
+    s = next(d for d in range(k, world + 1) if world % d == 0)
+    if s >= world:
+        return None
+    comp = [r for r in range(world) if r not in process_set.ranks]
+    pad, rest = comp[:s - k], comp[s - k:]
+    return [members + pad] + [rest[i:i + s]
+                              for i in range(0, len(rest), s)]
+
+
 def _member_pos(process_set: ProcessSet, axis):
     """Traced position of this device within the (sorted) member list;
     0 for non-members (callers mask their output)."""
@@ -569,20 +589,30 @@ def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None,
     SURVEY.md §7 "hard parts").
 
     Process sets whose complement doesn't split into equal groups (e.g.
-    5 of 8 ranks — inexpressible as ``axis_index_groups``) fall back to a
-    full-axis gather + static member-row selection: every device (members
-    AND non-members) receives the members' concatenation. The reference has
-    no equal-partition constraint; this removes ours at the cost of
-    gathering world-size instead of set-size bytes on that rare path.
+    5 of 8 ranks — inexpressible as ``axis_index_groups``) take a padded
+    construction: the member set plus enough complement ranks to reach
+    the smallest world-divisor forms group 0 (a 3-of-8 gather moves 4
+    rows/device, not 8) and members slice off their rows; on this path
+    non-member output is shape-correct but unspecified (reference
+    semantics: non-participants never call the op). When no divisor
+    beats the full axis (5 of 8), it falls back to a full-axis gather +
+    member-row selection — there every device, members AND non-members,
+    receives the members' concatenation.
     """
     axis = _axis(axis_name)
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
     if not _is_global(process_set) and _ragged_set(process_set, axis):
         members = sorted(process_set.ranks)
+        k = len(members)
+        pg = _padded_member_groups(process_set, axis)
 
         def ragged_leaf(x):
             m = x.shape[0]
+            if pg is not None:
+                g = lax.all_gather(x, axis, axis=0, tiled=True,
+                                   axis_index_groups=pg)
+                return g[:k * m]  # members' rows (members lead group 0)
             g = lax.all_gather(x, axis, axis=0, tiled=True)
             rows = np.concatenate(
                 [np.arange(r * m, (r + 1) * m) for r in members])
@@ -698,13 +728,16 @@ def alltoall(tensor: Any, splits: Optional[Sequence[int]] = None, *,
     if _is_global(process_set) and effective_axis_size(axis) == 1:
         return tensor
     if not _is_global(process_set) and _ragged_set(process_set, axis):
-        # Ragged set: gather every member's full tensor, then each member
+        # Ragged set: gather the members' tensors (padded equal-size
+        # groups when a world-divisor >= set size exists — set-size wire
+        # cost, VERDICT r2 #8 — else the full axis), then each member
         # picks its own chunk from each member's contribution (shape is
         # preserved, so non-members just keep their input).
         members = sorted(process_set.ranks)
         k = len(members)
         member = _member_mask(process_set, axis)
         pos = _member_pos(process_set, axis)
+        pg = _padded_member_groups(process_set, axis)
 
         def ragged_leaf(x):
             if x.shape[0] % k != 0:
@@ -713,9 +746,16 @@ def alltoall(tensor: Any, splits: Optional[Sequence[int]] = None, *,
                     f"participant count ({k}); pass explicit splits for "
                     "uneven exchange")
             c = x.shape[0] // k
-            g = lax.all_gather(x, axis, axis=0, tiled=False)  # [world, ...]
+            if pg is not None:
+                g = lax.all_gather(x, axis, axis=0, tiled=False,
+                                   axis_index_groups=pg)  # [s, ...]
+                # group 0 leads with the members in member order
+                srcs = range(k)
+            else:
+                g = lax.all_gather(x, axis, axis=0, tiled=False)
+                srcs = members
             picks = [lax.dynamic_slice_in_dim(g[r], pos * c, c, axis=0)
-                     for r in members]
+                     for r in srcs]
             out = jnp.concatenate(picks, axis=0)
             return jnp.where(member, out, x)
 

@@ -466,3 +466,58 @@ def test_merge_chrome_traces_labels_and_stackframes(tmp_path):
     bad.write_text(json.dumps({"foo": 1}))
     with pytest.raises(ValueError, match="traceEvents"):
         merge_chrome_traces([bad], tmp_path / "out2.json")
+
+
+def test_allgather_padded_ragged_set_wire_cost():
+    """VERDICT r2 #8: a RAGGED set with a usable world-divisor (3-of-8:
+    complement 5 can't form groups of 3, but padding one complement rank
+    gives groups of 4) gathers group-size rows — half the world-size
+    wire bytes — and members still get exactly the members' rows."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from horovod_tpu.collectives import ops
+
+    ps = hvd.add_process_set([1, 4, 6])
+    x = np.arange(N * 2, dtype=np.float32).reshape(N * 2, 1)
+
+    f = shard_map(lambda t: ops.allgather(t, process_set=ps),
+                  mesh=hvd.mesh(), in_specs=P(hvd.RANK_AXIS),
+                  out_specs=P(hvd.RANK_AXIS), check_vma=False)
+    out = np.asarray(jax.jit(f)(jnp.asarray(x))).reshape(N, 6, 1)
+    for r in (1, 4, 6):  # members see [rows of 1, rows of 4, rows of 6]
+        np.testing.assert_array_equal(out[r].ravel(), [2, 3, 8, 9, 12, 13])
+
+    txt = jax.jit(f).lower(jnp.asarray(x)).as_text()
+    gathers = [l for l in txt.splitlines() if "all_gather" in l]
+    assert gathers, txt[:500]
+    # per-device 2 rows -> padded group of 4 gathers 8 rows; a full-axis
+    # gather would produce 16.
+    assert any("tensor<8x1xf32>" in l for l in gathers), gathers
+    assert not any("tensor<16x1xf32>" in l for l in gathers), gathers
+    hvd.remove_process_set(ps)
+
+
+def test_alltoall_padded_ragged_set():
+    """3-of-8 (ragged) alltoall rides the padded groups too: members
+    exchange chunks in member order, non-members — including the
+    complement rank drafted as group padding — keep their input."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from horovod_tpu.collectives import ops
+
+    ps = hvd.add_process_set([1, 4, 6])
+    x = np.zeros((N, 3), np.float32)
+    for r in range(N):
+        x[r] = r * 10 + np.arange(3)
+
+    f = shard_map(lambda t: ops.alltoall(t, process_set=ps),
+                  mesh=hvd.mesh(), in_specs=P(hvd.RANK_AXIS),
+                  out_specs=P(hvd.RANK_AXIS), check_vma=False)
+    out = np.asarray(jax.jit(f)(jnp.asarray(x.reshape(N * 3, 1)))
+                     ).reshape(N, 3)
+    np.testing.assert_array_equal(out[1], [10, 40, 60])  # chunk 0 of each
+    np.testing.assert_array_equal(out[4], [11, 41, 61])  # chunk 1
+    np.testing.assert_array_equal(out[6], [12, 42, 62])  # chunk 2
+    for r in (0, 2, 3, 5, 7):
+        np.testing.assert_array_equal(out[r], x[r])
+    hvd.remove_process_set(ps)
