@@ -140,7 +140,10 @@ def allgather(tensor: Any, *, process_set: Optional[ProcessSet] = None) -> Any:
     """Per-rank allgather: input leaves ``[size * k, ...]`` (k rows per
     rank). Global set: returns the rank-order concatenation (replicated).
     Process set: each rank gathers within its group, so the result is
-    stacked per-rank ``[size, group_k, ...]``."""
+    stacked per-rank ``[size, group_k, ...]``. Only MEMBER rows are
+    specified for a proper subset — ragged sets on the padded-group path
+    leave non-member rows with other groups' data (reference semantics:
+    non-participants never call the op; see ``ops.allgather``)."""
     n = _ctx.size()
     _check_stacked(tensor, n, exact=False)
     replicated = process_set is None or process_set.process_set_id == 0

@@ -210,6 +210,98 @@ def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:
     return broadcast_object(local, root_rank=owner)
 
 
+class FrameworkState(State):
+    """Shared machinery for the framework-binding states (torch / tf):
+    arbitrary scalar attributes, in-memory snapshots, disk-persisted
+    commits (``HOROVOD_ELASTIC_COMMIT_DIR``) with ``load_latest`` for
+    process-restart resume — so every framework state plugs into BOTH
+    elastic modes (in-process reset and restart; elastic/run_fn.py).
+
+    Subclasses own the framework half via three hooks:
+    ``_framework_snapshot() -> picklable``, ``_framework_restore(snap)``,
+    and ``_framework_broadcast()`` (make live state match rank 0).
+    ``_GUARDED`` lists the subclass-owned attribute names exempt from the
+    scalar-attr routing."""
+
+    _GUARDED: tuple = ()
+
+    def __init__(self, commit_dir: Optional[str] = None, **kwargs: Any):
+        self._scalars: Dict[str, Any] = dict(kwargs)
+        self._saved_scalars: Dict[str, Any] = dict(kwargs)
+        self._commit_dir = commit_dir or os.environ.get(C.COMMIT_DIR_ENV)
+        self._commit_seq = 0
+        self._saved_fw: Any = None
+        super().__init__()
+        # In-memory snapshot only: persisting here would clobber a
+        # previous generation's on-disk commit before load_latest().
+        self._saved_fw = self._framework_snapshot()
+
+    # -- scalar attribute routing (epoch=, batch=, ...) ----------------------
+
+    def __getattr__(self, name):
+        scalars = self.__dict__.get("_scalars", {})
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name in type(self)._GUARDED:
+            super().__setattr__(name, value)
+        elif "_scalars" in self.__dict__ and name in self._scalars:
+            self._scalars[name] = value
+        else:
+            super().__setattr__(name, value)
+
+    # -- framework hooks -----------------------------------------------------
+
+    def _framework_snapshot(self) -> Any:
+        raise NotImplementedError
+
+    def _framework_restore(self, snap: Any) -> None:
+        raise NotImplementedError
+
+    def _framework_broadcast(self) -> None:
+        raise NotImplementedError
+
+    def _broadcast_scalars(self, scalars: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- State contract ------------------------------------------------------
+
+    def save(self) -> None:
+        self._saved_fw = self._framework_snapshot()
+        self._saved_scalars = dict(self._scalars)
+        if self._commit_dir:
+            self._commit_seq += 1
+            _persist(self._commit_dir,
+                     {"seq": self._commit_seq, "fw": self._saved_fw,
+                      "scalars": self._saved_scalars})
+
+    def restore(self) -> None:
+        if self._saved_fw is not None:
+            self._framework_restore(self._saved_fw)
+        self._scalars = dict(self._saved_scalars)
+
+    def load_latest(self) -> bool:
+        """Adopt the newest persisted commit across the (re)launched
+        world; returns True if one was found."""
+        if not self._commit_dir:
+            return False
+        payload = load_persisted_world(self._commit_dir)
+        if payload is None:
+            return False
+        self._commit_seq = int(payload.get("seq", 0))
+        self._saved_fw = payload.get("fw")
+        self._saved_scalars = dict(payload.get("scalars", {}))
+        self.restore()
+        return True
+
+    def sync(self) -> None:
+        self._framework_broadcast()
+        self._scalars = self._broadcast_scalars(self._scalars)
+        self.save()
+
+
 class ObjectState(State):
     """State whose attrs are arbitrary picklable objects
     (reference: common/elastic.py ObjectState)."""

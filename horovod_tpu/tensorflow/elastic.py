@@ -1,0 +1,84 @@
+"""Elastic state for TF/Keras models.
+
+Reference parity: ``horovod/tensorflow/elastic.py`` (``TensorFlowState``
+/ ``TensorFlowKerasState``, SURVEY.md §2.5, §3.4): commit/restore of
+variable values (+ arbitrary scalar attributes) and ``sync()``
+broadcasting from the new rank 0 after a membership change. Built on
+:class:`horovod_tpu.elastic.state.FrameworkState`, so commits ALSO
+persist to ``HOROVOD_ELASTIC_COMMIT_DIR`` and ``load_latest()`` resumes
+a relaunched generation (the restart elastic mode). Plugs into the same
+``@hvd.elastic.run`` wrapper as the JAX/torch states; the exception
+protocol (``HorovodInternalError`` / ``HostsUpdatedInterrupt``) is
+shared.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..elastic.state import FrameworkState
+from . import functions as _fn
+
+
+class TensorFlowState(FrameworkState):
+    """Commit/restore/sync over a list of tf.Variables (+ scalars)."""
+
+    _GUARDED = ("variables",)
+
+    def __init__(self, variables=None, **kwargs: Any):
+        self.variables = list(variables) if variables is not None else []
+        super().__init__(**kwargs)
+
+    def _collect(self):
+        """Override point: the live variable list (re-evaluated at every
+        snapshot/sync so lazily-created variables are picked up)."""
+        return self.variables
+
+    def _framework_snapshot(self):
+        self.variables = list(self._collect())
+        return [np.asarray(v) for v in self.variables]
+
+    def _framework_restore(self, snap) -> None:
+        # Re-collect so variables built since the snapshot are aligned;
+        # ones newer than the snapshot keep their live values (zip stops
+        # at the shorter list) — same behavior as restoring a checkpoint
+        # into a partially-built optimizer.
+        self.variables = list(self._collect())
+        for v, saved in zip(self.variables, snap):
+            v.assign(saved)
+
+    def _framework_broadcast(self) -> None:
+        self.variables = list(self._collect())
+        _fn.broadcast_variables(self.variables, root_rank=0)
+
+    def _broadcast_scalars(self, scalars):
+        return _fn.broadcast_object(scalars, root_rank=0,
+                                    name="tf_state.scalars")
+
+
+class TensorFlowKerasState(TensorFlowState):
+    """Reference ``TensorFlowKerasState``: tracks a Keras model's (and
+    optionally its optimizer's) variables, RE-COLLECTED at every
+    snapshot/sync — Keras 3 creates optimizer slot variables (momentum,
+    velocity, ...) lazily at the first ``apply_gradients``, so a list
+    frozen at construction would silently skip them."""
+
+    _GUARDED = ("variables", "model", "optimizer")
+
+    def __init__(self, model, optimizer=None, **kwargs: Any):
+        self.model = model
+        self.optimizer = optimizer
+        super().__init__(self._collect_keras(model, optimizer), **kwargs)
+
+    @staticmethod
+    def _collect_keras(model, optimizer):
+        variables = list(model.trainable_variables) \
+            + list(model.non_trainable_variables)
+        if optimizer is not None and getattr(optimizer, "variables", None):
+            variables += list(optimizer.variables)
+        return variables
+
+    def _collect(self):
+        return self._collect_keras(self.model, self.optimizer)

@@ -186,18 +186,36 @@ def DistributedGradientTape(gradtape, compression=Compression.none,
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          compression=Compression.none, op=Average,
                          gradient_predivide_factor: float = 1.0,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False,
                          process_set=None, sparse_as_dense: bool = False):
     """Wrap a Keras optimizer so ``apply_gradients`` allreduces gradients
     first (reference ``horovod.tensorflow.keras.DistributedOptimizer``).
     Implemented as a dynamic subclass adopted via ``__class__`` so
     ``isinstance`` checks and LR schedules keep working (the torch
     wrapper's construction, adapted to Keras' non-reconstructible
-    optimizers)."""
+    optimizers).
+
+    ``backward_passes_per_step=k`` aggregates k local steps before one
+    allreduce+apply (the reference's gradient-aggregation helper): calls
+    1..k-1 accumulate, advance ``optimizer.iterations`` (so
+    iteration-keyed LR schedules track batches, as the reference's
+    helper does), and apply nothing; call k reduces the accumulated
+    gradients — summed by default, averaged with
+    ``average_aggregated_gradients=True`` (reference default and knob)
+    — and applies the result."""
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError(
             "gradient_predivide_factor not supported with op != Average")
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    if backward_passes_per_step > 1 and op == Adasum:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported with Adasum "
+            "(reference restriction)")
 
     base = optimizer.__class__
+    bpps = backward_passes_per_step
 
     class _Distributed(base):
 
@@ -205,10 +223,40 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             pairs = list(grads_and_vars)
             grads = [g for g, _ in pairs]
             hvars = [v for _, v in pairs]
-            if sparse_as_dense:
+            if sparse_as_dense or bpps > 1:
+                # local aggregation sums dense tensors; densify slices
                 grads = [tf.convert_to_tensor(g)
                          if isinstance(g, tf.IndexedSlices) else g
                          for g in grads]
+            if bpps > 1:
+                if not tf.executing_eagerly():
+                    # The Python-side accumulate/skip branch would be
+                    # baked into the first trace (silent no-training);
+                    # fail loudly instead of diverging.
+                    raise NotImplementedError(
+                        "backward_passes_per_step > 1 requires eager "
+                        "apply_gradients in this build (a compiled "
+                        "model.fit traces the skip branch); use Keras 3's "
+                        "native gradient_accumulation_steps for compiled "
+                        "training loops")
+                acc = getattr(self, "_hvd_agg", None)
+                if acc is None:
+                    acc = [None] * len(grads)
+                count = getattr(self, "_hvd_agg_count", 0) + 1
+                acc = [a if g is None else (g if a is None else a + g)
+                       for a, g in zip(acc, grads)]
+                if count < bpps:
+                    self._hvd_agg = acc
+                    self._hvd_agg_count = count
+                    # Iteration-keyed LR schedules must see every batch
+                    # (reference helper increments on skipped steps too).
+                    self.iterations.assign_add(1)
+                    return None  # not due: aggregate only
+                self._hvd_agg = None
+                self._hvd_agg_count = 0
+                if average_aggregated_gradients:
+                    acc = [None if a is None else a / bpps for a in acc]
+                grads = acc
             prefix = "opt_grad"
             if op == Average and gradient_predivide_factor != 1.0:
                 f = gradient_predivide_factor

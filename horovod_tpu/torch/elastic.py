@@ -1,74 +1,60 @@
 """Elastic state for torch models.
 
 Reference parity: ``horovod/torch/elastic/state.py`` (``TorchState``,
-SURVEY.md §2.5, §3.4): in-memory commit/restore of model + optimizer state
-dicts and arbitrary scalar attributes, and ``sync()`` broadcasting from the
-new rank 0 after a membership change. Plugs into the same
-``@hvd.elastic.run`` wrapper as the JAX-side state
-(horovod_tpu/elastic/run_fn.py) — the exception protocol
-(``HorovodInternalError`` / ``HostsUpdatedInterrupt``) is shared.
+SURVEY.md §2.5, §3.4): commit/restore of model + optimizer state dicts
+and arbitrary scalar attributes, and ``sync()`` broadcasting from the
+new rank 0 after a membership change. Built on
+:class:`horovod_tpu.elastic.state.FrameworkState`, so commits ALSO
+persist to ``HOROVOD_ELASTIC_COMMIT_DIR`` and ``load_latest()`` resumes
+a relaunched generation (the restart elastic mode) — strictly stronger
+than the reference's in-memory-only TorchState. Plugs into the same
+``@hvd.elastic.run`` wrapper as the JAX/TF states; the exception
+protocol (``HorovodInternalError`` / ``HostsUpdatedInterrupt``) is
+shared.
 """
 
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict
+from typing import Any
 
 import torch
 
-from ..elastic.state import State
+from ..elastic.state import FrameworkState
 from . import functions as _fn
 
 
-class TorchState(State):
+class TorchState(FrameworkState):
     """Commit/restore/sync over a torch model + optimizer (+ scalars)."""
+
+    _GUARDED = ("model", "optimizer")
 
     def __init__(self, model: torch.nn.Module = None,
                  optimizer: torch.optim.Optimizer = None, **kwargs: Any):
         self.model = model
         self.optimizer = optimizer
-        self._scalars: Dict[str, Any] = dict(kwargs)
-        self._saved_model = None
-        self._saved_opt = None
-        self._saved_scalars: Dict[str, Any] = dict(kwargs)
-        super().__init__()
-        self.save()
+        super().__init__(**kwargs)
 
-    def __getattr__(self, name):
-        scalars = self.__dict__.get("_scalars", {})
-        if name in scalars:
-            return scalars[name]
-        raise AttributeError(name)
+    def _framework_snapshot(self):
+        return {
+            "model": copy.deepcopy(self.model.state_dict())
+            if self.model is not None else None,
+            "optimizer": copy.deepcopy(self.optimizer.state_dict())
+            if self.optimizer is not None else None,
+        }
 
-    def __setattr__(self, name, value):
-        if name.startswith("_") or name in ("model", "optimizer"):
-            super().__setattr__(name, value)
-        elif "_scalars" in self.__dict__ and name in self._scalars:
-            self._scalars[name] = value
-        else:
-            super().__setattr__(name, value)
+    def _framework_restore(self, snap) -> None:
+        if snap.get("model") is not None and self.model is not None:
+            self.model.load_state_dict(snap["model"])
+        if snap.get("optimizer") is not None and self.optimizer is not None:
+            self.optimizer.load_state_dict(snap["optimizer"])
 
-    # -- State contract (base State.commit() = save + host-update check) -----
-
-    def save(self) -> None:
-        if self.model is not None:
-            self._saved_model = copy.deepcopy(self.model.state_dict())
-        if self.optimizer is not None:
-            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
-        self._saved_scalars = dict(self._scalars)
-
-    def restore(self) -> None:
-        if self._saved_model is not None:
-            self.model.load_state_dict(self._saved_model)
-        if self._saved_opt is not None:
-            self.optimizer.load_state_dict(self._saved_opt)
-        self._scalars = dict(self._saved_scalars)
-
-    def sync(self) -> None:
+    def _framework_broadcast(self) -> None:
         if self.model is not None:
             _fn.broadcast_parameters(self.model.state_dict(), root_rank=0)
         if self.optimizer is not None:
             _fn.broadcast_optimizer_state(self.optimizer, root_rank=0)
-        self._scalars = _fn.broadcast_object(self._scalars, root_rank=0,
-                                             name="torch_state.scalars")
-        self.save()
+
+    def _broadcast_scalars(self, scalars):
+        return _fn.broadcast_object(scalars, root_rank=0,
+                                    name="torch_state.scalars")

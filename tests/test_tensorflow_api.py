@@ -288,3 +288,139 @@ def test_gradient_tape_predivide_scales_sparse_like_dense():
     for dense, sp in run_parallel(n, fn):
         np.testing.assert_allclose(dense, [1.5])       # mean of 1, 2
         np.testing.assert_allclose(sp[0], [1.5, 1.5])  # sparse matches
+
+
+@pytest.mark.parametrize("average", [False, True])
+def test_keras_optimizer_backward_passes_per_step(average):
+    """backward_passes_per_step=2: calls 1..k-1 aggregate locally (still
+    advancing optimizer.iterations, so iteration-keyed LR schedules track
+    batches) and apply nothing; call k applies the rank-averaged SUM of
+    the accumulated gradients by default — the reference's
+    average_aggregated_gradients=False default — or the mean with the
+    flag set."""
+    import keras
+    n = 2
+
+    def fn(r):
+        m = _make_keras_model()
+        opt = hvd.DistributedOptimizer(
+            keras.optimizers.SGD(0.1), backward_passes_per_step=2,
+            average_aggregated_gradients=average)
+        for i in range(2):
+            x = tf.constant(np.full((2, 2), float(r + i + 1), np.float32))
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(m(x))
+            grads = tape.gradient(loss, m.trainable_variables)
+            opt.apply_gradients(zip(grads, m.trainable_variables))
+            if i == 0:  # nothing applied yet, but iterations advanced
+                np.testing.assert_allclose(m.get_weights()[0],
+                                           [[1.0], [2.0]])
+                assert int(opt.iterations) == 1
+        return m.get_weights()[0], int(opt.iterations)
+
+    outs = run_parallel(n, fn)
+    np.testing.assert_allclose(outs[0][0], outs[1][0])
+    assert outs[0][1] == 2
+    # grads per call: 2*(r+i+1) per weight-row. Local SUM over i then
+    # rank mean: r=0: 6, r=1: 10 -> 8 -> w -= 0.8; averaged: half that.
+    expect = [[0.6], [1.6]] if average else [[0.2], [1.2]]
+    np.testing.assert_allclose(outs[0][0], expect, atol=1e-6)
+
+
+def test_tensorflow_elastic_state_roundtrip():
+    """TensorFlowKerasState commit/restore/sync — the reference's
+    horovod.tensorflow.elastic state contract over the shared engine."""
+    import keras
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+    n = 2
+
+    def fn(r):
+        m = _make_keras_model()
+        m.set_weights([np.full((2, 1), float(r), np.float32)])
+        state = TensorFlowKerasState(m, batch=10 * r, epoch=r)
+        state.sync()  # rank 0's weights + scalars win
+        synced = m.get_weights()[0].copy()
+        batch_after_sync = state.batch
+        # mutate, then restore to the committed snapshot
+        m.set_weights([np.full((2, 1), 99.0, np.float32)])
+        state.batch = 77
+        state.restore()
+        return (synced, batch_after_sync, m.get_weights()[0], state.batch)
+
+    for synced, batch, restored, batch2 in run_parallel(n, fn):
+        np.testing.assert_allclose(synced, 0.0)   # root 0's value
+        assert batch == 0
+        np.testing.assert_allclose(restored, 0.0)
+        assert batch2 == 0
+
+
+def test_tensorflow_state_persists_and_resumes(tmp_path, monkeypatch):
+    """FrameworkState persistence: commits land in
+    HOROVOD_ELASTIC_COMMIT_DIR and a FRESH state (new process after a
+    relaunch) adopts them via load_latest — the restart elastic mode."""
+    from horovod_tpu.tensorflow.elastic import TensorFlowState
+    hvd.shutdown()
+    hvd.init()
+    v = tf.Variable([1.0, 2.0])
+    state = TensorFlowState([v], commit_dir=str(tmp_path), step=0)
+    v.assign([5.0, 6.0])
+    state.step = 9
+    state.commit()
+
+    v.assign([0.0, 0.0])
+    fresh = TensorFlowState([v], commit_dir=str(tmp_path), step=0)
+    assert fresh.load_latest()
+    np.testing.assert_allclose(np.asarray(v), [5.0, 6.0])
+    assert fresh.step == 9
+    hvd.shutdown()
+
+
+def test_keras_state_picks_up_lazy_optimizer_slots():
+    """Keras 3 creates momentum slots at the first apply_gradients: the
+    state must re-collect variables at snapshot time, or restored ranks
+    keep divergent momentum buffers."""
+    import keras
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+    hvd.shutdown()
+    hvd.init()
+    m = _make_keras_model()
+    opt = keras.optimizers.SGD(0.1, momentum=0.9)
+    state = TensorFlowKerasState(m, optimizer=opt, epoch=0)
+    n_before = len(state.variables)
+
+    x = tf.constant(np.ones((2, 2), np.float32))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(m(x))
+    opt.apply_gradients(zip(tape.gradient(loss, m.trainable_variables),
+                            m.trainable_variables))
+    state.commit()  # must now include the momentum slot
+    assert len(state.variables) > n_before
+    mom = [v for v in opt.variables if "momentum" in v.path.lower()
+           or "velocity" in v.path.lower()]
+    if not mom:  # keras names vary; fall back to any new optimizer var
+        mom = list(opt.variables)[-1:]
+    snap_val = np.asarray(mom[0]).copy()
+    mom[0].assign(np.full_like(snap_val, 123.0))
+    state.restore()
+    np.testing.assert_allclose(np.asarray(mom[0]), snap_val)
+    hvd.shutdown()
+
+
+def test_keras_bpps_rejects_compiled_apply():
+    import keras
+    hvd.shutdown()
+    hvd.init()
+    m = _make_keras_model()
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.1),
+                                   backward_passes_per_step=2)
+
+    @tf.function
+    def step(x):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(m(x))
+        grads = tape.gradient(loss, m.trainable_variables)
+        opt.apply_gradients(zip(grads, m.trainable_variables))
+
+    with pytest.raises(Exception, match="backward_passes_per_step"):
+        step(tf.constant(np.ones((2, 2), np.float32)))
+    hvd.shutdown()
