@@ -24,6 +24,18 @@ from .data_store import StoreDataset, materialize_to_store  # noqa: F401
 from .estimator import JaxEstimator, JaxModel  # noqa: F401
 from .torch_estimator import TorchEstimator, TorchModel  # noqa: F401
 
+
+def __getattr__(name):
+    # Lazy: importing keras costs seconds and most spark users never touch
+    # the Keras estimator.
+    if name in ("KerasEstimator", "KerasModel"):
+        from . import keras_estimator as _ke
+        return getattr(_ke, name)
+    raise AttributeError(name)
+
+# KerasEstimator/KerasModel resolve lazily via __getattr__ and are
+# deliberately NOT in __all__: star-import must not pay the keras import
+# (or fail where keras is absent).
 __all__ = ["run", "JaxEstimator", "JaxModel", "TorchEstimator",
            "StoreDataset", "materialize_to_store",
            "TorchModel"]

@@ -1,0 +1,204 @@
+"""``KerasEstimator`` / ``KerasModel`` — the reference's flagship Spark
+estimator pair, now buildable since keras ships in this image.
+
+Reference parity: ``horovod/spark/keras/estimator.py`` (SURVEY.md §2.5):
+fit a Keras model from DataFrame-shaped data (or a materialised
+:class:`~horovod_tpu.spark.data_store.StoreDataset` — the Petastorm
+streaming role), with the optimizer wrapped in
+``horovod_tpu.tensorflow.keras.DistributedOptimizer`` so gradients
+allreduce across the engine world; the fitted Transformer predicts and
+``transform``\\ s DataFrames, and round-trips through the Store
+(HDFS/S3-style remote stores stage through the data path's cache).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..checkpoint.store import Store
+from ..core.logging import get_logger
+from .estimator import _materialize, _transform_df, _validation_split
+
+_MODEL_BLOB = "model.keras"
+
+
+class KerasModel:
+    """The fitted Transformer (reference: ``horovod.spark.keras``'s
+    KerasModel): predicts on numpy, ``transform``\\ s DataFrames, and
+    saves/loads whole-model ``.keras`` archives through the Store."""
+
+    def __init__(self, model, feature_col: str = "features",
+                 output_col: str = "prediction"):
+        self.model = model
+        self.feature_col = feature_col
+        self.output_col = output_col
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        out = self.model.predict(np.asarray(features), verbose=0)
+        return np.asarray(out).squeeze(-1) if out.ndim > 1 \
+            and out.shape[-1] == 1 else np.asarray(out)
+
+    def transform(self, df):
+        """Spark/pandas DataFrame → same DataFrame + prediction column."""
+        return _transform_df(self, df)
+
+    # -- store round trip ---------------------------------------------------
+
+    def save(self, store: Store, run_id: str) -> str:
+        import tempfile
+        path = os.path.join(store.checkpoint_path(run_id), _MODEL_BLOB)
+        # keras 3 saves archives to a path; stage through a temp file so
+        # remote stores receive bytes via store.write.
+        with tempfile.TemporaryDirectory() as td:
+            local = os.path.join(td, _MODEL_BLOB)
+            self.model.save(local)
+            with open(local, "rb") as f:
+                store.write(path, f.read())
+        return path
+
+    @classmethod
+    def load(cls, store: Store, run_id: str, *,
+             feature_col: str = "features",
+             output_col: str = "prediction") -> "KerasModel":
+        import tempfile
+        import keras
+        path = os.path.join(store.checkpoint_path(run_id), _MODEL_BLOB)
+        with tempfile.TemporaryDirectory() as td:
+            local = os.path.join(td, _MODEL_BLOB)
+            with open(local, "wb") as f:
+                f.write(store.read(path))
+            # compile=False: the archive references the run's dynamic
+            # DistributedOptimizer subclass, which isn't importable in a
+            # fresh process — and the fitted Transformer only infers
+            # (reference KerasModel does the same custom-object dance).
+            model = keras.models.load_model(local, compile=False)
+        return cls(model, feature_col=feature_col, output_col=output_col)
+
+
+class KerasEstimator:
+    """Train a Keras model from DataFrame-shaped data over the engine
+    world (reference ``horovod.spark.keras.KerasEstimator`` essentials:
+    ``model``, ``optimizer``, ``loss``, ``batch_size``, ``epochs``,
+    feature/label columns, ``store``+``run_id``, validation fraction)."""
+
+    def __init__(self, model=None, optimizer=None, loss=None,
+                 feature_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, epochs: int = 1,
+                 validation: Optional[float] = None,
+                 store: Optional[Store] = None, run_id: str = "run",
+                 shuffle: bool = True, seed: int = 0,
+                 output_col: str = "prediction", verbose: int = 0):
+        if model is None or optimizer is None or loss is None:
+            raise ValueError("model, optimizer and loss are required")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_col = feature_col
+        self.label_col = label_col
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.validation = validation
+        self.store = store
+        self.run_id = run_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.output_col = output_col
+        self.verbose = verbose
+        self.history: list = []
+
+    def _compile(self):
+        import horovod_tpu.tensorflow as hvd
+        if not hvd.is_initialized():
+            hvd.init()
+        dist_opt = hvd.DistributedOptimizer(self.optimizer)
+        self.model.compile(optimizer=dist_opt, loss=self.loss)
+        return hvd
+
+    def fit(self, data) -> KerasModel:
+        from .data_store import StoreDataset
+        if isinstance(data, StoreDataset):
+            return self._fit_store(data)
+        hvd = self._compile()
+        feats, labels = _materialize(data, self.feature_col, self.label_col)
+        rng = np.random.RandomState(self.seed)
+        feats, labels, val = _validation_split(feats, labels,
+                                               self.validation, rng)
+        if len(feats) < self.batch_size:
+            raise ValueError(
+                f"need at least one global batch ({self.batch_size}) of "
+                f"rows, got {len(feats)}")
+        kw = {}
+        if val is not None:
+            kw["validation_data"] = val
+        from ..tensorflow.keras import BroadcastGlobalVariablesCallback
+        hist = self.model.fit(
+            feats, labels, batch_size=self.batch_size, epochs=self.epochs,
+            shuffle=self.shuffle, verbose=self.verbose,
+            callbacks=[BroadcastGlobalVariablesCallback(0)], **kw)
+        self.history = [
+            {"epoch": e, **{k: float(v[e]) for k, v in
+                            hist.history.items()}}
+            for e in range(len(hist.history.get("loss", [])))]
+        get_logger().info("KerasEstimator fit: %s",
+                          self.history[-1] if self.history else "{}")
+        return self._finish()
+
+    def _fit_store(self, ds) -> KerasModel:
+        """Streaming fit from a StoreDataset (the Petastorm reader-loop
+        role): each rank streams ITS shard of part files (rank-sharded,
+        the torch estimator's pattern) and runs one ``train_on_batch``
+        per streamed local batch; gradients allreduce across ranks, and
+        every rank takes the same paired step count."""
+        if self.validation:
+            raise ValueError(
+                "validation split is not supported with a StoreDataset; "
+                "materialise a separate validation run_id")
+        hvd = self._compile()
+        from ..tensorflow.functions import broadcast_variables
+        n = hvd.size()
+        if self.batch_size % n:
+            raise ValueError(
+                f"batch_size {self.batch_size} (global) must be divisible "
+                f"by the world size {n}")
+        local_batch = self.batch_size // n
+        steps = ds.min_steps(local_batch, n)
+        if steps < 1:
+            raise ValueError(
+                f"need at least one local batch ({local_batch}) per rank, "
+                f"got shard rows "
+                f"{[ds.shard_rows(r, n) for r in range(n)]}")
+        self.model.build((None,) + ds.feature_shape)
+        broadcast_variables(self.model.trainable_variables
+                            + self.model.non_trainable_variables, 0)
+        log = get_logger()
+        for epoch in range(self.epochs):
+            losses = []
+            it = ds.batches(local_batch, shuffle=self.shuffle,
+                            seed=self.seed + epoch, rank=hvd.rank(),
+                            num_replicas=n)
+            try:
+                for feats, labels in itertools.islice(it, steps):
+                    losses.append(float(
+                        self.model.train_on_batch(feats, labels)))
+            finally:
+                it.close()  # release prefetch threads on a failed step
+            entry = {"epoch": epoch,
+                     "loss": float(np.mean(losses)) if losses else None}
+            self.history.append(entry)
+            log.info("KerasEstimator epoch %d (store-streamed): %s",
+                     epoch, entry)
+        return self._finish()
+
+    def _finish(self) -> KerasModel:
+        import horovod_tpu.tensorflow as hvd
+        fitted = KerasModel(self.model, feature_col=self.feature_col,
+                            output_col=self.output_col)
+        if self.store is not None and hvd.rank() == 0:
+            # rank-0 gate: concurrent ranks would race on the single
+            # store path (torch_estimator.py documents the same)
+            fitted.save(self.store, self.run_id)
+        return fitted

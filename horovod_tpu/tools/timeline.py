@@ -52,13 +52,15 @@ class Timeline:
     def _us(self) -> int:
         return int((time.time() - self._start) * 1e6)
 
-    def activity_start(self, name: str, activity: str, rank: int = 0) -> None:
+    def activity_start(self, name: str, activity: str, rank: int = 0,
+                       tid: int = 0) -> None:
         self._q.put({"name": activity, "cat": name, "ph": "B",
-                     "ts": self._us(), "pid": rank, "tid": 0})
+                     "ts": self._us(), "pid": rank, "tid": tid})
 
-    def activity_end(self, name: str, activity: str, rank: int = 0) -> None:
+    def activity_end(self, name: str, activity: str, rank: int = 0,
+                     tid: int = 0) -> None:
         self._q.put({"name": activity, "cat": name, "ph": "E",
-                     "ts": self._us(), "pid": rank, "tid": 0})
+                     "ts": self._us(), "pid": rank, "tid": tid})
 
     def marker(self, name: str, rank: int = 0) -> None:
         self._q.put({"name": name, "ph": "i", "ts": self._us(),

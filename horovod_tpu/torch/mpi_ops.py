@@ -83,9 +83,26 @@ class _TorchRuntime:
             self._inflight.add(key)
 
         def run():
+            # Per-op timeline span (reference timeline.cc: each collective
+            # gets NEGOTIATE/EXEC activities; here the host-side engine op
+            # is one span, device phases live in the xplane trace).
+            tl = None
+            from ..core import context_api as _ctx
+            if _ctx.is_initialized():
+                tl = _ctx.context().timeline
+            # tid = worker-thread id: concurrent ops on the async pool
+            # must not share a Chrome-trace track, or B/E pairs mis-nest
+            # and spans get attributed to the wrong op.
+            tid = threading.get_ident() & 0x7FFFFFFF
+            if tl is not None:
+                tl.activity_start(name, kind.upper(),
+                                  rank=self.engine.rank(), tid=tid)
             try:
                 return fn(name)
             finally:
+                if tl is not None:
+                    tl.activity_end(name, kind.upper(),
+                                    rank=self.engine.rank(), tid=tid)
                 with self.hlock:
                     self._inflight.discard(key)
         return self.alloc(self.executor().submit(run))

@@ -430,3 +430,44 @@ def test_remote_store_restage_on_rematerialize():
     ds2 = materialize_to_store((X2, y), store, "same", rows_per_part=64)
     b2 = next(iter(ds2.batches(64, shuffle=False)))[0]
     np.testing.assert_allclose(b2, X2), "stale staged part served"
+
+
+def test_keras_estimator_trains_and_roundtrips(tmp_path):
+    """KerasEstimator (reference horovod.spark.keras, now buildable since
+    keras ships): fit from arrays with the wrapped optimizer, save the
+    .keras archive through the store, reload, predict."""
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator, KerasModel
+    from horovod_tpu.checkpoint.store import LocalStore
+
+    X, y = _toy_data(256)
+    store = LocalStore(str(tmp_path))
+    model = keras.Sequential([keras.layers.Dense(8, activation="relu"),
+                              keras.layers.Dense(1)])
+    est = KerasEstimator(model=model, optimizer=keras.optimizers.Adam(0.05),
+                         loss="mse", batch_size=64, epochs=8,
+                         store=store, run_id="keras")
+    fitted = est.fit((X, y))
+    assert est.history[-1]["loss"] < est.history[0]["loss"] * 0.7
+    preds = fitted.predict(X[:8])
+    assert preds.shape == (8,)
+    loaded = KerasModel.load(store, "keras")
+    np.testing.assert_allclose(loaded.predict(X[:8]), preds, rtol=1e-5)
+
+
+def test_keras_estimator_streams_from_store(tmp_path):
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator, materialize_to_store
+    from horovod_tpu.checkpoint.store import LocalStore
+
+    X, y = _toy_data(256)
+    store = LocalStore(str(tmp_path))
+    ds = materialize_to_store((X, y), store, "kstream", rows_per_part=64)
+    model = keras.Sequential([keras.layers.Dense(8, activation="relu"),
+                              keras.layers.Dense(1)])
+    est = KerasEstimator(model=model, optimizer=keras.optimizers.Adam(0.05),
+                         loss="mse", batch_size=64, epochs=10,
+                         store=store, run_id="kstream")
+    fitted = est.fit(ds)
+    assert est.history[-1]["loss"] < est.history[0]["loss"] * 0.7
+    assert fitted.predict(X[:4]).shape == (4,)
