@@ -24,6 +24,8 @@ from .functions import (allgather_object, broadcast_object,  # noqa: F401
                         broadcast_variables)
 from .gradient_tape import (DistributedGradientTape,  # noqa: F401
                             DistributedOptimizer)
+from .sync_batch_norm import (SyncBatchNorm,  # noqa: F401
+                              SyncBatchNormalization)
 from .mpi_ops import (ProcessSet, add_process_set, allgather,  # noqa: F401
                       allreduce, alltoall, barrier, broadcast, broadcast_,
                       cross_rank, cross_size, global_process_set,

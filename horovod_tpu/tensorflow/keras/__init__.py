@@ -14,6 +14,8 @@ from .. import (init, is_initialized, rank, size, local_rank,  # noqa: F401
                 local_size, shutdown, allreduce, allgather, broadcast,
                 broadcast_variables, allgather_object, broadcast_object)
 from ..gradient_tape import DistributedOptimizer  # noqa: F401
+from ..sync_batch_norm import (SyncBatchNorm,  # noqa: F401
+                               SyncBatchNormalization)
 from .callbacks import (BroadcastGlobalVariablesCallback,  # noqa: F401
                         LearningRateScheduleCallback,
                         LearningRateWarmupCallback,

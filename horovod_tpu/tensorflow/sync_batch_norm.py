@@ -1,0 +1,74 @@
+"""Cross-rank SyncBatchNorm for the tensorflow/keras API.
+
+Reference parity: ``horovod/tensorflow/sync_batch_norm.py`` (SURVEY.md
+§2.4, §2.6): batch statistics combine across ranks — one packed
+allreduce of (count, sum, sq-sum) so uneven batches weight correctly —
+with running stats updated from the global moments. Single-rank or
+inference behaves exactly like ``keras.layers.BatchNormalization``.
+"""
+
+from __future__ import annotations
+
+import keras
+import numpy as np
+import tensorflow as tf
+
+from . import mpi_ops as _ops
+from ..core.engine import Sum
+
+
+class SyncBatchNormalization(keras.layers.BatchNormalization):
+    """Drop-in ``BatchNormalization`` whose training statistics span all
+    ranks (channels-last; the reference layer's contract)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.axis not in (-1,):
+            raise ValueError(
+                "SyncBatchNormalization supports channels-last (axis=-1) "
+                f"only in this build; got axis={self.axis}")
+        try:
+            self._hvd_name = _ops._rt().autoname("sync_batch_norm", None)
+        except RuntimeError:
+            self._hvd_name = "sync_batch_norm.uninit"
+
+    def call(self, inputs, training=None):
+        # keras contract: a frozen layer (trainable=False) uses moving
+        # stats and must not mutate them, even under training=True.
+        if not training or not self.trainable or _ops.size() == 1:
+            return super().call(inputs, training=training)
+
+        x = tf.convert_to_tensor(inputs)
+        ndim = x.shape.rank
+        axes = list(range(ndim - 1))  # reduce all but channels-last
+        c = x.shape[-1]
+        count = tf.cast(tf.size(x) / c, x.dtype)[None]
+        local_sum = tf.reduce_sum(x, axis=axes)
+        local_sqsum = tf.reduce_sum(tf.square(x), axis=axes)
+
+        packed = tf.concat([count, local_sum, local_sqsum], 0)
+        packed = _ops.allreduce(packed, op=Sum, name=self._hvd_name)
+        total = packed[0]
+        mean = packed[1:1 + c] / total
+        sqmean = packed[1 + c:] / total
+        var = sqmean - tf.square(mean)
+
+        if self.moving_mean is not None:
+            m = self.momentum
+            # Bessel correction for the running var (guarded at n == 1),
+            # the BatchNorm running-stat convention — tensor ops so the
+            # eager and tf.function paths compute identically.
+            unbiased = tf.where(total > 1.0, var * total / (total - 1.0),
+                                var)
+            self.moving_mean.assign(self.moving_mean * m + mean * (1 - m))
+            self.moving_variance.assign(
+                self.moving_variance * m + unbiased * (1 - m))
+
+        gamma = self.gamma if self.scale else tf.ones_like(mean)
+        beta = self.beta if self.center else tf.zeros_like(mean)
+        return tf.nn.batch_normalization(x, mean, var, beta, gamma,
+                                         self.epsilon)
+
+
+#: Reference alias: ``hvd.SyncBatchNorm`` names the same layer.
+SyncBatchNorm = SyncBatchNormalization

@@ -424,3 +424,28 @@ def test_keras_bpps_rejects_compiled_apply():
     with pytest.raises(Exception, match="backward_passes_per_step"):
         step(tf.constant(np.ones((2, 2), np.float32)))
     hvd.shutdown()
+
+
+def test_sync_batch_norm_spans_ranks():
+    """SyncBatchNormalization: training statistics combine across ranks
+    (count-weighted), so normalized outputs use the GLOBAL batch mean."""
+    n = 2
+
+    def fn(r):
+        bn = hvd.SyncBatchNormalization(momentum=0.5)
+        # rank 0 contributes zeros, rank 1 fours: global mean 2, var 4
+        x = tf.constant(np.full((2, 3), 4.0 * r, np.float32))
+        bn.build((None, 3))
+        out = bn(x, training=True)
+        return (np.asarray(out), np.asarray(bn.moving_mean),
+                np.asarray(bn.moving_variance))
+
+    outs = run_parallel(n, fn)
+    for out, mm, mv in outs:
+        np.testing.assert_allclose(mm, np.full(3, 1.0), rtol=1e-5)
+        # unbiased var: 4 * (4/3); moving = 1*0.5 + unbiased*0.5
+        np.testing.assert_allclose(mv, np.full(3, 0.5 + 0.5 * 16 / 3),
+                                   rtol=1e-5)
+    # outputs: (x - 2) / sqrt(4 + eps) -> rank0 ~ -1, rank1 ~ +1
+    np.testing.assert_allclose(outs[0][0], np.full((2, 3), -1.0), atol=1e-2)
+    np.testing.assert_allclose(outs[1][0], np.full((2, 3), 1.0), atol=1e-2)
