@@ -91,6 +91,20 @@ def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:
     return out.astype(arrays[0].dtype, copy=False)
 
 
+def next_autoname(counters: dict, rank: int, kind: str,
+                  name=None) -> str:
+    """Shared per-rank auto-naming for the framework runtimes (torch/tf):
+    every rank, creating its ops/layers in the same program order, must
+    derive the SAME collective key. Caller holds its own lock; mutates
+    ``counters`` ({rank: {kind: next_index}})."""
+    if name is not None:
+        return name
+    c = counters.setdefault(rank, {})
+    i = c.get(kind, 0)
+    c[kind] = i + 1
+    return f"{kind}.noname.{i}"
+
+
 def default_engine() -> "CollectiveEngine":
     """Transport selection shared by every framework binding (reference
     §2.2 op-manager priority): JaxProcessEngine on multi-host pods,

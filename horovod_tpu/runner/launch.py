@@ -110,8 +110,21 @@ def check_build(file=sys.stdout) -> None:
     import horovod_tpu as hvd
     elastic = "X" if importlib.util.find_spec(
         "horovod_tpu.elastic") is not None else " "
+
+    def has(mod):
+        try:
+            return "X" if importlib.util.find_spec(mod) is not None else " "
+        except (ImportError, ModuleNotFoundError, ValueError):
+            return " "
     print("horovod_tpu v" + hvd.__version__, file=file)
     print(f"""
+Available frameworks:
+    [X] JAX (the TPU compute path — in-graph collectives)
+    [{has('torch')}] PyTorch (horovod_tpu.torch, host tensors)
+    [{'X' if has('tensorflow') == 'X' else ' '}] TensorFlow (horovod_tpu.tensorflow, host tensors)
+    [{'X' if has('tensorflow') == 'X' and has('keras') == 'X' else ' '}] Keras (horovod_tpu.tensorflow.keras)
+    [ ] MXNet (EOL upstream)
+
 Available backends:
     [X] XLA (TPU/CPU collectives over ICI/DCN)
     [ ] NCCL (n/a on TPU; see SURVEY.md §2.7)

@@ -46,18 +46,10 @@ class _TfRuntime:
         self._clock = threading.Lock()
 
     def autoname(self, kind: str, name: Optional[str]) -> str:
-        # PER-RANK counters (the torch runtime's construction): every
-        # rank, creating its ops/layers in the same program order, must
-        # derive the SAME collective key — a shared counter would hand
-        # thread-simulated ranks different ids for the same op.
-        if name is not None:
-            return name
-        r = self.engine.rank()
+        from ..core.engine import next_autoname
         with self._clock:
-            c = self._counters.setdefault(r, {})
-            i = c.get(kind, 0)
-            c[kind] = i + 1
-        return f"{kind}.noname.{i}"
+            return next_autoname(self._counters, self.engine.rank(),
+                                 kind, name)
 
 
 def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:

@@ -10,7 +10,6 @@ inference behaves exactly like ``keras.layers.BatchNormalization``.
 from __future__ import annotations
 
 import keras
-import numpy as np
 import tensorflow as tf
 
 from . import mpi_ops as _ops
@@ -23,10 +22,6 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if self.axis not in (-1,):
-            raise ValueError(
-                "SyncBatchNormalization supports channels-last (axis=-1) "
-                f"only in this build; got axis={self.axis}")
         try:
             self._hvd_name = _ops._rt().autoname("sync_batch_norm", None)
         except RuntimeError:
@@ -40,11 +35,19 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
 
         x = tf.convert_to_tensor(inputs)
         ndim = x.shape.rank
+        ax = self.axis if self.axis >= 0 else ndim + self.axis
+        if ax != ndim - 1:
+            raise ValueError(
+                "SyncBatchNormalization supports channels-last only in "
+                f"this build; got axis={self.axis} for rank-{ndim} input")
         axes = list(range(ndim - 1))  # reduce all but channels-last
         c = x.shape[-1]
-        count = tf.cast(tf.size(x) / c, x.dtype)[None]
-        local_sum = tf.reduce_sum(x, axis=axes)
-        local_sqsum = tf.reduce_sum(tf.square(x), axis=axes)
+        # Statistics accumulate in float32 regardless of input dtype:
+        # fp16 counts/sq-sums overflow at image-sized batches.
+        xs = tf.cast(x, tf.float32)
+        count = tf.cast(tf.size(x) / c, tf.float32)[None]
+        local_sum = tf.reduce_sum(xs, axis=axes)
+        local_sqsum = tf.reduce_sum(tf.square(xs), axis=axes)
 
         packed = tf.concat([count, local_sum, local_sqsum], 0)
         packed = _ops.allreduce(packed, op=Sum, name=self._hvd_name)
@@ -60,14 +63,20 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
             # eager and tf.function paths compute identically.
             unbiased = tf.where(total > 1.0, var * total / (total - 1.0),
                                 var)
-            self.moving_mean.assign(self.moving_mean * m + mean * (1 - m))
+            self.moving_mean.assign(
+                self.moving_mean * m
+                + tf.cast(mean, self.moving_mean.dtype) * (1 - m))
             self.moving_variance.assign(
-                self.moving_variance * m + unbiased * (1 - m))
+                self.moving_variance * m
+                + tf.cast(unbiased, self.moving_variance.dtype) * (1 - m))
 
-        gamma = self.gamma if self.scale else tf.ones_like(mean)
-        beta = self.beta if self.center else tf.zeros_like(mean)
-        return tf.nn.batch_normalization(x, mean, var, beta, gamma,
-                                         self.epsilon)
+        gamma = tf.cast(self.gamma, tf.float32) if self.scale \
+            else tf.ones_like(mean)
+        beta = tf.cast(self.beta, tf.float32) if self.center \
+            else tf.zeros_like(mean)
+        out = tf.nn.batch_normalization(xs, mean, var, beta, gamma,
+                                        self.epsilon)
+        return tf.cast(out, x.dtype)
 
 
 #: Reference alias: ``hvd.SyncBatchNorm`` names the same layer.

@@ -115,14 +115,10 @@ class _TorchRuntime:
             return h
 
     def autoname(self, kind: str, name: Optional[str]) -> str:
-        if name is not None:
-            return name
-        r = self.engine.rank()
+        from ..core.engine import next_autoname
         with self.hlock:
-            c = self._name_counters.setdefault(r, {})
-            i = c.get(kind, 0)
-            c[kind] = i + 1
-        return f"{kind}.noname.{i}"
+            return next_autoname(self._name_counters, self.engine.rank(),
+                                 kind, name)
 
     def shutdown(self):
         for ex in self._executors.values():

@@ -449,3 +449,33 @@ def test_sync_batch_norm_spans_ranks():
     # outputs: (x - 2) / sqrt(4 + eps) -> rank0 ~ -1, rank1 ~ +1
     np.testing.assert_allclose(outs[0][0], np.full((2, 3), -1.0), atol=1e-2)
     np.testing.assert_allclose(outs[1][0], np.full((2, 3), 1.0), atol=1e-2)
+
+
+def test_sync_batch_norm_fp16_stats_do_not_overflow():
+    """Statistics accumulate in float32: fp16 counts/sq-sums overflow at
+    image-sized batches (regression guard)."""
+    # 70k rows: an fp16 count/sq-sum would overflow (65504 max)
+    x = tf.constant(np.random.RandomState(0).randn(70000, 4)
+                    .astype(np.float16))
+
+    def fn(r):
+        layer = hvd.SyncBatchNormalization(momentum=0.5, dtype="float16")
+        layer.build((None, 4))
+        out = layer(x, training=True)
+        return np.asarray(layer.moving_mean), np.asarray(out)
+
+    outs = run_parallel(2, fn)
+    for mm, out in outs:
+        assert np.all(np.isfinite(mm)), mm
+        assert np.all(np.isfinite(out))
+
+
+def test_sync_batch_norm_rejects_non_channels_last_when_syncing():
+    def fn(r):
+        bn = hvd.SyncBatchNormalization(axis=1)
+        bn.build((None, 3, 8))
+        with pytest.raises(ValueError, match="channels-last"):
+            bn(tf.constant(np.zeros((2, 3, 8), np.float32)), training=True)
+        return True
+
+    assert all(run_parallel(2, fn))
