@@ -479,3 +479,25 @@ def test_sync_batch_norm_rejects_non_channels_last_when_syncing():
         return True
 
     assert all(run_parallel(2, fn))
+
+
+def test_join_and_barrier():
+    """hvd.join over the TF surface: uneven step counts — early-finishing
+    ranks join and answer the stragglers' collectives with zeros; barrier
+    synchronizes (reference join/barrier contract)."""
+    n = 2
+
+    def fn(r):
+        hvd.barrier()
+        outs = []
+        steps = 1 + r  # rank 1 takes one extra step
+        for i in range(steps):
+            outs.append(hvd.allreduce(tf.constant([float(r + 1)]),
+                                      op=hvd.Sum, name="j").numpy())
+        last = hvd.join()
+        return outs, last
+
+    res = run_parallel(n, fn)
+    np.testing.assert_allclose(res[0][0][0], [3.0])  # both active: 1+2
+    np.testing.assert_allclose(res[1][0][1], [2.0])  # rank 0 joined: 2+0
+    assert res[0][1] == res[1][1] == 1  # last joiner is rank 1
