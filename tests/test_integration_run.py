@@ -359,9 +359,30 @@ with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
     w = tf.Variable([2.0])
     loss = tf.reduce_sum(w * (hvd.rank() + 1.0))
 g = tape.gradient(loss, [w])[0]
+
+# Keras model.fit ACROSS the two processes: compiled train_step traces
+# apply_gradients -> fused bucket allreduce through the py_function
+# boundary on the production engine; ranks must converge identically.
+import keras
+from horovod_tpu.tensorflow.keras import BroadcastGlobalVariablesCallback
+m = keras.Sequential([keras.layers.Dense(1, use_bias=False)])
+m.build((None, 2))
+m.set_weights([np.full((2, 1), float(hvd.rank() + 1), np.float32)])
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05))
+m.compile(optimizer=opt, loss="mse")
+rngk = np.random.RandomState(hvd.rank())
+xk = rngk.randn(64, 2).astype(np.float32)
+yk = (xk @ np.array([1.0, -1.0], np.float32)).astype(np.float32)
+hist = m.fit(xk, yk, batch_size=32, epochs=3, verbose=0,
+             callbacks=[BroadcastGlobalVariablesCallback(0)])
+fit_w = m.get_weights()[0].ravel().tolist()
+fit_losses = [round(float(v), 6) for v in hist.history["loss"]]
+
 print(json.dumps({"rank": hvd.rank(), "graph": out.tolist(),
                   "bcast": np.asarray(v).tolist(),
-                  "grad": np.asarray(g).tolist()}))
+                  "grad": np.asarray(g).tolist(),
+                  "fit_w": fit_w, "fit_improved":
+                  hist.history["loss"][-1] < hist.history["loss"][0]}))
 """
 
 
@@ -382,3 +403,6 @@ def test_hvdrun_tensorflow_binding(tmp_path):
         assert out["graph"] == [6.0]        # (1+2)*2
         assert out["bcast"] == [1.0, 1.0]   # root 1's value
         assert out["grad"] == [1.5]         # mean of 1 and 2
+        assert out["fit_improved"], out     # compiled fit trains
+    # both ranks converge to IDENTICAL weights (broadcast + allreduce)
+    assert lines[0]["fit_w"] == lines[1]["fit_w"], lines
