@@ -1,10 +1,13 @@
-"""fp16 wire compression for the tensorflow API.
+"""Wire compression for the tensorflow API.
 
 Reference parity: ``horovod/tensorflow/compression.py`` (SURVEY.md §2.4)
-— the same four names (``Compression.none/.fp16``, ``NoneCompressor``,
-``FP16Compressor``), compressing the numpy wire payload and casting back
-after the collective. Operates on numpy (the engine wire format), so it
-works identically in eager and ``tf.py_function`` graph contexts.
+— ``Compression.none/.fp16`` with the reference names
+(``NoneCompressor``, ``FP16Compressor``), plus ``Compression.bf16``
+(``BF16Compressor``), the TPU-native wire dtype also offered on the JAX
+surface. Compressors operate on numpy (the engine wire format), so they
+work identically in eager and ``tf.py_function`` graph contexts; the
+cast-compressor base is parametrized by wire dtype like the jax-side
+``collectives/compression.py``.
 """
 
 from __future__ import annotations
@@ -33,18 +36,42 @@ class NoneCompressor(Compressor):
         return arr
 
 
-class FP16Compressor(Compressor):
-    @staticmethod
-    def compress(arr):
+class _CastCompressor(Compressor):
+    """Cast floating payloads to ``wire_dtype`` for the collective, back
+    to the input dtype after."""
+
+    wire_dtype: str = "float16"
+
+    @classmethod
+    def compress(cls, arr):
         if np.issubdtype(arr.dtype, np.floating):
-            return arr.astype(np.float16), arr.dtype
+            return arr.astype(cls._wire()), arr.dtype
         return arr, None
 
     @staticmethod
     def decompress(arr, ctx):
         return arr if ctx is None else arr.astype(ctx)
 
+    @classmethod
+    def _wire(cls):
+        if cls.wire_dtype == "bfloat16":
+            import ml_dtypes
+            return ml_dtypes.bfloat16
+        return np.dtype(cls.wire_dtype)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = "float16"
+
+
+class BF16Compressor(_CastCompressor):
+    """Same exponent range as fp32: gradient compression never overflows
+    the way fp16 can."""
+
+    wire_dtype = "bfloat16"
+
 
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
+    bf16 = BF16Compressor

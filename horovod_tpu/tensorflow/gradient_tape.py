@@ -56,7 +56,8 @@ def _allreduce_grads(grads, op, compression, prescale, postscale,
         def np_reduce(arr):
             carr, ctx = compression.compress(arr)
             if prescale != 1.0:
-                carr = carr * prescale
+                # keep the WIRE dtype (bf16 * float promotes to f32)
+                carr = (carr * prescale).astype(carr.dtype)
             red = rt.engine.allreduce(nm, carr, op, members=m)
             if postscale != 1.0:
                 red = red * postscale

@@ -183,7 +183,9 @@ def allreduce(tensor, average: Optional[bool] = None,
     def fn(arr):
         carr, ctx = compression.compress(arr)
         if prescale_factor != 1.0:
-            carr = carr * prescale_factor
+            # keep the WIRE dtype: ml_dtypes.bfloat16 * python float
+            # promotes to float32, silently doubling the payload
+            carr = (carr * prescale_factor).astype(carr.dtype)
         out = rt.engine.allreduce(nm, carr, opname, members=m)
         if postscale_factor != 1.0:
             out = out * postscale_factor

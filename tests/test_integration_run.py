@@ -376,7 +376,6 @@ yk = (xk @ np.array([1.0, -1.0], np.float32)).astype(np.float32)
 hist = m.fit(xk, yk, batch_size=32, epochs=3, verbose=0,
              callbacks=[BroadcastGlobalVariablesCallback(0)])
 fit_w = m.get_weights()[0].ravel().tolist()
-fit_losses = [round(float(v), 6) for v in hist.history["loss"]]
 
 print(json.dumps({"rank": hvd.rank(), "graph": out.tolist(),
                   "bcast": np.asarray(v).tolist(),

@@ -501,3 +501,18 @@ def test_join_and_barrier():
     np.testing.assert_allclose(res[0][0][0], [3.0])  # both active: 1+2
     np.testing.assert_allclose(res[1][0][1], [2.0])  # rank 0 joined: 2+0
     assert res[0][1] == res[1][1] == 1  # last joiner is rank 1
+
+
+def test_allreduce_bf16_compression():
+    """Compression.bf16 — the TPU-native wire dtype (same exponent range
+    as fp32): values survive the cast round-trip where fp16 would
+    overflow (tested at 1e5 > fp16 max 65504)."""
+    n = 2
+
+    def fn(r):
+        t = tf.constant([1e5 * (r + 1), 0.5])
+        return hvd.allreduce(t, op=hvd.Sum, name="bf",
+                             compression=hvd.Compression.bf16).numpy()
+
+    for o in run_parallel(n, fn):
+        np.testing.assert_allclose(o, [3e5, 1.0], rtol=1e-2)
