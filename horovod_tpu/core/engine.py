@@ -771,9 +771,14 @@ class JaxProcessEngine(CollectiveEngine):
             return np.ones(length, dt)
         if dt.kind == "b":  # bool min/max = logical and/or
             return np.full(length, op == Min, dt)
-        big = np.finfo(dt).max if dt.kind == "f" else np.iinfo(dt).max
-        small = np.finfo(dt).min if dt.kind == "f" else np.iinfo(dt).min
-        return np.full(length, big if op == Min else small, dt)
+        try:
+            info = np.finfo(dt) if dt.kind == "f" else np.iinfo(dt)
+        except ValueError:
+            # ml_dtypes floats (bfloat16: numpy kind 'V') need their own
+            # finfo
+            import ml_dtypes
+            info = ml_dtypes.finfo(dt)
+        return np.full(length, info.max if op == Min else info.min, dt)
 
     def _device_reduce(self, flat: np.ndarray, op: str,
                        scatter_shape=None, members=None) -> np.ndarray:

@@ -44,6 +44,24 @@ class FP16Compressor(Compressor):
         return tensor if ctx is None else tensor.to(ctx)
 
 
+class BF16Compressor(Compressor):
+    """TPU-native wire dtype (beyond the reference's none/fp16 pair;
+    the jax and tf surfaces offer the same): fp32 exponent range, so
+    gradient compression never overflows the way fp16 can. Crosses the
+    numpy engine boundary via the uint16 view-cast in ``mpi_ops``."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
+    bf16 = BF16Compressor

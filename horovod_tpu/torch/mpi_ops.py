@@ -212,10 +212,23 @@ def _members(process_set: Optional[ProcessSet]):
 # --- numpy adaptation -------------------------------------------------------
 
 def _to_np(t: torch.Tensor) -> np.ndarray:
+    if t.dtype == torch.bfloat16:
+        # torch refuses bf16 .numpy(); view-cast through int16 (present
+        # in every supported torch, unlike uint16 which needs >=2.3) onto
+        # the ml_dtypes wire dtype — a bit-identical reinterpret — so
+        # bf16 tensors and Compression.bf16 cross the engine boundary.
+        import ml_dtypes
+        return (t.detach().cpu().contiguous().view(torch.int16)
+                .numpy().view(ml_dtypes.bfloat16))
     return t.detach().cpu().contiguous().numpy()
 
 
 def _from_np(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    import ml_dtypes
+    if a.dtype == ml_dtypes.bfloat16:
+        out = torch.from_numpy(
+            np.ascontiguousarray(a).view(np.int16)).view(torch.bfloat16)
+        return out.to(device=like.device, dtype=like.dtype)
     return torch.from_numpy(np.ascontiguousarray(a)).to(
         device=like.device, dtype=like.dtype)
 
@@ -231,7 +244,9 @@ def _allreduce_impl(tensor: torch.Tensor, op: str, name: Optional[str],
     compressed, ctx = compression.compress(tensor)
     arr = _to_np(compressed)
     if prescale_factor != 1.0:
-        arr = arr * prescale_factor
+        # keep the WIRE dtype: ml_dtypes.bfloat16 * python float promotes
+        # to float32, silently doubling the compressed payload
+        arr = (arr * prescale_factor).astype(arr.dtype)
     out = rt.engine.allreduce(name, arr, op, members=members)
     if postscale_factor != 1.0:
         out = out * postscale_factor
@@ -396,8 +411,7 @@ def sparse_allreduce_async(tensor: torch.Tensor, op: str = Average,
                                      members=members)
         return torch.sparse_coo_tensor(
             torch.from_numpy(np.ascontiguousarray(g_idx.T)),
-            torch.from_numpy(np.ascontiguousarray(g_vals)).to(
-                tensor.dtype),
+            _from_np(g_vals, vals).to(tensor.dtype),
             t.shape).coalesce().to(tensor.device)
     return rt.submit("sparse_allreduce", name, run)
 

@@ -986,3 +986,26 @@ def test_torch_ops_record_timeline_spans(tmp_path):
     # (timeline.cc convention mirrored by tools/timeline.py)
     assert any(e.get("name") == "ALLREDUCE" and e.get("cat") == "tl_op"
                for e in events), events[:10]
+
+
+def test_allreduce_bf16_tensor_and_compression():
+    """bf16 torch tensors cross the numpy engine boundary (view-cast —
+    torch refuses bf16 .numpy()), and Compression.bf16 keeps an fp32
+    gradient's wire payload at half size without fp16's overflow (1e5 >
+    fp16 max)."""
+    n = 2
+
+    def fn(r):
+        raw = hvd.allreduce(torch.tensor([1.0, 2.0], dtype=torch.bfloat16)
+                            * (r + 1), op=hvd.Sum, name="bfraw")
+        comp = hvd.allreduce(torch.tensor([1e5 * (r + 1), 0.5]),
+                             op=hvd.Sum, name="bfc",
+                             compression=hvd.Compression.bf16)
+        return raw, comp
+
+    for raw, comp in run_parallel(n, fn):
+        assert raw.dtype == torch.bfloat16
+        torch.testing.assert_close(
+            raw.float(), torch.tensor([3.0, 6.0]), rtol=1e-2, atol=1e-2)
+        torch.testing.assert_close(
+            comp, torch.tensor([3e5, 1.0]), rtol=1e-2, atol=1e-2)
