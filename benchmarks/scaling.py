@@ -124,7 +124,9 @@ def main():
     }
     print(json.dumps(rec))
     print(json.dumps(rec_h))
-    _append_history([rec, rec_h])
+    if os.environ.get("HOROVOD_SCALING_NO_HISTORY", "").lower() \
+            not in ("1", "true"):
+        _append_history([rec, rec_h])
 
 
 def _append_history(records) -> None:
