@@ -215,11 +215,14 @@ class StoreDataset:
                 tmp = f"{local}.tmp.{os.getpid()}"
                 with open(tmp, "wb") as f:
                     f.write(data)
+                # Data first, marker second: a crash between the two leaves
+                # a MISSING/stale marker (cache miss, re-fetch) — the other
+                # order would leave a fresh marker vouching for stale bytes.
+                os.replace(tmp, local)  # atomic: concurrent ranks race ok
                 if want_digest is not None:
                     with open(f"{marker}.tmp.{os.getpid()}", "w") as f:
                         f.write(want_digest)
                     os.replace(f"{marker}.tmp.{os.getpid()}", marker)
-                os.replace(tmp, local)  # atomic: concurrent ranks race ok
             out.append(local)
         return out
 

@@ -123,6 +123,12 @@ class KerasEstimator:
         if isinstance(data, StoreDataset):
             return self._fit_store(data)
         hvd = self._compile()
+        n = hvd.size()
+        if self.batch_size % n:
+            raise ValueError(
+                f"batch_size {self.batch_size} (global) must be divisible "
+                f"by the world size {n} (global batch shards over ranks)")
+        local_batch = self.batch_size // n
         feats, labels = _materialize(data, self.feature_col, self.label_col)
         rng = np.random.RandomState(self.seed)
         feats, labels, val = _validation_split(feats, labels,
@@ -131,12 +137,27 @@ class KerasEstimator:
             raise ValueError(
                 f"need at least one global batch ({self.batch_size}) of "
                 f"rows, got {len(feats)}")
+        # Shard the materialized rows by rank (batch_size is GLOBAL, like
+        # _fit_store and the torch/jax estimators): every rank fits over
+        # its own 1/n of the data with a local batch, gradients allreduce,
+        # and shards are trimmed to equal length so step counts pair. One
+        # shared-seed permutation first, so contiguous shards mix classes.
+        if self.shuffle:
+            order = np.random.RandomState(self.seed).permutation(len(feats))
+            feats, labels = feats[order], labels[order]
+        per_rank = len(feats) // n
+        sel = slice(hvd.rank() * per_rank, (hvd.rank() + 1) * per_rank)
+        feats, labels = feats[sel], labels[sel]
         kw = {}
         if val is not None:
             kw["validation_data"] = val
+        # Build BEFORE fit so the broadcast callback (on_train_begin, i.e.
+        # before the first batch builds a lazy model) sees the variables.
+        if not self.model.built:
+            self.model.build((None,) + feats.shape[1:])
         from ..tensorflow.keras import BroadcastGlobalVariablesCallback
         hist = self.model.fit(
-            feats, labels, batch_size=self.batch_size, epochs=self.epochs,
+            feats, labels, batch_size=local_batch, epochs=self.epochs,
             shuffle=self.shuffle, verbose=self.verbose,
             callbacks=[BroadcastGlobalVariablesCallback(0)], **kw)
         self.history = [

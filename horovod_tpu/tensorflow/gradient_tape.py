@@ -153,9 +153,24 @@ class _DistributedGradientTape:
             glist = [tf.convert_to_tensor(g)
                      if isinstance(g, tf.IndexedSlices) else g
                      for g in glist]
-        # Stable names across steps: sequential reuse is safe (ops are
-        # synchronous) and lets the engine's signature cache hit.
-        prefix = "gradtape"
+        # Slot-pool prefix, claimed per gradient() call and released on
+        # return: the canonical eager loop reconstructs this wrapper
+        # EVERY step, so a monotone per-instance counter would mint a
+        # fresh collective name each step and defeat the engine's
+        # signature cache — the steady-state single-model step instead
+        # reuses "gradtape.0" forever (stable names, cache hits), and
+        # slot state never grows. Two reductions genuinely in flight at
+        # once (threads) hold distinct slots, so concurrent models cannot
+        # cross-pair buckets; claim order is program order, identical on
+        # every rank, so names still pair across ranks.
+        rt = _ops._rt()
+        slot = rt.claim_slot("gradtape")
+        try:
+            return self._reduce(glist, one, f"gradtape.{slot}")
+        finally:
+            rt.release_slot("gradtape", slot)
+
+    def _reduce(self, glist, one, prefix):
         if self._op == Average and self._predivide != 1.0:
             f = self._predivide
             n = _ops.size() if self._process_set is None \
@@ -258,7 +273,12 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 if average_aggregated_gradients:
                     acc = [None if a is None else a / bpps for a in acc]
                 grads = acc
-            prefix = "opt_grad"
+            prefix = getattr(self, "_hvd_prefix", None)
+            if prefix is None:
+                # Per-instance (see gradient() above): concurrent wrapped
+                # optimizers must not share engine op names.
+                prefix = _ops._rt().autoname("opt_grad", None)
+                self._hvd_prefix = prefix
             if op == Average and gradient_predivide_factor != 1.0:
                 f = gradient_predivide_factor
                 n = _ops.size() if process_set is None \

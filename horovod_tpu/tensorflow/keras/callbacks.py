@@ -31,6 +31,30 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
     def on_train_begin(self, logs=None):
         if self._done:
             return
+        # Lazy (unbuilt) models have no variables yet — broadcasting
+        # nothing here would silently leave ranks divergent. Built-ness
+        # can itself diverge across ranks (rank 0 restored a checkpoint,
+        # others hold a lazy model), so the broadcast-now-or-defer choice
+        # must be RANK-UNIFORM or collective order splits and the engines
+        # deadlock: agree on min(built) first, and only broadcast here
+        # when every rank is built; otherwise everyone defers to the
+        # first on_train_batch_end (the reference callback's hook).
+        built = 1.0 if self.model.built else 0.0
+        if _ops.size() > 1:
+            rt = _ops._rt()
+            if not hasattr(self, "_flag_name"):
+                self._flag_name = rt.autoname("broadcast_cb_built", None)
+            built = float(rt.engine.allreduce(
+                self._flag_name, np.asarray([built], np.float64),
+                _ops.Min)[0])
+        if built >= 1.0:
+            self._broadcast()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if not self._done:
+            self._broadcast()
+
+    def _broadcast(self):
         broadcast_variables(self.model.trainable_variables
                             + self.model.non_trainable_variables,
                             self.root_rank)

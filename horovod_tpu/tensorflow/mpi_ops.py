@@ -21,6 +21,7 @@ internally).
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Optional
 
@@ -43,6 +44,7 @@ class _TfRuntime:
         self.engine = eng
         self.process_sets = ProcessSetTable(eng.size())
         self._counters = {}
+        self._slots = {}  # (rank, kind) -> {"free": [int heap], "next": int}
         self._clock = threading.Lock()
 
     def autoname(self, kind: str, name: Optional[str]) -> str:
@@ -50,6 +52,28 @@ class _TfRuntime:
         with self._clock:
             return next_autoname(self._counters, self.engine.rank(),
                                  kind, name)
+
+    def claim_slot(self, kind: str) -> int:
+        """Claim the smallest free slot index for ``kind`` on this rank.
+
+        Unlike ``autoname`` (monotone counter), slots are RELEASABLE: a
+        caller that claims, uses, and releases in program order gets the
+        SAME index every time — so per-step-reconstructed wrappers keep
+        stable collective names (signature-cache hits) while two wrappers
+        alive at once still get distinct indices (no cross-pairing)."""
+        with self._clock:
+            st = self._slots.setdefault((self.engine.rank(), kind),
+                                        {"free": [], "next": 0})
+            if st["free"]:
+                return heapq.heappop(st["free"])
+            s = st["next"]
+            st["next"] += 1
+            return s
+
+    def release_slot(self, kind: str, slot: int) -> None:
+        with self._clock:
+            st = self._slots[(self.engine.rank(), kind)]
+            heapq.heappush(st["free"], slot)
 
 
 def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:

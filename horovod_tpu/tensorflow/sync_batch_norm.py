@@ -58,17 +58,16 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
 
         if self.moving_mean is not None:
             m = self.momentum
-            # Bessel correction for the running var (guarded at n == 1),
-            # the BatchNorm running-stat convention — tensor ops so the
-            # eager and tf.function paths compute identically.
-            unbiased = tf.where(total > 1.0, var * total / (total - 1.0),
-                                var)
+            # Biased (population) variance for the running stat: the
+            # Keras BatchNormalization convention, and what this layer's
+            # own single-rank/frozen fallback through super().call uses —
+            # so inference stats agree between the two code paths.
             self.moving_mean.assign(
                 self.moving_mean * m
                 + tf.cast(mean, self.moving_mean.dtype) * (1 - m))
             self.moving_variance.assign(
                 self.moving_variance * m
-                + tf.cast(unbiased, self.moving_variance.dtype) * (1 - m))
+                + tf.cast(var, self.moving_variance.dtype) * (1 - m))
 
         gamma = tf.cast(self.gamma, tf.float32) if self.scale \
             else tf.ones_like(mean)

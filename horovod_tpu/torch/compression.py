@@ -48,7 +48,8 @@ class BF16Compressor(Compressor):
     """TPU-native wire dtype (beyond the reference's none/fp16 pair;
     the jax and tf surfaces offer the same): fp32 exponent range, so
     gradient compression never overflows the way fp16 can. Crosses the
-    numpy engine boundary via the uint16 view-cast in ``mpi_ops``."""
+    numpy engine boundary via the int16 view-cast in ``mpi_ops``
+    (a bit-identical reinterpret; uint16 views need torch>=2.3)."""
 
     @staticmethod
     def compress(tensor):

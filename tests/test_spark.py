@@ -455,6 +455,57 @@ def test_keras_estimator_trains_and_roundtrips(tmp_path):
     np.testing.assert_allclose(loaded.predict(X[:8]), preds, rtol=1e-5)
 
 
+def test_keras_estimator_multirank_shards_in_memory_fit():
+    """Two thread-sim ranks, in-memory fit: batch_size is GLOBAL (like
+    _fit_store and the torch/jax estimators) — each rank fits over its
+    1/n shard with a local batch, broadcast + grad-allreduce leave every
+    rank with identical weights, and an indivisible batch_size raises."""
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator
+    from horovod_tpu.tensorflow.testing import run_parallel
+
+    X, y = _toy_data(128)
+
+    def fit_on_rank(rank):
+        # Eager fit: two thread-sim ranks tracing model.fit concurrently
+        # serialize on TF's tracing lock past the engine stall timeout;
+        # the compiled path is covered cross-process in
+        # test_integration_run.py.
+        tf = pytest.importorskip("tensorflow")
+        tf.config.run_functions_eagerly(True)
+        model = keras.Sequential([
+            keras.layers.Dense(
+                4, activation="relu",
+                kernel_initializer=keras.initializers.Constant(
+                    0.1 * (rank + 1))),  # differ pre-broadcast on purpose
+            keras.layers.Dense(1)])
+        est = KerasEstimator(model=model,
+                             optimizer=keras.optimizers.SGD(0.05),
+                             loss="mse", batch_size=32, epochs=2,
+                             shuffle=False)
+        fitted = est.fit((X, y))
+        return [np.asarray(w) for w in fitted.model.get_weights()]
+
+    tf = pytest.importorskip("tensorflow")
+    try:
+        r0, r1 = run_parallel(2, fit_on_rank)
+    finally:
+        tf.config.run_functions_eagerly(False)
+    for a, b in zip(r0, r1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def bad_batch(rank):
+        model = keras.Sequential([keras.layers.Dense(1)])
+        est = KerasEstimator(model=model,
+                             optimizer=keras.optimizers.SGD(0.05),
+                             loss="mse", batch_size=33)
+        with pytest.raises(ValueError, match="divisible"):
+            est.fit((X, y))
+        return True
+
+    assert all(run_parallel(2, bad_batch))
+
+
 def test_keras_estimator_streams_from_store(tmp_path):
     keras = pytest.importorskip("keras")
     from horovod_tpu.spark import KerasEstimator, materialize_to_store

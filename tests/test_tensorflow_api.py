@@ -204,6 +204,37 @@ def test_broadcast_variables_and_objects():
         assert gathered == [("r", 0), ("r", 1)]
 
 
+def test_broadcast_callback_divergent_builtness_no_deadlock():
+    """Rank 0 built (checkpoint restored), rank 1 lazy/unbuilt: the
+    broadcast-now-or-defer choice is agreed via a min-allreduce, so
+    collective order never splits across ranks — everyone defers to the
+    first on_train_batch_end and converges (no deadlock/mismatch)."""
+    import keras
+    from horovod_tpu.tensorflow.keras import BroadcastGlobalVariablesCallback
+
+    X = np.random.RandomState(3).randn(8, 2).astype(np.float32)
+    y = np.zeros(8, np.float32)
+
+    def fn(r):
+        tf.config.run_functions_eagerly(True)
+        model = keras.Sequential([keras.layers.Dense(
+            1, kernel_initializer=keras.initializers.Constant(r + 1.0))])
+        opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.0))
+        model.compile(optimizer=opt, loss="mse")
+        if r == 0:
+            model.build((None, 2))  # only rank 0 is built pre-fit
+        model.fit(X, y, batch_size=4, epochs=1, verbose=0,
+                  callbacks=[BroadcastGlobalVariablesCallback(0)])
+        return [np.asarray(w) for w in model.get_weights()]
+
+    try:
+        r0, r1 = run_parallel(2, fn)
+    finally:
+        tf.config.run_functions_eagerly(False)
+    for a, b in zip(r0, r1):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
 def test_metric_average_callback():
     from horovod_tpu.tensorflow.keras import MetricAverageCallback
     n = 2
@@ -248,10 +279,68 @@ def test_fused_tape_op_count(monkeypatch):
 
     outs = run_parallel(2, fn, engine=eng)
     assert len(eng.names) == 2, eng.names  # one fused op per rank
-    assert all(nm.startswith("gradtape.fused.float32.")
-               for nm in eng.names)
+    # slot-pool prefix (gradtape.<slot>) — same name on both ranks
+    assert all(".fused.float32." in nm and nm.startswith("gradtape.")
+               for nm in eng.names), eng.names
+    assert len(set(eng.names)) == 1, eng.names
     for g in outs[0]:
         np.testing.assert_allclose(g, np.full((4,), 1.5))
+
+
+def test_tape_slot_pool_stable_and_distinct(monkeypatch):
+    """The gradient-tape prefix slot pool: per-step reconstructed tapes
+    reuse slot 0 (stable names -> engine signature-cache hits), while two
+    tapes ALIVE at once (persistent) hold distinct slots so concurrent
+    models cannot cross-pair buckets."""
+    import threading as _threading
+    from horovod_tpu.core.engine import ThreadSimEngine
+
+    class Recording(ThreadSimEngine):
+        def __init__(self, k):
+            super().__init__(k)
+            self.names = []
+            self._cl = _threading.Lock()
+
+        def allreduce(self, name, arr, op, members=None):
+            with self._cl:
+                self.names.append(name)
+            return super().allreduce(name, arr, op, members=members)
+
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(64 << 20))
+    eng = Recording(2)
+
+    def fn(r):
+        v = tf.Variable(np.ones(4, np.float32))
+        # canonical eager loop: a FRESH wrapper every step — including a
+        # fresh PERSISTENT tape (the WGAN-GP shape, multiple gradient
+        # calls per step)
+        for _ in range(2):
+            with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+                loss = tf.reduce_sum(v)
+            tape.gradient(loss, [v])
+        pt = hvd.DistributedGradientTape(tf.GradientTape(persistent=True))
+        with pt:
+            lp = tf.reduce_sum(v)
+        pt.gradient(lp, [v])
+        pt.gradient(lp, [v])
+
+        # direct pool semantics (per-rank pool): overlapping claims get
+        # distinct slots; released slots are reused smallest-first
+        import horovod_tpu.tensorflow.mpi_ops as _mo
+        rt = _mo._rt()
+        a = rt.claim_slot("slotpool_test")
+        b = rt.claim_slot("slotpool_test")
+        assert (a, b) == (0, 1)
+        rt.release_slot("slotpool_test", a)
+        assert rt.claim_slot("slotpool_test") == 0
+        rt.release_slot("slotpool_test", 0)
+        rt.release_slot("slotpool_test", b)
+        return None
+
+    run_parallel(2, fn, engine=eng)
+    seq = [n for n in eng.names if ".fused." in n]
+    # every call claimed-and-released slot 0: one stable name, no growth
+    assert set(seq) == {"gradtape.0.fused.float32.0"}, seq
 
 
 def test_learning_rate_callbacks_exist():
@@ -443,8 +532,10 @@ def test_sync_batch_norm_spans_ranks():
     outs = run_parallel(n, fn)
     for out, mm, mv in outs:
         np.testing.assert_allclose(mm, np.full(3, 1.0), rtol=1e-5)
-        # unbiased var: 4 * (4/3); moving = 1*0.5 + unbiased*0.5
-        np.testing.assert_allclose(mv, np.full(3, 0.5 + 0.5 * 16 / 3),
+        # biased (population) var — the Keras BatchNormalization moving-
+        # stat convention, matching the layer's single-rank fallback:
+        # var 4; moving = 1*0.5 + 4*0.5
+        np.testing.assert_allclose(mv, np.full(3, 0.5 + 0.5 * 4.0),
                                    rtol=1e-5)
     # outputs: (x - 2) / sqrt(4 + eps) -> rank0 ~ -1, rank1 ~ +1
     np.testing.assert_allclose(outs[0][0], np.full((2, 3), -1.0), atol=1e-2)
