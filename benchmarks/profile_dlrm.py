@@ -1,0 +1,151 @@
+"""Op-level device profile of the DLRM train step on the real TPU.
+
+VERDICT r3 #9: the "embedding-bound by design" claim behind DLRM's
+examples/sec lens (docs/benchmarks.md) was profile-free. This captures
+an xplane trace of the exact `benchmarks/dlrm.py` TPU config's step and
+attributes leaf-op time: embedding gathers/scatter-grads vs dense MLPs
+vs the pairwise interaction vs the Adagrad update.
+
+Usage (real chip):  python benchmarks/profile_dlrm.py [per_chip_batch]
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+from xprof import make_categorize, parse_xplane, report  # noqa: E402
+
+STEPS = 8
+
+
+def main():
+    import flax.linen as nn
+    from flax.linen import partitioning as nn_partitioning
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.dlrm import DLRM, bce_loss, dlrm_criteo
+    from horovod_tpu.models.llama import LOGICAL_RULES
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import rules_for_mesh
+
+    hvd.init()
+    cfg = dlrm_criteo()
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    per_chip = int(pos[0]) if pos else 2048
+    B = per_chip * hvd.size()
+    print(f"device: {jax.devices()[0].device_kind}  batch {B}  "
+          f"{cfg.num_tables} tables x {cfg.rows_per_table} rows", flush=True)
+
+    mesh = create_mesh({"dp": 1})
+    rules = rules_for_mesh(mesh, LOGICAL_RULES)
+    rng = np.random.RandomState(0)
+    dense = jnp.asarray(rng.randn(B, cfg.dense_features).astype(np.float32))
+    sparse = jnp.asarray(rng.randint(0, cfg.rows_per_table,
+                                     (B, cfg.num_tables)))
+    labels = jnp.asarray((rng.rand(B) < 0.3).astype(np.float32))
+
+    model = DLRM(cfg)
+    with nn_partitioning.axis_rules(rules):
+        variables = model.init(jax.random.PRNGKey(0), dense, sparse)
+    params = nn.meta.unbox(variables["params"])
+
+    sparse_path = "--dense" not in sys.argv
+    print(f"path: {'sparse rows (bench config)' if sparse_path else 'dense'}")
+    if sparse_path:
+        # exactly benchmarks/dlrm.py's configuration, pinned layouts incl.
+        from jax.experimental.layout import Format, Layout
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu.models.dlrm import make_sparse_dlrm_step
+        lr, eps, acc0 = 1e-2, 1e-7, 0.1
+        dense_params = {k: v for k, v in params.items()
+                        if k != "embedding_tables"}
+        nrows = cfg.num_tables * cfg.rows_per_table
+        rowmajor = Format(Layout((0, 1)), NamedSharding(mesh, P()))
+        tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
+                         out_shardings=rowmajor)(params["embedding_tables"])
+        accum = jax.jit(lambda t: jnp.full_like(t, acc0),
+                        out_shardings=rowmajor)(tables)
+        opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
+        opt_state = opt.init(dense_params)
+        try:
+            from jax._src.sharding_impls import UNSPECIFIED as _U
+        except ImportError:  # pragma: no cover
+            _U = None
+        jitted = jax.jit(
+            make_sparse_dlrm_step(model, cfg, opt, lr=lr, eps=eps),
+            donate_argnums=(0, 1, 2, 3),
+            in_shardings=(_U, rowmajor, rowmajor, _U, _U, _U, _U),
+            out_shardings=(_U, rowmajor, rowmajor, _U, _U))
+        state = (dense_params, tables, accum, opt_state)
+
+        def once():
+            nonlocal state
+            out = jitted(*state, dense, sparse, labels)
+            state = out[:4]
+            return out[4]
+    else:
+        opt = optax.adagrad(1e-2)
+        opt_state = opt.init(params)
+
+        def step(params, opt_state, d, s, y):
+            def loss_of(p):
+                with nn_partitioning.axis_rules(rules):
+                    out = model.apply({"params": p}, d, s)
+                return bce_loss(out, y)
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        state = (params, opt_state)
+
+        def once():
+            nonlocal state
+            out = jitted(*state, dense, sparse, labels)
+            state = out[:2]
+            return out[2]
+
+    np.asarray(once())  # compile outside the trace
+
+    logdir = tempfile.mkdtemp(prefix="dlrm_xplane_")
+    with jax.profiler.trace(logdir):
+        loss = None
+        for _ in range(STEPS):
+            loss = once()
+        np.asarray(loss)
+
+    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
+    if not totals:
+        print(f"no device events; planes seen: {planes}")
+        return
+    # Shape-based attribution: embedding tables are [rows_per_table, dim]
+    # (gather fwd / scatter-add grads / adagrad over table-shaped state);
+    # the interaction output is [B, F*F or F*(F-1)/2]-ish; MLPs are
+    # [B, hidden] dots.
+    R, Dm = cfg.rows_per_table, cfg.embed_dim
+    flat = cfg.num_tables * R
+    extra = [
+        ("embedding(table-shaped)", re.compile(rf"\[{R},{Dm}\]|"
+                                               rf"\[\d+,{R},{Dm}\]|"
+                                               rf"\[{flat},{Dm}\]")),
+        ("mlp(batch-dots)", re.compile(rf"convolution|^%?dot")),
+    ]
+    report(f"dlrm_profile_b{per_chip}", totals, counts, wall_ps,
+           async_ps, STEPS,
+           categorize=make_categorize(extra),
+           extra_json={"batch": B, "tables": cfg.num_tables,
+                       "rows": R, "embed_dim": Dm})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Op-level device profile of the Mixtral train step on the real TPU.
+
+VERDICT r3 next #1: Mixtral is the last BASELINE config without a
+profile-grounded perf story ("router/dispatch-bound at dim 512" was
+asserted, never evidenced). This captures an xplane trace of the exact
+`benchmarks/mixtral.py` TPU config's train step and attributes leaf-op
+time — in particular telling the DISPATCH path (the [T,E,C] one-hot
+einsums / sort-based gather-scatter) from the EXPERT matmuls, by the
+output shapes in the HLO instruction text:
+
+  [E, C, D]   = dispatch/combine einsum products  (E=8, C=cap, D=512)
+  [E, C, M]   = expert w1/w3/w2 matmuls           (M=1792)
+  [T, E] / [T, E*k] = router logits/probs
+
+Usage (real chip):  python benchmarks/profile_mixtral.py [per_chip_batch]
+Artifacts: the docs/benchmarks.md Mixtral table comes from this output.
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))  # repo root (horovod_tpu pkg)
+sys.path.insert(0, _here)
+from xprof import make_categorize, parse_xplane, report  # noqa: E402
+
+STEPS = 8
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models.llama import LOGICAL_RULES
+    from horovod_tpu.models.mixtral import Mixtral, MixtralConfig
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import (create_gspmd_train_state,
+                                   make_gspmd_train_step)
+
+    hvd.init()
+    # EXACTLY the benchmarks/mixtral.py TPU config
+    cfg = MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
+                        n_heads=8, n_kv_heads=4, hidden_dim=1792,
+                        n_experts=8, top_k=2, max_seq_len=1024)
+    per_chip = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    seq = 512
+    batch = per_chip * hvd.size()
+    print(f"device: {jax.devices()[0].device_kind}  batch {batch} "
+          f"seq {seq}  (T={batch*seq} tokens)", flush=True)
+
+    mesh = create_mesh({"dp": hvd.size()})
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    model = Mixtral(cfg)
+    opt = optax.adamw(1e-4)
+    state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
+                                     tokens, mesh, LOGICAL_RULES)
+    step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
+                                 aux_weight=cfg.router_aux_weight,
+                                 donate=False)
+    _, loss = step(state, tokens)  # warm/compile outside the trace
+    np.asarray(loss)
+
+    logdir = tempfile.mkdtemp(prefix="mixtral_xplane_")
+    with jax.profiler.trace(logdir):
+        for _ in range(STEPS):
+            state2, loss = step(state, tokens)
+        np.asarray(loss)
+
+    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
+    if not totals:
+        print(f"no device events; planes seen: {planes}")
+        return
+    # Shape-based attribution for the MoE layer at THIS config:
+    # C = capacity, M = hidden. Matched against full instruction text.
+    C = max(1, int(cfg.capacity_factor * cfg.top_k * batch * seq
+                   / cfg.n_experts))
+    E, D, M = cfg.n_experts, cfg.dim, cfg.hidden_dim
+    extra = [
+        ("moe:expert-matmul", re.compile(
+            rf"\[{E},{C},{M}\]|\[{C},{M}\]|\[{E},{M},{D}\]")),
+        ("moe:dispatch/combine", re.compile(
+            rf"\[{E},{C},{D}\]|\[{C},{D}\]|,{E},{C}\]")),
+    ]
+    report(f"mixtral_profile_b{per_chip}", totals, counts, wall_ps,
+           async_ps, STEPS,
+           categorize=make_categorize(extra),
+           extra_json={"batch": batch, "seq": seq, "capacity": C})
+
+
+if __name__ == "__main__":
+    main()

@@ -14,10 +14,8 @@ Artifacts: docs/benchmarks.md table is generated from this output.
 """
 
 import collections
-import glob
 import json
 import os
-import re
 import sys
 import tempfile
 
@@ -26,82 +24,17 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
 from common import peak_flops  # noqa: E402
+# Shared xplane parsing (r4): one parser for all three profilers — the
+# device-plane layout notes live in xprof.py's docstring.
+from xprof import make_categorize, parse_xplane, short_name  # noqa: E402
 
 STEPS = 8  # one scan: enough occurrences to average per-op time
 
-
-def parse_xplane(logdir):
-    """Aggregate (name -> total_ps, occurrences) for LEAF HLO ops on the
-    TPU device plane's "XLA Ops" line of the newest xplane.pb.
-
-    Layout (verified on this image's jax/libtpu): the device plane carries
-    lines "Steps" / "XLA Modules" / "XLA Ops" / "Async XLA Ops". The
-    XLA-Ops line nests the `%while` scan-loop umbrella over its body ops
-    (umbrella duration == wall time of the module), so the umbrella and
-    module events are dropped: what remains sums to device occupancy.
-    "Async XLA Ops" (copy-start/done DMA spans) measure OVERLAP windows,
-    not occupancy, and are aggregated separately."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
-                             recursive=True), key=os.path.getmtime)
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {logdir}")
-    space = xplane_pb2.XSpace()
-    with open(paths[-1], "rb") as f:
-        space.ParseFromString(f.read())
-    totals = collections.Counter()
-    counts = collections.Counter()
-    async_total = 0
-    wall_ps = 0
-    plane_names = []
-    for plane in space.planes:
-        plane_names.append(plane.name)
-        if "/device:TPU" not in plane.name:
-            continue
-        meta = plane.event_metadata
-        for line in plane.lines:
-            if line.name == "Async XLA Ops":
-                async_total += sum(ev.duration_ps for ev in line.events)
-                continue
-            if line.name == "XLA Modules":
-                wall_ps += sum(ev.duration_ps for ev in line.events)
-            if line.name != "XLA Ops":
-                continue
-            for ev in line.events:
-                name = meta[ev.metadata_id].name if ev.metadata_id in meta \
-                    else str(ev.metadata_id)
-                stripped = name.lstrip("%")
-                if stripped.startswith(("while", "tuple.", "jit_")):
-                    continue  # scan-loop/module umbrellas, not leaf work
-                totals[name] += ev.duration_ps
-                counts[name] += 1
-    return totals, counts, plane_names, wall_ps, async_total
-
-
-_CATEGORIES = [
-    ("convolution", re.compile(r"convolution|conv\d|^conv")),
-    ("all-reduce", re.compile(r"all-reduce|reduce-scatter|all-gather|"
-                              r"collective")),
-    ("matmul", re.compile(r"^dot|einsum|matmul")),
-    ("copy/transpose", re.compile(r"copy|transpose|bitcast|slice")),
-    ("reduce/bn", re.compile(r"reduce|batch-norm")),
-    ("fusion(elementwise)", re.compile(r"fusion|fused")),
-]
-
-
-def short_name(name):
-    """'%loop_convolution_fusion.12 = ...' -> 'loop_convolution_fusion.12'"""
-    return name.split(" = ")[0].lstrip("%")
-
-
-def categorize(name):
-    low = short_name(name).lower()
-    for cat, pat in _CATEGORIES:
-        if pat.search(low):
-            return cat
-    return "other"
+categorize = make_categorize()
 
 
 def main():

@@ -1,0 +1,140 @@
+"""Shared xplane-trace parsing for the op-occupancy profilers.
+
+Extracted from ``profile_resnet.py`` (r3) so every BASELINE config's
+profile (`profile_resnet.py`, `profile_mixtral.py`, `profile_dlrm.py`)
+reads the device plane identically: the TPU device plane's "XLA Ops"
+line holds leaf HLO op spans (drop the `%while` scan umbrella and
+module events — what remains sums to device occupancy); "Async XLA Ops"
+are overlapped DMA windows, NOT occupancy, tallied separately.
+
+The event metadata name is the FULL HLO instruction text (verified on
+this image's jax/libtpu — no ``tf_op``/op_name stats are populated), so
+shape-based attribution is possible: callers can pass extra (category,
+regex) pairs matched against the instruction text, e.g. to tell a
+``bf16[8,1280,512]`` dispatch einsum from a ``bf16[8,1280,1792]``
+expert matmul.
+"""
+
+import collections
+import glob
+import json
+import os
+import re
+
+_BASE_CATEGORIES = [
+    ("convolution", re.compile(r"convolution|conv\d|^conv")),
+    ("collective", re.compile(r"all-reduce|reduce-scatter|all-gather|"
+                              r"all-to-all|collective")),
+    ("sort", re.compile(r"^sort|sort\.")),
+    ("gather/scatter", re.compile(r"gather|scatter|dynamic-slice|"
+                                  r"dynamic-update")),
+    ("matmul", re.compile(r"^dot|einsum|matmul")),
+    ("copy/transpose", re.compile(r"copy|transpose|bitcast|slice")),
+    ("reduce/bn", re.compile(r"reduce|batch-norm")),
+    ("fusion(elementwise)", re.compile(r"fusion|fused")),
+]
+
+
+def parse_xplane(logdir):
+    """(totals: name->ps, counts, plane_names, wall_ps, async_ps) for the
+    newest xplane.pb under ``logdir``; see module docstring for layout."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    totals = collections.Counter()
+    counts = collections.Counter()
+    async_total = 0
+    wall_ps = 0
+    plane_names = []
+    for plane in space.planes:
+        plane_names.append(plane.name)
+        if "/device:TPU" not in plane.name:
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name == "Async XLA Ops":
+                async_total += sum(ev.duration_ps for ev in line.events)
+                continue
+            if line.name == "XLA Modules":
+                wall_ps += sum(ev.duration_ps for ev in line.events)
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = meta[ev.metadata_id].name if ev.metadata_id in meta \
+                    else str(ev.metadata_id)
+                stripped = name.lstrip("%")
+                if stripped.startswith(("while", "tuple.", "jit_")):
+                    continue  # scan-loop/module umbrellas, not leaf work
+                totals[name] += ev.duration_ps
+                counts[name] += 1
+    return totals, counts, plane_names, wall_ps, async_total
+
+
+def short_name(name):
+    """'%loop_fusion.12 = bf16[...] fusion(...)' -> 'loop_fusion.12'"""
+    return name.split(" = ")[0].lstrip("%")
+
+
+def make_categorize(extra=()):
+    """Categorizer over the FULL instruction text: ``extra`` is an
+    ordered list of (category, compiled-regex) checked FIRST against the
+    whole instruction (shapes included), then the op-kind fallbacks run
+    on the short name."""
+    def categorize(name):
+        for cat, pat in extra:
+            if pat.search(name):
+                return cat
+        low = short_name(name).lower()
+        for cat, pat in _BASE_CATEGORIES:
+            if pat.search(low):
+                return cat
+        return "other"
+    return categorize
+
+
+def report(metric, totals, counts, wall_ps, async_ps, steps, *,
+           categorize=None, extra_json=None, top_k=25):
+    """Print the top-K table + category rollup + one JSON line; returns
+    the rollup dict {category: share}."""
+    from common import peak_flops
+    import numpy as np
+    categorize = categorize or make_categorize()
+    grand = sum(totals.values())
+    print(f"module wall: {wall_ps/1e9:.1f} ms / {steps} steps = "
+          f"{wall_ps/1e9/steps:.2f} ms/step; leaf-op occupancy "
+          f"{grand/1e9:.1f} ms ({grand/max(wall_ps,1):.0%}); async DMA "
+          f"span-sum {async_ps/1e9:.1f} ms (overlap, not occupancy)")
+    print(f"\n{'op':<52} {'category':<22} {'ms':>8} {'share':>7} {'n':>5}")
+    rows = []
+    for name, ps in totals.most_common(top_k):
+        cat = categorize(name)
+        sn = short_name(name)
+        rows.append({"op": sn, "category": cat,
+                     "ms": round(ps / 1e9, 3),
+                     "share": round(ps / grand, 4),
+                     "n": counts[name]})
+        print(f"{sn[:52]:<52} {cat:<22} {ps/1e9:>8.3f} {ps/grand:>6.1%} "
+              f"{counts[name]:>5}")
+    roll = collections.Counter()
+    for name, ps in totals.items():
+        roll[categorize(name)] += ps
+    print("\ncategory rollup:")
+    for cat, ps in roll.most_common():
+        print(f"  {cat:<22} {ps/1e9:>9.3f} ms  {ps/grand:>6.1%}")
+    peak = peak_flops()
+    out = {"metric": metric,
+           "wall_ms_per_step": round(wall_ps / 1e9 / steps, 3),
+           "occupancy_ms_per_step": round(grand / 1e9 / steps, 3),
+           "categories": {c: round(p / grand, 4) for c, p in roll.items()},
+           "top": rows[:10]}
+    if np.isfinite(peak):
+        out["peak_tflops"] = round(peak / 1e12, 1)
+    if extra_json:
+        out.update(extra_json)
+    print("\n" + json.dumps(out))
+    return {c: p / grand for c, p in roll.items()}

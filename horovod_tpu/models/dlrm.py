@@ -68,7 +68,7 @@ class DLRM(nn.Module):
     cfg: DLRMConfig
 
     @nn.compact
-    def __call__(self, dense, sparse, train: bool = True):
+    def __call__(self, dense, sparse, train: bool = True, looked=None):
         c = self.cfg
         # [tables, rows, dim] sharded table-wise over ep — the model-parallel
         # half of the DLRM hybrid.
@@ -85,9 +85,13 @@ class DLRM(nn.Module):
             raise ValueError("bottom_mlp must end at embed_dim")
         # sparse lookups: one row per table; gather over the table axis.
         # vmap over tables, then constrain so the exchange to batch-sharded
-        # layout is one all_to_all.
-        looked = jax.vmap(lambda tab, idx: jnp.take(tab, idx, axis=0),
-                          in_axes=(0, 1), out_axes=1)(tables, sparse)
+        # layout is one all_to_all. A caller doing SPARSE embedding
+        # training (make_sparse_dlrm_step) passes pre-gathered rows via
+        # ``looked`` so the tables param stays outside the autodiff path
+        # (no dense [T,R,D] gradient tables).
+        if looked is None:
+            looked = jax.vmap(lambda tab, idx: jnp.take(tab, idx, axis=0),
+                              in_axes=(0, 1), out_axes=1)(tables, sparse)
         looked = nn_partitioning.with_sharding_constraint(
             looked, ("batch", None, None))  # [B, tables, dim]
         feats = jnp.concatenate([d[:, None, :], looked.astype(c.dtype)],
@@ -108,3 +112,97 @@ def bce_loss(logits, labels):
     logits = logits.astype(jnp.float32)
     return jnp.mean(jnp.maximum(logits, 0) - logits * labels +
                     jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def sparse_adagrad_update(tables_flat, accum_flat, flat_idx, row_grads,
+                          lr, eps: float = 1e-7):
+    """Adagrad on FLAT embedding tables touching ONLY the looked-up rows.
+
+    The reference's DLRM path ships sparse gradients (allgather of
+    indices+values, SURVEY.md §6) precisely because dense updates of
+    multi-hundred-MB tables are the bottleneck — the r4 profile
+    (profile_dlrm.py) measured ~87% of the DLRM step in dense-gradient
+    materialization + dense Adagrad + table copies. Because untouched
+    rows have exactly zero gradient, sparse Adagrad restricted to the
+    touched rows is NUMERICALLY IDENTICAL to dense ``optax.adagrad``
+    (``scale_by_rss`` semantics mirrored below, parity-tested):
+    duplicate ids within the batch are collapsed by summation BEFORE the
+    accumulator update, as the dense gradient would be.
+
+    Tables are FLAT [T*R, D] (table t's row r at t*R + r): a 2-D shape
+    lets the caller pin a row-major jit layout — XLA's entry-layout
+    heuristic otherwise picks a gather-friendly transposed layout and
+    inserts four whole-table transpose copies per step around the
+    scatters (~12 ms/step measured; see benchmarks/dlrm.py).
+
+    tables_flat/accum_flat: [N, D]; flat_idx: [K] int; row_grads: [K, D]
+    (d loss / d looked-up rows). Returns (tables, accum) updated.
+    """
+    K = flat_idx.shape[0]
+    N = tables_flat.shape[0]
+    # collapse duplicate rows: one global sort (flat ids never collide
+    # across tables), segment-sum grads into a COMPACT [K, D] workspace
+    o = jnp.argsort(flat_idx)
+    ids_s = flat_idx[o]
+    g_s = row_grads[o]
+    head = jnp.concatenate([jnp.ones((1,), bool),
+                            ids_s[1:] != ids_s[:-1]])
+    seg = jnp.cumsum(head.astype(jnp.int32)) - 1          # [K] in [0,S)
+    gsum = jnp.zeros_like(g_s).at[seg].add(g_s)
+    # segment -> row id; unused tail segments get N (dropped below)
+    uid = jnp.full((K,), N, flat_idx.dtype).at[seg].set(ids_s)
+    acc_rows = accum_flat.at[uid].get(mode="fill", fill_value=0.0)
+    acc_new = acc_rows + gsum * gsum
+    # optax.scale_by_rss update rule, row-restricted
+    inv = jnp.where(acc_new > 0.0, jax.lax.rsqrt(acc_new + eps), 0.0)
+    tables2 = tables_flat.at[uid].add(-lr * gsum * inv, mode="drop")
+    accum2 = accum_flat.at[uid].set(acc_new, mode="drop")
+    return tables2, accum2
+
+
+def make_sparse_dlrm_step(model, cfg, opt_dense, *, lr: float,
+                          eps: float = 1e-7, loss=bce_loss, rules=None):
+    """Train step with the reference's sparse-embedding semantics: the
+    dense MLPs update through ``opt_dense`` (any optax optimizer), the
+    embedding tables through :func:`sparse_adagrad_update` — gradients
+    exist only for the [B, T, D] looked-up rows, never as dense [T, R, D]
+    tables. Tables ride FLAT as [T*R, D] (see sparse_adagrad_update for
+    the layout rationale; callers should pin a row-major layout on the
+    tables/accum jit params, as benchmarks/dlrm.py does). Returns
+    ``step(dense_params, tables_flat, accum_flat, opt_state, d, s, y) ->
+    (dense_params, tables_flat, accum_flat, opt_state, loss)``, jittable
+    with all array args donated. On a multi-chip mesh pass the resolved
+    logical-axis ``rules`` (``train.rules_for_mesh``) so the model's
+    internal sharding constraints stay live — flax silently no-ops them
+    outside an ``axis_rules`` scope."""
+    import contextlib
+
+    import optax
+    T, R, D = cfg.num_tables, cfg.rows_per_table, cfg.embed_dim
+    scope = (lambda: nn_partitioning.axis_rules(rules)) if rules \
+        else contextlib.nullcontext
+
+    def step(dense_params, tables_flat, accum_flat, opt_state, d, s, y):
+        B = s.shape[0]
+        fid = (s + (jnp.arange(T, dtype=s.dtype) * R)[None, :]).reshape(-1)
+        looked = tables_flat[fid].reshape(B, T, D)
+
+        def loss_of(p, rows):
+            with scope():
+                out = model.apply(
+                    {"params": {**p,
+                                "embedding_tables":
+                                    tables_flat.reshape(T, R, D)}},
+                    d, s, looked=rows)
+            return loss(out, y)
+
+        lval, (gdense, grows) = jax.value_and_grad(
+            loss_of, argnums=(0, 1))(dense_params, looked)
+        updates, opt_state2 = opt_dense.update(gdense, opt_state,
+                                               dense_params)
+        dense2 = optax.apply_updates(dense_params, updates)
+        tables2, accum2 = sparse_adagrad_update(
+            tables_flat, accum_flat, fid, grows.reshape(B * T, D), lr, eps)
+        return dense2, tables2, accum2, opt_state2, lval
+
+    return step

@@ -310,14 +310,23 @@ def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
         for i in range(c.n_layers):
             x = block(c, name=f"block_{i}")(x, positions)
     x = RMSNorm(c.norm_eps, c.dtype, name="final_norm")(x)
+    # LM head in the compute dtype with f32 ACCUMULATION (r4): an
+    # f32×f32 head matmul runs at ~1/4 MXU rate and profiled as a
+    # double-digit share of the Mixtral step (profile_mixtral.py);
+    # bf16 inputs + preferred_element_type=f32 keep f32 logits (full
+    # accumulator precision) at bf16 matmul speed.
     if c.tie_embeddings:
-        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), emb)
+        logits = jnp.einsum("btd,vd->btv", x.astype(c.dtype),
+                            emb.astype(c.dtype),
+                            preferred_element_type=jnp.float32)
     else:
-        logits = nn.DenseGeneral(
-            c.vocab_size, axis=-1, use_bias=False, dtype=jnp.float32,
-            name="lm_head",
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              ("embed", "vocab")))(x)
+        w_head = mdl.param("lm_head",
+                           _part(nn.initializers.lecun_normal(),
+                                 ("embed", "vocab")),
+                           (c.dim, c.vocab_size), jnp.float32)
+        logits = jnp.einsum("btd,dv->btv", x.astype(c.dtype),
+                            w_head.astype(c.dtype),
+                            preferred_element_type=jnp.float32)
     return logits
 
 

@@ -4,11 +4,12 @@ Role: BASELINE.md config 4 (Mixtral-8x7B — alltoall expert dispatch over
 ICI). The reference only exposes the alltoall *primitive* (SURVEY.md §2.6
 "EP: primitive only"); this is the full layer: Llama blocks whose FFN is a
 top-2 routed bank of SwiGLU experts. Experts are sharded over the ``ep``
-mesh axis ("experts" logical axis); the dispatch/combine einsums against the
-capacity one-hot tensors (parallel/moe.py router) carry sharding constraints
-that make XLA lower the exchange to all_to_all over ICI — the GSPMD twin of
-``parallel.moe.routed_experts`` (the explicit shard_map version, tested
-equivalent).
+mesh axis ("experts" logical axis); the sort-based gather-only
+dispatch/combine (parallel/moe.py, r4 — the one-hot [T,E,C] einsums it
+replaced profiled costlier than the expert matmuls) feeds expert buffers
+whose sharding constraints make XLA lower the exchange to all_to_all over
+ICI — the GSPMD twin of ``parallel.moe.routed_experts`` (the explicit
+shard_map version, tested equivalent).
 
 Aux load-balancing losses are sown into the ``losses`` collection; the train
 harness (make_gspmd_train_step(aux_weight=...)) folds them into the loss.
@@ -24,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from flax.linen import partitioning as nn_partitioning
 
-from ..parallel.moe import topk_router
+from ..parallel.moe import sorted_combine, sorted_dispatch, topk_router_sorted
 from .llama import Attention, LlamaConfig, RMSNorm, _part
 
 
@@ -73,11 +74,14 @@ class MoEMLP(nn.Module):
         tokens = x.reshape(B * T, D)
         logits = router(tokens)
         capacity = max(1, int(c.capacity_factor * c.top_k * B * T / E))
-        r = topk_router(logits, E, capacity, c.top_k)
+        # Sort-based dispatch plan (r4): the one-hot [T,E,C] einsum
+        # dispatch cost more device time than the expert matmuls at the
+        # bench config (profile_mixtral.py) — row gather/scatter moves
+        # O(k·T·D) bytes instead.
+        r = topk_router_sorted(logits, E, capacity, c.top_k)
         self.sow("losses", "router_aux", r.aux_loss)
 
-        dispatched = jnp.einsum("tec,td->ecd", r.dispatch,
-                                tokens.astype(jnp.float32)).astype(c.dtype)
+        dispatched = sorted_dispatch(tokens, r, E, capacity)  # [E,C,D]
         dispatched = nn_partitioning.with_sharding_constraint(
             dispatched, ("experts", None, "embed"))
         h = jax.nn.silu(jnp.einsum("ecd,edm->ecm", dispatched,
@@ -86,8 +90,7 @@ class MoEMLP(nn.Module):
         h = nn_partitioning.with_sharding_constraint(
             h, ("experts", None, "mlp"))
         out = jnp.einsum("ecm,emd->ecd", h, w2.astype(c.dtype))
-        y = jnp.einsum("tec,ecd->td", r.combine,
-                       out.astype(jnp.float32)).astype(c.dtype)
+        y = sorted_combine(out, r, B * T).astype(c.dtype)
         return y.reshape(B, T, D)
 
 

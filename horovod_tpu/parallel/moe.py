@@ -5,8 +5,11 @@ primitive (nccl_operations.cc AllToAll, MPI_Alltoallv) but no MoE layer or
 router — EP is "primitive only". BASELINE.md config 4 (Mixtral-8x7B) demands
 the full path, built here the TPU way:
 
-- tokens are routed top-k with a capacity limit (Switch/GShard-style
-  one-hot dispatch tensors — all static shapes, MXU-friendly einsums);
+- tokens are routed top-k with a capacity limit. Two dispatch forms:
+  the GShard-style one-hot einsum router (``topk_router``, kept as the
+  readable reference + parity oracle) and the production sort-based
+  GATHER-ONLY plan (``topk_router_sorted`` — all static shapes, zero
+  scatters even in backward; see its docstring for why);
 - experts are sharded over the ``ep`` mesh axis; the token exchange is ONE
   ``lax.all_to_all`` each way over ICI (the exact op the reference exposes
   but can only run host-side, here fused into the compiled graph);
@@ -74,6 +77,174 @@ def topk_router(router_logits, num_experts: int, capacity: int,
     return RouterOutput(dispatch, combine, aux_loss)
 
 
+class SortedRouting(NamedTuple):
+    """Sort-based routing plan (no [T,E,C] one-hot tensors).
+
+    ``k*T`` flattened (round, token) entries in ROUND-MAJOR order
+    (index = round*T + token), matching :func:`topk_router`'s claim
+    priority (all first choices claim capacity before any second
+    choice). Carries BOTH directions of the token<->slot mapping so
+    dispatch and combine — and, via their custom VJPs, both backward
+    passes — are pure row GATHERS: TPU scatters serialize row updates
+    and profiled as slow as the one-hot einsums they replaced
+    (profile_mixtral.py, r4).
+    """
+    token_idx: jnp.ndarray   # [k*T] int32: source token of each entry
+    dest: jnp.ndarray        # [k*T] int32: expert*capacity + slot, or
+    #                          E*capacity (out-of-range sentinel) if dropped
+    weight: jnp.ndarray      # [k*T] f32: renormalized gate (0 if dropped)
+    slot_entry: jnp.ndarray  # [E*C] int32: entry filling each slot (clipped)
+    slot_valid: jnp.ndarray  # [E*C] bool: slot actually claimed
+    aux_loss: jnp.ndarray    # same load-balancing loss as topk_router
+
+
+def topk_router_sorted(router_logits, num_experts: int, capacity: int,
+                       top_k: int = 2) -> SortedRouting:
+    """Top-k router producing a gather-based dispatch plan.
+
+    Numerically equivalent to :func:`topk_router` (same expert choices,
+    same capacity-claim priority, same renormalized combine weights,
+    same aux loss) but O(k·T·D) memory traffic instead of materializing
+    two [T, E, C] one-hot tensors and O(T·E·C·D) dispatch einsums — at
+    the Mixtral bench config those einsums cost MORE device time than
+    the expert matmuls themselves (profile_mixtral.py, r4).
+    """
+    T = router_logits.shape[0]
+    kT = top_k * T
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top1, num_experts, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
+
+    gate, choice = lax.top_k(probs, top_k)            # [T, k]
+    # round-major flatten: entry r*T + t  (claim priority = round, token)
+    e_flat = choice.T.reshape(-1).astype(jnp.int32)   # [k*T]
+    g_flat = gate.T.reshape(-1)
+    token_idx = jnp.tile(jnp.arange(T, dtype=jnp.int32), top_k)
+
+    # stable sort by expert: within an expert, entries keep round-major
+    # order — exactly topk_router's base_count claim sequence
+    order = jnp.argsort(e_flat, stable=True).astype(jnp.int32)
+    e_sorted = e_flat[order]
+    counts = jnp.sum(jax.nn.one_hot(e_flat, num_experts, dtype=jnp.int32),
+                     axis=0)                          # [E]
+    start = jnp.cumsum(counts) - counts               # exclusive cumsum
+    pos = jnp.arange(kT, dtype=jnp.int32) - start[e_sorted]
+    keep_sorted = pos < capacity
+    dest_sorted = jnp.where(
+        keep_sorted, e_sorted * capacity + jnp.minimum(pos, capacity - 1),
+        num_experts * capacity)                       # sentinel = dropped
+    # un-sort back to (round, token) order — a tiny int permutation
+    # scatter ([k*T] elements), nothing row-sized
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(kT, dtype=jnp.int32))
+    dest = dest_sorted[inv]
+    kept = g_flat * (dest < num_experts * capacity)
+    # round-major layout: entries of token t sit at {r*T + t} — the
+    # per-token reduction is a reshape-sum, not a segment scatter
+    denom = kept.reshape(top_k, T).sum(0)
+    weight = kept / jnp.maximum(denom, 1e-9)[token_idx]
+
+    # slot-side view: slot (e, p) is filled by sorted entry start[e]+p
+    grid = (start[:, None] + jnp.arange(capacity, dtype=jnp.int32)[None, :]
+            ).reshape(-1)                             # [E*C]
+    slot_valid = (jnp.arange(capacity, dtype=jnp.int32)[None, :]
+                  < jnp.minimum(counts, capacity)[:, None]).reshape(-1)
+    slot_entry = order[jnp.clip(grid, 0, kT - 1)]
+    return SortedRouting(token_idx, dest, weight, slot_entry, slot_valid,
+                         aux_loss)
+
+
+from functools import partial as _partial
+
+
+def _zero_tan(a):
+    """float0 zero-cotangent for integer/bool plan arrays (the jax
+    convention for non-differentiable array inputs of a custom_vjp)."""
+    import numpy as _np
+    from jax.dtypes import float0
+    return _np.zeros(a.shape, float0)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _dispatch_rows(x, slot_entry, slot_valid, dest, top_k: int):
+    """buf[s] = x[token(slot_entry[s])] * valid[s] — gather only."""
+    T = x.shape[0]
+    rows = x[slot_entry % T]
+    return rows * slot_valid[:, None].astype(x.dtype)
+
+
+def _dispatch_rows_fwd(x, slot_entry, slot_valid, dest, top_k):
+    return _dispatch_rows(x, slot_entry, slot_valid, dest, top_k), \
+        (x.shape[0], slot_entry, slot_valid, dest)
+
+
+def _dispatch_rows_bwd(top_k, res, dbuf):
+    # dx[t] = sum_r dbuf[dest[r*T + t]] — ALSO a gather (+ reshape-sum):
+    # the mirror of the combine forward, so no scatter in the transpose.
+    T, slot_entry, slot_valid, dest = res
+    rows = dbuf.at[dest].get(mode="fill", fill_value=0)
+    dx = rows.reshape(top_k, T, -1).sum(0)
+    return dx, _zero_tan(slot_entry), _zero_tan(slot_valid), _zero_tan(dest)
+
+
+def sorted_dispatch(x, r: SortedRouting, num_experts: int, capacity: int):
+    """[T, D] tokens -> [E, C, D] expert buffers, gathers only (fwd AND
+    bwd — see :class:`SortedRouting`). Unclaimed capacity slots are
+    zero, as with the one-hot dispatch."""
+    k = r.dest.shape[0] // x.shape[0]
+    buf = _dispatch_rows(x, r.slot_entry, r.slot_valid, r.dest, k)
+    return buf.reshape(num_experts, capacity, x.shape[-1])
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _combine_rows(flat, weight, dest, slot_entry, slot_valid,
+                  num_tokens: int):
+    """y[t] = sum_r flat[dest[r*T+t]] * weight[r*T+t] — gather only."""
+    rows = flat.at[dest].get(mode="fill", fill_value=0)
+    k = dest.shape[0] // num_tokens
+    return (rows.reshape(k, num_tokens, -1)
+            * weight.reshape(k, num_tokens, 1)).sum(0)
+
+
+def _combine_rows_fwd(flat, weight, dest, slot_entry, slot_valid,
+                      num_tokens):
+    y = _combine_rows(flat, weight, dest, slot_entry, slot_valid,
+                      num_tokens)
+    return y, (flat, weight, dest, slot_entry, slot_valid)
+
+
+def _combine_rows_bwd(num_tokens, res, dy):
+    # dflat[s] = dy[token(slot_entry[s])] * weight[slot_entry[s]] * valid
+    # — gathers; dweight[j] = <dy[token(j)], flat[dest[j]]> — gathers.
+    flat, weight, dest, slot_entry, slot_valid = res
+    T = num_tokens
+    w_slot = weight[slot_entry] * slot_valid
+    dflat = (dy[slot_entry % T] * w_slot[:, None]).astype(flat.dtype)
+    rows = flat.at[dest].get(mode="fill", fill_value=0)
+    k = dest.shape[0] // T
+    dweight = jnp.sum(rows.reshape(k, T, -1)
+                      * dy.reshape(1, T, -1), axis=-1).reshape(-1)
+    return (dflat, dweight, _zero_tan(dest), _zero_tan(slot_entry),
+            _zero_tan(slot_valid))
+
+
+def sorted_combine(out, r: SortedRouting, num_tokens: int):
+    """[E, C, D] expert outputs -> [T, D] weighted combine, gathers only
+    (fwd AND bwd). Accumulates in f32 like the one-hot combine."""
+    E, C, D = out.shape
+    flat = out.reshape(E * C, D).astype(jnp.float32)
+    y = _combine_rows(flat, r.weight, r.dest, r.slot_entry, r.slot_valid,
+                      num_tokens)
+    return y.astype(out.dtype)
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
 def expert_alltoall(expert_inputs, axis_name: str):
     """[E, C, D] (all experts' buffers on this device) -> [E_local, n*C, D]
     (this device's experts, tokens from every device). One all_to_all."""
@@ -107,15 +278,12 @@ def routed_experts(x, router_logits, expert_fn: Callable, *,
     T, D = x.shape
     n = lax.axis_size(axis_name) if axis_name else 1
     capacity = max(1, int(capacity_factor * top_k * T / num_experts))
-    r = topk_router(router_logits, num_experts, capacity, top_k)
-    # [T,E,C] x [T,D] -> [E,C,D]
-    dispatched = jnp.einsum("tec,td->ecd", r.dispatch,
-                            x.astype(jnp.float32)).astype(x.dtype)
+    r = topk_router_sorted(router_logits, num_experts, capacity, top_k)
+    dispatched = sorted_dispatch(x, r, num_experts, capacity)  # [E,C,D]
     if axis_name:
         dispatched = expert_alltoall(dispatched, axis_name)  # [E/n, n*C, D]
     out = expert_fn(dispatched)
     if axis_name:
         out = expert_alltoall_back(out, axis_name)           # [E, C, D]
-    y = jnp.einsum("tec,ecd->td", r.combine,
-                   out.astype(jnp.float32)).astype(x.dtype)
+    y = sorted_combine(out, r, T)
     return y, r.aux_loss

@@ -204,6 +204,66 @@ def test_dlrm_trains_dp_ep():
     assert "ep" in str(params["embedding_tables"].sharding.spec)
 
 
+def test_dlrm_sparse_step_matches_dense_adagrad():
+    """The sparse embedding path (r4: only looked-up rows update — the
+    reference's sparse-gradient DLRM semantics) is numerically identical
+    to dense optax.adagrad over the whole table, because untouched rows
+    have exactly zero gradient. Duplicate ids within a batch are
+    deliberately present to exercise the collapse-by-summation."""
+    from horovod_tpu.models.dlrm import (DLRM, bce_loss, dlrm_tiny,
+                                         make_sparse_dlrm_step)
+    cfg = dlrm_tiny()
+    model = DLRM(cfg)
+    rng = np.random.RandomState(5)
+    B = 16
+    dense = jnp.asarray(rng.randn(B, cfg.dense_features).astype(np.float32))
+    # small id range -> guaranteed duplicate rows per table in the batch
+    sparse = jnp.asarray(rng.randint(0, 4, (B, cfg.num_tables)))
+    labels = jnp.asarray((rng.rand(B) < 0.3).astype(np.float32))
+    lr, eps, acc0 = 1e-2, 1e-7, 0.1
+
+    import flax.linen as nn
+    params0 = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), dense, sparse)["params"])
+
+    # dense path: one optimizer over everything
+    opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
+    p = jax.tree_util.tree_map(lambda a: a, params0)
+    st = opt.init(p)
+
+    def dense_step(p, st):
+        def loss_of(pp):
+            return bce_loss(model.apply({"params": pp}, dense, sparse),
+                            labels)
+        loss, g = jax.value_and_grad(loss_of)(p)
+        up, st2 = opt.update(g, st, p)
+        return optax.apply_updates(p, up), st2, loss
+
+    # sparse path: tables split out, FLAT [T*R, D] (see
+    # sparse_adagrad_update's layout rationale)
+    dp = {k: v for k, v in params0.items() if k != "embedding_tables"}
+    tables = params0["embedding_tables"].reshape(-1, cfg.embed_dim)
+    accum = jnp.full_like(tables, acc0)
+    opt_d = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
+    st_d = opt_d.init(dp)
+    step = jax.jit(make_sparse_dlrm_step(model, cfg, opt_d, lr=lr, eps=eps))
+
+    for _ in range(3):
+        p, st, dloss = dense_step(p, st)
+        dp, tables, accum, st_d, sloss = step(dp, tables, accum, st_d,
+                                              dense, sparse, labels)
+        np.testing.assert_allclose(float(dloss), float(sloss), rtol=1e-6)
+
+    np.testing.assert_allclose(
+        np.asarray(p["embedding_tables"]).reshape(-1, cfg.embed_dim),
+        np.asarray(tables), rtol=1e-5, atol=1e-7)
+    for k in dp:
+        for a, b in zip(jax.tree_util.tree_leaves(p[k]),
+                        jax.tree_util.tree_leaves(dp[k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
 def test_bert_flash_matches_naive():
     """use_flash=True (interpret-mode Pallas) must agree with the
     materialised-softmax path, including the padding mask."""

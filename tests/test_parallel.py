@@ -96,6 +96,58 @@ def test_topk_router_shapes_and_capacity():
     assert np.isfinite(float(r.aux_loss))
 
 
+def test_sorted_router_matches_onehot_router():
+    """The sort-based dispatch plan (r4: replaces the [T,E,C] one-hot
+    einsums on the MoE hot path) is numerically equivalent to
+    ``topk_router`` — same dispatch result, combine weights, aux loss,
+    and gradients — across ample/tight/heavy-drop capacities."""
+    from horovod_tpu.parallel.moe import (sorted_combine, sorted_dispatch,
+                                          topk_router_sorted)
+    rng = np.random.RandomState(7)
+    T, E, D, k = 64, 8, 16, 2
+    for cap_factor in (2.0, 0.5, 0.15):
+        cap = max(1, int(cap_factor * k * T / E))
+        logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+        x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+        r1 = topk_router(logits, E, cap, k)
+        r2 = topk_router_sorted(logits, E, cap, k)
+        np.testing.assert_allclose(
+            np.asarray(jnp.einsum("tec,td->ecd", r1.dispatch, x)),
+            np.asarray(sorted_dispatch(x, r2, E, cap)),
+            rtol=1e-5, atol=1e-6)
+        out = jnp.asarray(rng.randn(E, cap, D).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(jnp.einsum("tec,ecd->td", r1.combine, out)),
+            np.asarray(sorted_combine(out, r2, T)),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(r1.aux_loss),
+                                   np.asarray(r2.aux_loss), rtol=1e-6)
+
+    cap = max(1, int(0.5 * k * T / E))
+    w = jnp.asarray(rng.randn(D, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+
+    def loss_onehot(x, logits, w):
+        r = topk_router(logits, E, cap, k)
+        d = jnp.einsum("tec,td->ecd", r.dispatch, x)
+        o = jnp.tanh(jnp.einsum("ecd,df->ecf", d, w))
+        return (jnp.einsum("tec,ecd->td", r.combine, o) ** 2).sum() \
+            + r.aux_loss
+
+    def loss_sorted(x, logits, w):
+        r = topk_router_sorted(logits, E, cap, k)
+        o = jnp.tanh(jnp.einsum("ecd,df->ecf",
+                                sorted_dispatch(x, r, E, cap), w))
+        return (sorted_combine(o, r, T) ** 2).sum() + r.aux_loss
+
+    g1 = jax.grad(loss_onehot, argnums=(0, 1, 2))(x, logits, w)
+    g2 = jax.grad(loss_sorted, argnums=(0, 1, 2))(x, logits, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+
 def test_routed_experts_single_device_identity_expert():
     """With identity experts and top-1 routing (no drops), MoE output ==
     input (combine weights renormalised to 1)."""

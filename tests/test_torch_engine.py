@@ -429,6 +429,106 @@ def test_cache_verify_every_reverifies(monkeypatch):
         assert counts == [3, 1, 3, 1], counts
 
 
+def test_cache_capacity_one_perpetual_evict_refill(monkeypatch):
+    """VERDICT r3 #8: a capacity-1 cache under a 2-op steady state is the
+    worst case — each op evicts the other before its next occurrence, so
+    BOTH ops pay the asymmetric want-full path on EVERY round, forever.
+    Verified invariants: (a) correctness is unaffected (results match the
+    uncached protocol), (b) every occurrence costs mini + 2 header
+    gathers = 3 host rounds (the want-full fallback, not a hang or a
+    stale hit), (c) the cache never exceeds capacity, (d) a SINGLE-op
+    steady state still reaches the 1-gather cached path at capacity 1."""
+    _pin_cache(monkeypatch, capacity=1)
+
+    def fn(eng, r):
+        counts, outs = [], []
+        for _ in range(3):  # alternating ops: perpetual evict/refill
+            for name in ("a", "b"):
+                before = eng.host_rounds
+                outs.append(eng.allreduce(
+                    name, np.full(2, r + 1.0, np.float32), Sum))
+                counts.append(eng.host_rounds - before)
+                assert len(eng._sig_seen) <= 1
+        solo = []
+        for _ in range(3):  # single hot op: capacity 1 is enough
+            before = eng.host_rounds
+            eng.allreduce("solo", np.ones(2, np.float32), Sum)
+            solo.append(eng.host_rounds - before)
+        return counts, solo, outs
+
+    for counts, solo, outs in _run_counting(2, fn):
+        assert counts == [3] * 6, counts
+        assert solo == [3, 1, 1], solo
+        for o in outs:
+            np.testing.assert_allclose(o, [3.0, 3.0])
+
+
+def test_cache_mixed_subgroup_and_global_cycles(monkeypatch):
+    """VERDICT r3 #8: subgroup and global cached ops interleaved over many
+    cycles. Each reaches its own steady state (1 mini gather per op), the
+    subgroup's mini round meets among MEMBERS only (non-members spend no
+    gather on it), and results stay correct throughout."""
+    _pin_cache(monkeypatch)
+    n = 3
+    sub = (0, 2)
+
+    def fn(eng, r):
+        per_cycle = []
+        for cycle in range(6):
+            before = eng.host_rounds
+            g = eng.allreduce("glob", np.full(2, r + 1.0, np.float32), Sum)
+            np.testing.assert_allclose(g, [6.0, 6.0])  # 1+2+3
+            if r in sub:
+                s = eng.allreduce("subg", np.full(2, r + 1.0, np.float32),
+                                  Sum, members=sub)
+                np.testing.assert_allclose(s, [4.0, 4.0])  # 1+3
+            per_cycle.append(eng.host_rounds - before)
+        return per_cycle
+
+    outs = _run_counting(n, fn)
+    for r, per_cycle in enumerate(outs):
+        # steady state from cycle 1: one mini gather per op issued
+        expect = 2 if r in sub else 1
+        assert per_cycle[1:] == [expect] * 5, (r, per_cycle)
+
+
+def test_cache_rank_rejoins_mid_steady_state(monkeypatch):
+    """VERDICT r3 #8: a rank joining mid-steady-state drags cached ops
+    back onto the full header round (identity contributions keep
+    working), and after the join completes the SAME signatures resume
+    the 1-gather cached path — the seen-counts survive the join."""
+    _pin_cache(monkeypatch)
+
+    def fn(eng, r):
+        # steady state first
+        for _ in range(2):
+            eng.allreduce("g", np.full(2, r + 1.0, np.float32), Sum)
+        if r == 0:
+            eng.join()           # rank 0 out for one stretch
+            during = None
+        else:
+            before = eng.host_rounds
+            during = eng.allreduce("g", np.full(2, 5.0, np.float32), Sum)
+            assert eng.host_rounds - before >= 3  # forced full round
+            eng.join()
+        # both back: cached path must resume at one gather
+        steady = []
+        outs = []
+        for _ in range(2):
+            before = eng.host_rounds
+            outs.append(eng.allreduce(
+                "g", np.full(2, r + 1.0, np.float32), Sum))
+            steady.append(eng.host_rounds - before)
+        return during, steady, outs
+
+    outs = _run_counting(2, fn)
+    np.testing.assert_allclose(outs[1][0], [5.0, 5.0])  # identity join
+    for during, steady, res in outs:
+        assert steady == [1, 1], steady
+        for o in res:
+            np.testing.assert_allclose(o, [3.0, 3.0])
+
+
 def test_cache_join_falls_back_to_full_rounds(monkeypatch):
     """A joined rank forces cached ops back onto the full header round so
     its zero/identity contributions keep working (steady-state ops before
