@@ -72,43 +72,16 @@ def main():
     # spent ~87% of the step materializing [26,100000,64] gradient
     # tables + dense Adagrad + table copies (profile_dlrm.py); sparse
     # Adagrad is numerically identical (zero-grad rows don't move) and
-    # touches B*26 rows instead of 2.6M. Tables ride flat [T*R, D] with
-    # a PINNED row-major jit layout: XLA's entry-layout heuristic
-    # otherwise transposes the full tables around the scatters
-    # (4 × ~666MB copies/step — measured 22.4 -> 10.1 ms/step).
-    from jax.experimental.layout import Format, Layout
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from horovod_tpu.models.dlrm import make_sparse_dlrm_step
-    lr, eps, acc0 = 1e-2, 1e-7, 0.1
-    dense_params = {k: v for k, v in params.items()
-                    if k != "embedding_tables"}
-    nrows = cfg.num_tables * cfg.rows_per_table
-    rowmajor = Format(Layout((0, 1)),
-                      NamedSharding(mesh, P("ep") if "ep" in
-                                    mesh.axis_names else P()))
+    # touches B*26 rows instead of 2.6M. Setup (flat tables, pinned
+    # layouts, donation) is SHARED with profile_dlrm.py — see
+    # dlrm_common.build_sparse_training for the rationale.
+    from dlrm_common import build_sparse_training
     # count dense params BEFORE dropping the table buffer
-    n_dense_params = params_count(dense_params)
-    with jax.sharding.set_mesh(mesh):
-        # donate: the [T,R,D] buffer must not stay alive (~666MB of HBM)
-        # next to the flat copy + accum for the whole timed run
-        tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
-                         out_shardings=rowmajor, donate_argnums=0)(
-            params.pop("embedding_tables"))
-        accum = jax.jit(lambda t: jnp.full_like(t, acc0),
-                        out_shardings=rowmajor)(tables)
+    n_dense_params = params_count({k: v for k, v in params.items()
+                                   if k != "embedding_tables"})
+    jitted, dense_params, tables, accum, opt_state = build_sparse_training(
+        model, cfg, mesh, rules, params)
     del params
-    opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
-    opt_state = opt.init(dense_params)
-    try:  # UNSPECIFIED = "let XLA choose" (None would mean "replicate")
-        from jax._src.sharding_impls import UNSPECIFIED as _U
-    except ImportError:  # pragma: no cover - older/newer jax fallback
-        _U = None
-    jitted = jax.jit(make_sparse_dlrm_step(model, cfg, opt, lr=lr, eps=eps,
-                                           rules=rules),
-                     donate_argnums=(0, 1, 2, 3),
-                     in_shardings=(_U, rowmajor, rowmajor, _U, _U, _U, _U),
-                     out_shardings=(_U, rowmajor, rowmajor, _U, _U))
 
     def run(k):
         nonlocal dense_params, tables, accum, opt_state

@@ -61,31 +61,11 @@ def main():
     sparse_path = "--dense" not in sys.argv
     print(f"path: {'sparse rows (bench config)' if sparse_path else 'dense'}")
     if sparse_path:
-        # exactly benchmarks/dlrm.py's configuration, pinned layouts incl.
-        from jax.experimental.layout import Format, Layout
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from horovod_tpu.models.dlrm import make_sparse_dlrm_step
-        lr, eps, acc0 = 1e-2, 1e-7, 0.1
-        dense_params = {k: v for k, v in params.items()
-                        if k != "embedding_tables"}
-        nrows = cfg.num_tables * cfg.rows_per_table
-        rowmajor = Format(Layout((0, 1)), NamedSharding(mesh, P()))
-        tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
-                         out_shardings=rowmajor)(params["embedding_tables"])
-        accum = jax.jit(lambda t: jnp.full_like(t, acc0),
-                        out_shardings=rowmajor)(tables)
-        opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
-        opt_state = opt.init(dense_params)
-        try:
-            from jax._src.sharding_impls import UNSPECIFIED as _U
-        except ImportError:  # pragma: no cover
-            _U = None
-        jitted = jax.jit(
-            make_sparse_dlrm_step(model, cfg, opt, lr=lr, eps=eps),
-            donate_argnums=(0, 1, 2, 3),
-            in_shardings=(_U, rowmajor, rowmajor, _U, _U, _U, _U),
-            out_shardings=(_U, rowmajor, rowmajor, _U, _U))
+        # EXACTLY benchmarks/dlrm.py's program: shared setup helper
+        from dlrm_common import build_sparse_training
+        rules = rules_for_mesh(mesh, LOGICAL_RULES)
+        jitted, dense_params, tables, accum, opt_state = \
+            build_sparse_training(model, cfg, mesh, rules, params)
         state = (dense_params, tables, accum, opt_state)
 
         def once():

@@ -50,9 +50,30 @@ _ELEMENTWISE = {
 }
 
 
-def _adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+def _adasum_combine(a: np.ndarray, b: np.ndarray,
+                    segments: Optional[Sequence[int]] = None) -> np.ndarray:
     """Pairwise Adasum combine; same coefficient formula as
-    ops/fused.py:adasum_coefficients so host and device paths agree."""
+    ops/fused.py:adasum_coefficients so host and device paths agree.
+
+    ``segments`` (flat-buffer element counts, summing to ``a.size``)
+    makes the combine per-SEGMENT: each packed tensor gets its OWN
+    coefficient pair, so a fused gradient bucket reduces exactly like
+    per-tensor Adasum ops would (the reference runs Adasum on fused
+    buffers the same way — per-tensor dots inside the buffer,
+    ops/adasum/adasum.h)."""
+    if segments is not None:
+        if sum(segments) != a.size:
+            raise ValueError(
+                f"adasum segments {tuple(segments)} sum to "
+                f"{sum(segments)}, buffer has {a.size} elements — a "
+                "short sum would leave uninitialized tail values")
+        out = np.empty_like(a)
+        off = 0
+        for n in segments:
+            out[off:off + n] = _adasum_combine(a[off:off + n],
+                                               b[off:off + n])
+            off += n
+        return out
     af = a.astype(np.float64, copy=False)
     bf = b.astype(np.float64, copy=False)
     dot = float(np.vdot(af, bf))
@@ -63,7 +84,8 @@ def _adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return (ca * af + cb * bf).astype(a.dtype, copy=False)
 
 
-def _adasum_tree(chunks: List[np.ndarray]) -> np.ndarray:
+def _adasum_tree(chunks: List[np.ndarray],
+                 segments: Optional[Sequence[int]] = None) -> np.ndarray:
     """Recursive-halving combine over the rank dimension (reference:
     ops/adasum/adasum.h tree; collectives/adasum.py butterfly — identical
     result for power-of-two counts, graceful for any count here)."""
@@ -71,17 +93,24 @@ def _adasum_tree(chunks: List[np.ndarray]) -> np.ndarray:
     while len(xs) > 1:
         nxt = []
         for i in range(0, len(xs) - 1, 2):
-            nxt.append(_adasum_combine(xs[i], xs[i + 1]))
+            nxt.append(_adasum_combine(xs[i], xs[i + 1], segments))
         if len(xs) % 2:
             nxt.append(xs[-1])
         xs = nxt
     return xs[0]
 
 
-def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:
-    """Reduce per-rank arrays (joined ranks already excluded by caller)."""
+def reduce_arrays(arrays: Sequence[np.ndarray], op: str,
+                  segments: Optional[Sequence[int]] = None) -> np.ndarray:
+    """Reduce per-rank arrays (joined ranks already excluded by caller).
+    ``segments`` only affects Adasum (see :func:`_adasum_combine`);
+    elementwise ops are segment-invariant."""
     xs = np.stack([np.asarray(a) for a in arrays])
     if op == Adasum:
+        if segments is not None:
+            flat = _adasum_tree([xs[i].ravel() for i in range(xs.shape[0])],
+                                tuple(segments))
+            return flat.reshape(xs.shape[1:])
         return _adasum_tree([xs[i] for i in range(xs.shape[0])])
     if op not in _ELEMENTWISE:
         raise ValueError(f"unknown reduction op: {op!r}")
@@ -142,7 +171,10 @@ class CollectiveEngine:
     # process set: only members call, only members meet (reference
     # process_set.cc semantics). Engines that cannot form subgroups raise.
     def allreduce(self, name: str, arr: np.ndarray, op: str,
-                  members=None) -> np.ndarray:
+                  members=None, *,
+                  segments: Optional[Sequence[int]] = None) -> np.ndarray:
+        # ``segments``: flat-buffer element counts for fused Adasum (one
+        # coefficient pair per packed tensor); elementwise ops ignore it.
         raise NotImplementedError
 
     def allgather(self, name: str, arr: np.ndarray,
@@ -207,7 +239,7 @@ class SingleProcessEngine(CollectiveEngine):
     def size(self) -> int:
         return 1
 
-    def allreduce(self, name, arr, op, members=None):
+    def allreduce(self, name, arr, op, members=None, *, segments=None):
         self._check_member(members)
         if op == Adasum:  # combine with nothing = identity (tree of one)
             return np.array(arr, copy=True)
@@ -370,7 +402,7 @@ class ThreadSimEngine(CollectiveEngine):
 
     # -- collectives ---------------------------------------------------------
 
-    def allreduce(self, name, arr, op, members=None):
+    def allreduce(self, name, arr, op, members=None, *, segments=None):
         self._check_member(members)
 
         def compute(contrib, joined):
@@ -378,7 +410,7 @@ class ThreadSimEngine(CollectiveEngine):
             arrays = [contrib[r] for r in ranks]
             # Joined ranks contribute zeros; Average divides by the ACTIVE
             # count (reference join_allreduce semantics, collectives/join.py).
-            return reduce_arrays(arrays, op)
+            return reduce_arrays(arrays, op, segments)
         out = self._rv.run(f"allreduce.{name}", self.rank(),
                            np.asarray(arr), compute, members=members)
         return np.array(out, copy=True)
@@ -736,7 +768,11 @@ class JaxProcessEngine(CollectiveEngine):
                     return [dict(header, joined=False)] * k, payloads
             headers = self._gather_obj(header, members)
             active = [r for r, h in enumerate(headers) if not h["joined"]]
-            ops = {(h["kind"], h["name"], h.get("op"), h.get("root"))
+            # segments participate in the identity check: fused-Adasum
+            # ranks disagreeing on bucket layout must raise, not combine
+            # with mismatched per-tensor coefficients
+            ops = {(h["kind"], h["name"], h.get("op"), h.get("root"),
+                    h.get("segments"))
                    for h in headers if not h["joined"]}
             if len(ops) > 1:
                 raise RuntimeError(
@@ -867,14 +903,15 @@ class JaxProcessEngine(CollectiveEngine):
         self._sig_commit(sig)
         return len(active)
 
-    def allreduce(self, name, arr, op, members=None):
+    def allreduce(self, name, arr, op, members=None, *, segments=None):
         members = self._norm_members(members)
         arr = np.asarray(arr)
         if op == Adasum:
             # Adasum's pairwise tree reduction stays on the host gather
             # path (the combine is not an elementwise monoid XLA's
             # reduce lowers to).
-            return self._gather_allreduce(name, arr, op, members)
+            return self._gather_allreduce(name, arr, op, members,
+                                          segments=segments)
         flat = arr.reshape(1, -1)
         with self._lock:
             n_active = self._reduce_header_round("allreduce", name, flat, op,
@@ -884,21 +921,26 @@ class JaxProcessEngine(CollectiveEngine):
                 red = (red / n_active).astype(arr.dtype, copy=False)
             return red.reshape(arr.shape)
 
-    def _gather_allreduce(self, name, arr, op, members=None):
+    def _gather_allreduce(self, name, arr, op, members=None, *,
+                          segments=None):
         """The pre-r2 payload path (full N-way gather + host reduce): kept
         for Adasum and as the A/B baseline in benchmarks/torch_engine_bw.py
         — the device path's win is exactly this path's O(N*bytes) wire
-        cost."""
+        cost. ``segments`` (fused Adasum) rides the header AND the
+        signature, so ranks disagreeing on bucket layout fail the
+        mismatch check instead of combining mismatched coefficients."""
         arr = np.asarray(arr)
         flat = arr.reshape(1, -1)
+        seg = None if segments is None else tuple(int(s) for s in segments)
         headers, payloads = self._round(
-            self._header("allreduce", name, flat, {"op": op}), flat,
+            self._header("allreduce", name, flat,
+                         {"op": op, "segments": seg}), flat,
             members,
             sig=("gather", "allreduce", name, tuple(flat.shape),
-                 str(flat.dtype), op, members))
+                 str(flat.dtype), op, seg, members))
         arrays = [payloads[r][0] for r, h in enumerate(headers)
                   if not h["joined"] and len(payloads[r])]
-        return reduce_arrays(arrays, op).reshape(arr.shape)
+        return reduce_arrays(arrays, op, seg).reshape(arr.shape)
 
     def allgather(self, name, arr, members=None):
         members = self._norm_members(members)

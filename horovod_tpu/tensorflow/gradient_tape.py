@@ -246,15 +246,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          for g in grads]
             if bpps > 1:
                 if not tf.executing_eagerly():
-                    # The Python-side accumulate/skip branch would be
-                    # baked into the first trace (silent no-training);
-                    # fail loudly instead of diverging.
-                    raise NotImplementedError(
-                        "backward_passes_per_step > 1 requires eager "
-                        "apply_gradients in this build (a compiled "
-                        "model.fit traces the skip branch); use Keras 3's "
-                        "native gradient_accumulation_steps for compiled "
-                        "training loops")
+                    return self._hvd_apply_aggregated_graph(
+                        grads, hvars, *args, **kwargs)
                 acc = getattr(self, "_hvd_agg", None)
                 if acc is None:
                     acc = [None] * len(grads)
@@ -273,6 +266,57 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 if average_aggregated_gradients:
                     acc = [None if a is None else a / bpps for a in acc]
                 grads = acc
+            return self._hvd_reduce_apply(grads, hvars, *args, **kwargs)
+
+        def _hvd_apply_aggregated_graph(self, grads, hvars, *args,
+                                        **kwargs):
+            """bpps > 1 under tf.function: the reference's
+            ``gradient_aggregation.py`` pattern — tf.Variable
+            accumulators + a counter + a traced tf.cond between
+            accumulate-only and allreduce+apply, so the skip branch is
+            never baked into the trace. Every rank's counter advances
+            identically, so all ranks take the same branch and the
+            collectives inside the apply branch stay paired."""
+            accs = getattr(self, "_hvd_graph_acc", None)
+            if accs is None or len(accs) != len(grads):
+                # created at trace time, OUTSIDE the function graph
+                with tf.init_scope():
+                    accs = [None if g is None else
+                            tf.Variable(tf.zeros(v.shape, g.dtype),
+                                        trainable=False)
+                            for g, v in zip(grads, hvars)]
+                    counter = tf.Variable(0, dtype=tf.int64,
+                                          trainable=False)
+                self._hvd_graph_acc = accs
+                self._hvd_graph_counter = counter
+            counter = self._hvd_graph_counter
+            for a, g in zip(accs, grads):
+                if a is not None and g is not None:
+                    a.assign_add(g)
+            due = tf.equal(counter.assign_add(1) % bpps, 0)
+            me = self
+
+            def apply_branch():
+                agg = [None if a is None else
+                       (a.read_value() / bpps if average_aggregated_gradients
+                        else a.read_value())
+                       for a in accs]
+                me._hvd_reduce_apply(agg, hvars, *args, **kwargs)
+                for a in accs:
+                    if a is not None:
+                        a.assign(tf.zeros_like(a))
+                return tf.constant(0, tf.int64)
+
+            def skip_branch():
+                # Iteration-keyed LR schedules must see every batch
+                # (reference helper increments on skipped steps too).
+                me.iterations.assign_add(1)
+                return tf.constant(0, tf.int64)
+
+            tf.cond(due, apply_branch, skip_branch)
+            return None
+
+        def _hvd_reduce_apply(self, grads, hvars, *args, **kwargs):
             prefix = getattr(self, "_hvd_prefix", None)
             if prefix is None:
                 # Per-instance (see gradient() above): concurrent wrapped

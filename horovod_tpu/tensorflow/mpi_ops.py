@@ -225,10 +225,19 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
                       process_set: Optional[ProcessSet] = None):
+    """Allreduce a list of tensors as ONE logical op (reference
+    ``group_table.cc`` atomic groups): same-dtype tensors pack into
+    fusion buckets — one engine round per dtype bucket, not per tensor
+    (r4; previously a per-tensor loop costing O(tensors) negotiated
+    rounds). Rides the same packer as the gradient tape/optimizer."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    if not tensors:
+        return []
+    from .gradient_tape import _allreduce_grads
+    opname = _op_from_average(average, op)
     nm = _rt().autoname("grouped_allreduce", name)
-    return [allreduce(t, average, f"{nm}.{i}", compression, op,
-                      prescale_factor, postscale_factor, process_set)
-            for i, t in enumerate(tensors)]
+    return _allreduce_grads(tensors, opname, compression, prescale_factor,
+                            postscale_factor, process_set, nm)
 
 
 def allgather(tensor, name: Optional[str] = None,
@@ -243,11 +252,86 @@ def allgather(tensor, name: Optional[str] = None,
                    tensor)
 
 
+def _static_shapes(ts):
+    return all(t.shape.rank is not None
+               and not any(d is None for d in t.shape.as_list())
+               for t in ts)
+
+
+def _dtype_buckets(ts):
+    """Order-preserving {dtype name: [indices]} over a tensor list."""
+    buckets = {}
+    for i, t in enumerate(ts):
+        buckets.setdefault(t.dtype.name, []).append(i)
+    return buckets
+
+
+def _run_group_op(np_fn, ts, out_dtypes=None):
+    """Multi-tensor analog of :func:`_run_op`: one host callback for a
+    whole fused group, so the engine calls inside it stay in program
+    order on every rank."""
+    eng = _rt().engine
+    set_rank = getattr(eng, "set_rank", None)
+    my_rank = eng.rank() if set_rank is not None else None
+    dts = out_dtypes or [t.dtype for t in ts]
+    if tf.executing_eagerly():
+        return [tf.convert_to_tensor(np.asarray(o))
+                for o in np_fn(*[t.numpy() for t in ts])]
+
+    def body(*xs):
+        if set_rank is not None:
+            set_rank(my_rank)
+        return [tf.convert_to_tensor(np.asarray(o))
+                for o in np_fn(*[x.numpy() for x in xs])]
+
+    out = tf.py_function(body, ts, Tout=dts)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
 def grouped_allgather(tensors, name: Optional[str] = None,
                       process_set: Optional[ProcessSet] = None):
-    nm = _rt().autoname("grouped_allgather", name)
-    return [allgather(t, f"{nm}.{i}", process_set)
-            for i, t in enumerate(tensors)]
+    """Allgather a list of tensors as ONE logical op (r4): one small
+    fixed-size dims round + one ragged payload round per dtype bucket —
+    1 + #dtypes engine rounds instead of O(tensors)."""
+    ts = [tf.convert_to_tensor(t) for t in tensors]
+    if not ts:
+        return []
+    rt = _rt()
+    nm = rt.autoname("grouped_allgather", name)
+    m = _members(process_set)
+    if not _static_shapes(ts):
+        # dynamic shapes: per-tensor fallback (rare; same contract)
+        return [allgather(t, f"{nm}.{i}", process_set)
+                for i, t in enumerate(ts)]
+    world = len(process_set.ranks) if m is not None else rt.engine.size()
+    buckets = _dtype_buckets(ts)
+    rests = [tuple(t.shape.as_list()[1:]) for t in ts]
+    rowsz = [int(np.prod(r)) if r else 1 for r in rests]
+    eng = rt.engine
+
+    def np_fused(*arrs):
+        dims = np.asarray([a.shape[0] for a in arrs], np.int64)
+        gdims = eng.allgather(f"{nm}.dims", dims, members=m) \
+            .reshape(world, len(arrs))
+        outs = [None] * len(arrs)
+        for dt, idxs in buckets.items():
+            packed = np.concatenate(
+                [arrs[i].ravel() for i in idxs]) if idxs else None
+            g = eng.allgather(f"{nm}.fused.{dt}", packed, members=m)
+            pieces = {i: [] for i in idxs}
+            off = 0
+            for r in range(world):
+                for i in idxs:
+                    ln = int(gdims[r, i]) * rowsz[i]
+                    pieces[i].append(
+                        g[off:off + ln].reshape((int(gdims[r, i]),)
+                                                + rests[i]))
+                    off += ln
+            for i in idxs:
+                outs[i] = np.concatenate(pieces[i], axis=0)
+        return outs
+
+    return _run_group_op(np_fused, ts)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
@@ -320,9 +404,50 @@ def reducescatter(tensor, op: str = Sum, name: Optional[str] = None,
 def grouped_reducescatter(tensors, op: str = Sum,
                           name: Optional[str] = None,
                           process_set: Optional[ProcessSet] = None):
-    nm = _rt().autoname("grouped_reducescatter", name)
-    return [reducescatter(t, op, f"{nm}.{i}", process_set)
-            for i, t in enumerate(tensors)]
+    """Reducescatter a list of tensors as ONE logical op (r4): tensors
+    repack into a [world, seglen] buffer whose rank-r row holds every
+    tensor's rank-r chunk — one engine round per dtype bucket, same
+    wire bytes as the per-tensor ops."""
+    ts = [tf.convert_to_tensor(t) for t in tensors]
+    if not ts:
+        return []
+    rt = _rt()
+    nm = rt.autoname("grouped_reducescatter", name)
+    m = _members(process_set)
+    world = len(process_set.ranks) if m is not None else rt.engine.size()
+    if not _static_shapes(ts) or any(
+            t.shape.as_list()[0] % world for t in ts):
+        # dynamic shapes (rare), or an indivisible dim0 — per-tensor
+        # fallback so the engine's own divisibility error fires with the
+        # offending tensor's op name
+        return [reducescatter(t, op, f"{nm}.{i}", process_set)
+                for i, t in enumerate(ts)]
+    buckets = _dtype_buckets(ts)
+    rests = [tuple(t.shape.as_list()[1:]) for t in ts]
+    chunks = [t.shape.as_list()[0] // world for t in ts]
+    eng = rt.engine
+
+    def np_fused(*arrs):
+        outs = [None] * len(arrs)
+        for dt, idxs in buckets.items():
+            packed = np.stack([
+                np.concatenate([arrs[i][r * chunks[i]:
+                                        (r + 1) * chunks[i]].ravel()
+                                for i in idxs])
+                for r in range(world)])               # [world, seglen]
+            red = eng.reducescatter(f"{nm}.fused.{dt}", packed, op,
+                                    members=m)        # [1, seglen]
+            seg = np.asarray(red).ravel()
+            off = 0
+            for i in idxs:
+                ln = chunks[i] * (int(np.prod(rests[i])) if rests[i]
+                                  else 1)
+                outs[i] = seg[off:off + ln].reshape((chunks[i],)
+                                                    + rests[i])
+                off += ln
+        return outs
+
+    return _run_group_op(np_fused, ts)
 
 
 def join(device: str = "") -> int:

@@ -239,7 +239,7 @@ def _allreduce_impl(tensor: torch.Tensor, op: str, name: Optional[str],
                     compression, prescale_factor: float,
                     postscale_factor: float,
                     output: Optional[torch.Tensor],
-                    members=None) -> torch.Tensor:
+                    members=None, segments=None) -> torch.Tensor:
     rt = _rt()
     compressed, ctx = compression.compress(tensor)
     arr = _to_np(compressed)
@@ -247,7 +247,11 @@ def _allreduce_impl(tensor: torch.Tensor, op: str, name: Optional[str],
         # keep the WIRE dtype: ml_dtypes.bfloat16 * python float promotes
         # to float32, silently doubling the compressed payload
         arr = (arr * prescale_factor).astype(arr.dtype)
-    out = rt.engine.allreduce(name, arr, op, members=members)
+    # pass segments only when set: engine subclasses predating the fused
+    # Adasum metadata (tests, user fakes) keep working untouched
+    out = rt.engine.allreduce(name, arr, op, members=members,
+                              **({} if segments is None
+                                 else {"segments": segments}))
     if postscale_factor != 1.0:
         out = out * postscale_factor
     res = compression.decompress(_from_np(out, compressed), ctx)
@@ -352,11 +356,15 @@ def allreduce_fused_async_(tensors, op: str = Average,
     to O(buckets)."""
     rt = _rt()
     m = _members(process_set)
+    # Fused Adasum applies each tensor's OWN coefficient pair inside the
+    # buffer (reference ops/adasum/adasum.h fused-buffer design): the
+    # per-tensor segment boundaries ride the submission to the engine.
+    segments = tuple(t.numel() for t in tensors) if op == Adasum else None
 
     def run(nm):
         flat = torch.cat([t.detach().reshape(-1) for t in tensors])
         res = _allreduce_impl(flat, op, nm, compression, prescale_factor,
-                              postscale_factor, None, m)
+                              postscale_factor, None, m, segments)
         off = 0
         for t in tensors:
             n = t.numel()

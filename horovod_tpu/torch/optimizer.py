@@ -100,10 +100,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         """Fusion buckets are packed in ``synchronize()`` from the full due
         set, so fusion ALSO defers (on every engine — bucket contents and
         names are canonical-order-deterministic, which is what name-keyed
-        rendezvous needs too). Adasum stays per-parameter (its coefficients
-        are per-tensor dot products; fusing would change the math —
-        reference runs Adasum on fused buffers but scales each tensor by
-        its own coefficients, which our engines apply per op).
+        rendezvous needs too). Adasum buckets too (r4): its per-tensor
+        coefficients are applied INSIDE the fused buffer via segment
+        boundaries riding the submission — the reference's
+        fused-buffer-with-per-tensor-scaling design (ops/adasum/adasum.h).
 
         Resolved once per step (``synchronize()`` clears the latch), not
         once per hook fire — threshold resolution walks the config chain,
@@ -111,8 +111,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._defer_cached is None:
             self._defer_cached = (
                 self._ordered_engine
-                or (self._fusion_threshold_bytes() > 0
-                    and self._op != Adasum))
+                or self._fusion_threshold_bytes() > 0)
         return self._defer_cached
 
     def _make_hook(self):
@@ -269,11 +268,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         ``HOROVOD_FUSION_THRESHOLD`` and each bucket rides ONE fused
         engine allreduce (reference fusion_buffer_manager.cc /
         parameter_manager.cc tensor fusion — the mechanism that collapses
-        the P-parameter hot path to O(buckets) collectives per step).
-        Sparse gradients and Adasum keep their per-parameter ops, in the
-        same canonical positions on every rank."""
+        the P-parameter hot path to O(buckets) collectives per step) —
+        including ``op=Adasum`` (r4: per-tensor coefficients inside the
+        bucket via segment metadata). Sparse gradients keep their
+        per-parameter ops, in the same canonical positions on every
+        rank."""
         threshold = self._fusion_threshold_bytes()
-        fuse = threshold > 0 and self._op != Adasum
+        fuse = threshold > 0
         buckets: dict = {}      # dtype key -> [params, bytes]
         bucket_seq: dict = {}   # dtype key -> next bucket index
 

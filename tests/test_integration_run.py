@@ -377,11 +377,26 @@ hist = m.fit(xk, yk, batch_size=32, epochs=3, verbose=0,
              callbacks=[BroadcastGlobalVariablesCallback(0)])
 fit_w = m.get_weights()[0].ravel().tolist()
 
+# backward_passes_per_step=2 in the SAME compiled model.fit path (r4:
+# graph-mode aggregation — accumulators + traced tf.cond): trains
+# correctly and ranks converge identically.
+m2 = keras.Sequential([keras.layers.Dense(1, use_bias=False)])
+m2.build((None, 2))
+m2.set_weights([np.full((2, 1), float(hvd.rank() + 1), np.float32)])
+opt2 = hvd.DistributedOptimizer(keras.optimizers.SGD(0.05),
+                                backward_passes_per_step=2)
+m2.compile(optimizer=opt2, loss="mse")
+hist2 = m2.fit(xk, yk, batch_size=16, epochs=4, verbose=0,
+               callbacks=[BroadcastGlobalVariablesCallback(0)])
+bpps_w = m2.get_weights()[0].ravel().tolist()
+
 print(json.dumps({"rank": hvd.rank(), "graph": out.tolist(),
                   "bcast": np.asarray(v).tolist(),
                   "grad": np.asarray(g).tolist(),
                   "fit_w": fit_w, "fit_improved":
-                  hist.history["loss"][-1] < hist.history["loss"][0]}))
+                  hist.history["loss"][-1] < hist.history["loss"][0],
+                  "bpps_w": bpps_w, "bpps_improved":
+                  hist2.history["loss"][-1] < hist2.history["loss"][0]}))
 """
 
 
@@ -403,5 +418,7 @@ def test_hvdrun_tensorflow_binding(tmp_path):
         assert out["bcast"] == [1.0, 1.0]   # root 1's value
         assert out["grad"] == [1.5]         # mean of 1 and 2
         assert out["fit_improved"], out     # compiled fit trains
+        assert out["bpps_improved"], out    # graph-mode bpps=2 trains
     # both ranks converge to IDENTICAL weights (broadcast + allreduce)
     assert lines[0]["fit_w"] == lines[1]["fit_w"], lines
+    assert lines[0]["bpps_w"] == lines[1]["bpps_w"], lines

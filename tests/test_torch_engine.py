@@ -13,8 +13,8 @@ import threading
 import numpy as np
 import pytest
 
-from horovod_tpu.torch.engine import (Average, JaxProcessEngine, Sum,
-                                      ThreadSimEngine)
+from horovod_tpu.torch.engine import (Adasum, Average, JaxProcessEngine,
+                                      Sum, ThreadSimEngine)
 
 
 class _Bus:
@@ -427,6 +427,51 @@ def test_cache_verify_every_reverifies(monkeypatch):
 
     for counts in _run_counting(2, fn):
         assert counts == [3, 1, 3, 1], counts
+
+
+def test_cache_fused_adasum_bucket_steady_state(monkeypatch):
+    """VERDICT r3 #4: fused Adasum buckets (segments metadata) ride the
+    signature cache too — steady state is one mini round per bucket op —
+    and per-segment coefficients apply (each packed tensor combines with
+    its OWN Adasum coefficients, bit-identical to per-tensor ops)."""
+    _pin_cache(monkeypatch)
+    a0 = np.array([1.0, 0.0, 3.0], np.float32)   # tensor A, 3 elements
+    b0 = np.array([2.0, 2.0], np.float32)        # tensor B, 2 elements
+
+    def fn(eng, r):
+        # rank 1 contributes different values
+        a = a0 * (r + 1)
+        b = b0 if r == 0 else np.array([-2.0, 2.0], np.float32)
+        flat = np.concatenate([a, b])
+        counts, outs = [], []
+        for _ in range(3):
+            before = eng.host_rounds
+            outs.append(eng.allreduce("adasum_bucket", flat, Adasum,
+                                      segments=(3, 2)))
+            counts.append(eng.host_rounds - before)
+        return counts, outs[-1]
+
+    from horovod_tpu.core.engine import _adasum_combine
+    expect_a = _adasum_combine(a0, a0 * 2)
+    expect_b = _adasum_combine(b0, np.array([-2.0, 2.0], np.float32))
+    for counts, out in _run_counting(2, fn):
+        # Adasum rides the gather payload path (host tree combine), so
+        # steady state is mini + 2 payload gathers — the header round's
+        # 2 gathers are what the cache removes (same shape as the
+        # allgather steady state: 5 first, 3 after).
+        assert counts == [5, 3, 3], counts
+        np.testing.assert_array_equal(out[:3], expect_a)
+        np.testing.assert_array_equal(out[3:], expect_b)
+
+    # differing segment layouts across ranks must NOT silently combine:
+    def bad(eng, r):
+        flat = np.ones(5, np.float32)
+        with pytest.raises(RuntimeError):
+            eng.allreduce("seg_mismatch", flat, Adasum,
+                          segments=(3, 2) if r == 0 else (2, 3))
+        return True
+
+    assert all(_run_counting(2, bad))
 
 
 def test_cache_capacity_one_perpetual_evict_refill(monkeypatch):
