@@ -63,7 +63,6 @@ def main():
     if sparse_path:
         # EXACTLY benchmarks/dlrm.py's program: shared setup helper
         from dlrm_common import build_sparse_training
-        rules = rules_for_mesh(mesh, LOGICAL_RULES)
         jitted, dense_params, tables, accum, opt_state = \
             build_sparse_training(model, cfg, mesh, rules, params)
         state = (dense_params, tables, accum, opt_state)

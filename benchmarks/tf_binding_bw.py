@@ -1,0 +1,125 @@
+"""TF binding host-boundary cost: compiled ``model.fit`` step time with
+the hvd DistributedOptimizer (py_function + numpy engine crossing per
+bucket) vs plain Keras, and bucketed vs per-tensor reduction.
+
+VERDICT r3 #7: the torch engine got a dedicated payload-path A/B
+(``torch_engine_bw.py``); this is the analog for the newest surface.
+The launcher runs three cases over the SAME model/batch/steps:
+
+  plain      — 1-process Keras model.fit, no binding (the floor)
+  fused      — 2-process `hvdrun` model.fit, DistributedOptimizer with
+               the default fusion threshold (one engine round per
+               dtype bucket per step)
+  per_tensor — same but HOROVOD_FUSION_THRESHOLD=0 (one engine round
+               per gradient per step)
+
+Prints ONE JSON line: per-step times + overhead ratios. The binding
+work runs on CPU either way (keras here has no TPU device), so the
+ratio isolates the host/py_function/engine boundary, not device math.
+
+Usage:  python benchmarks/tf_binding_bw.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_root = os.path.dirname(_here)
+
+STEPS = 30
+BATCH = 256
+DIMS = (256, 1024, 1024, 256)
+
+_WORKER = """
+import json, os, sys, time
+import numpy as np
+import tensorflow as tf
+import horovod_tpu as hvdj
+hvdj.init()
+import horovod_tpu.tensorflow as hvd
+import keras
+hvd.init()
+STEPS = %(steps)d
+rng = np.random.RandomState(0)
+X = rng.randn(%(batch)d, %(d0)d).astype(np.float32)
+y = rng.randn(%(batch)d).astype(np.float32)
+model = keras.Sequential(
+    [keras.layers.Dense(d, activation="relu") for d in %(dims)s[1:]]
+    + [keras.layers.Dense(1)])
+opt = hvd.DistributedOptimizer(keras.optimizers.SGD(0.01))
+model.compile(optimizer=opt, loss="mse")
+model.fit(X, y, batch_size=%(batch)d, epochs=2, verbose=0)  # warm/trace
+t0 = time.perf_counter()
+model.fit(X, y, batch_size=%(batch)d, epochs=STEPS, verbose=0)
+dt = (time.perf_counter() - t0) / STEPS
+if hvd.rank() == 0:
+    print("STEP_MS", dt * 1e3, flush=True)
+"""
+
+
+def run_hvd_case(threshold=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    # workers run the script from a tmp dir: the repo must be importable
+    env["PYTHONPATH"] = _root + (os.pathsep + env["PYTHONPATH"]
+                                 if env.get("PYTHONPATH") else "")
+    if threshold is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(threshold)
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "w.py")
+        with open(script, "w") as f:
+            f.write(_WORKER % {"steps": STEPS, "batch": BATCH,
+                               "d0": DIMS[0], "dims": repr(list(DIMS))})
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+             "-H", "localhost:1,127.0.0.1:1", sys.executable, script],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=_root)
+    if r.returncode != 0:
+        raise RuntimeError(f"worker failed:\n{r.stdout[-2000:]}\n"
+                           f"{r.stderr[-2000:]}")
+    for line in r.stdout.splitlines():
+        if line.startswith("STEP_MS"):
+            return float(line.split()[1])
+    raise RuntimeError(f"no STEP_MS in output:\n{r.stdout[-2000:]}")
+
+
+def run_plain():
+    import numpy as np
+    import keras
+    rng = np.random.RandomState(0)
+    X = rng.randn(BATCH, DIMS[0]).astype(np.float32)
+    y = rng.randn(BATCH).astype(np.float32)
+    model = keras.Sequential(
+        [keras.layers.Dense(d, activation="relu") for d in DIMS[1:]]
+        + [keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(0.01), loss="mse")
+    model.fit(X, y, batch_size=BATCH, epochs=2, verbose=0)
+    t0 = time.perf_counter()
+    model.fit(X, y, batch_size=BATCH, epochs=STEPS, verbose=0)
+    return (time.perf_counter() - t0) / STEPS * 1e3
+
+
+def main():
+    plain_ms = run_plain()
+    fused_ms = run_hvd_case()
+    per_tensor_ms = run_hvd_case(threshold=0)
+    print(json.dumps({
+        "metric": "tf_binding_fit_step_overhead",
+        "plain_ms": round(plain_ms, 2),
+        "fused_ms": round(fused_ms, 2),
+        "per_tensor_ms": round(per_tensor_ms, 2),
+        "overhead_vs_plain": round(fused_ms / plain_ms, 3),
+        "fused_speedup_vs_per_tensor": round(per_tensor_ms / fused_ms, 3),
+        "unit": f"ms/step (2-process model.fit, batch {BATCH}, "
+                f"MLP {'x'.join(map(str, DIMS))})",
+    }))
+
+
+if __name__ == "__main__":
+    main()

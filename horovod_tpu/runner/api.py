@@ -18,37 +18,106 @@ from .hosts import get_host_assignments, parse_hosts
 from .settings import Settings
 
 
+#: env-transport ceiling for the cloudpickled function: Linux caps one env
+#: string at 128 KiB (MAX_ARG_STRLEN) and the whole wire env rides one ssh
+#: command line, so leave generous headroom for the rest of the env.
+_ENV_FN_LIMIT = 96 * 1024
+
+
+def _fetch_remote_results(hostname: str, path: str,
+                          settings: Settings) -> Optional[bytes]:
+    """Pull the rank-0 results blob off a remote host over the launcher's
+    existing ssh channel (``ssh <host> cat <path>``) — the reference
+    returns results over its driver/task RPC; the ssh fetch is that
+    channel's role here. Cleans the remote blob up after a successful
+    read; any transport failure (hung connection, missing ssh binary)
+    degrades to ``None`` so the caller raises its normal worker-failure
+    error instead of a raw subprocess traceback."""
+    import shlex
+    import subprocess
+
+    from .exec_run import ssh_base_command
+    base = ssh_base_command(settings) + [hostname]
+    try:
+        r = subprocess.run(base + [f"cat {shlex.quote(path)}"],
+                           capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return None
+        subprocess.run(base + [f"rm -rf {shlex.quote(os.path.dirname(path))}"],
+                       capture_output=True, timeout=60)
+        return r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         np: int = 1, hosts: Optional[str] = None,
         settings: Optional[Settings] = None,
         verbose: int = 0) -> List[Any]:
     """Run ``fn(*args, **kwargs)`` on every host process; returns the list
     of per-process results (index == process id). Raises RuntimeError if
-    any worker fails, like the reference."""
+    any worker fails, like the reference.
+
+    Multi-host (r4; reference ``horovod.run()`` ships the pickled fn to
+    remote hosts over its driver/task services): when any host is
+    non-local — or ``HOROVOD_RUN_REMOTE_TRANSPORT=1`` forces it — the
+    cloudpickled function travels in the (ssh-forwarded, HMAC-covered
+    settings) environment, workers allgather their results over the
+    engine so rank 0 holds all of them, rank 0 writes ONE results blob,
+    and the launcher reads it locally or fetches it over ssh.
+    """
     import cloudpickle
     s = settings or Settings(num_proc=np, verbose=verbose)
     hs = parse_hosts(hosts) if hosts else parse_hosts(f"localhost:{np}")
     assignments = get_host_assignments(hs, np)
-    if any(not is_local(a.hostname) for a in assignments):
-        # The pickled-fn/results handshake runs over a launcher-local tmp
-        # dir; remote hosts would need a shared FS plus a remote
-        # coordinator. Launch remote jobs as commands via the CLI
-        # (hvdrun), whose workers carry their own entrypoint.
-        raise NotImplementedError(
-            "runner.run() is single-host (function transport uses a local "
-            "tmp dir); use `python -m horovod_tpu.runner` for multi-host")
+    remote = any(not is_local(a.hostname) for a in assignments)
+    use_env_fn = remote or os.environ.get(
+        "HOROVOD_RUN_REMOTE_TRANSPORT", "") == "1"
+    blob = cloudpickle.dumps((fn, args, kwargs or {}))
     with tempfile.TemporaryDirectory(prefix="hvd_run_") as tmp:
-        fn_path = os.path.join(tmp, "fn.pkl")
-        with open(fn_path, "wb") as f:
-            cloudpickle.dump((fn, args, kwargs or {}), f)
-        command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
-                   fn_path, tmp]
+        if use_env_fn:
+            import base64
+            b64 = base64.b64encode(blob).decode()
+            if len(b64) > _ENV_FN_LIMIT:
+                raise RuntimeError(
+                    f"runner.run(): the pickled function "
+                    f"({len(b64)} bytes base64) exceeds the multi-host env "
+                    f"transport limit ({_ENV_FN_LIMIT}); ship large "
+                    "closures via a shared filesystem and the CLI "
+                    "(`python -m horovod_tpu.runner`) instead")
+            import dataclasses
+            s = dataclasses.replace(s, env=dict(s.env or {}))
+            s.env["HOROVOD_RUN_FUNC_B64"] = b64
+            s.env["HOROVOD_RUN_RESULTS_DIR"] = tmp
+            command = [sys.executable, "-m",
+                       "horovod_tpu.runner.run_task"]
+        else:
+            fn_path = os.path.join(tmp, "fn.pkl")
+            with open(fn_path, "wb") as f:
+                f.write(blob)
+            command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
+                       fn_path, tmp]
         code = launch_job(assignments, command, s,
                           coordinator_addr=default_coordinator_addr(
                               assignments, s),
                           secret_key=secret.make_secret_key())
 
+        all_results = None
+        if use_env_fn:
+            all_path = os.path.join(tmp, "results.all.pkl")
+            raw = None
+            if os.path.exists(all_path):
+                with open(all_path, "rb") as f:
+                    raw = f.read()
+            elif not is_local(assignments[0].hostname):
+                raw = _fetch_remote_results(assignments[0].hostname,
+                                            all_path, s)
+            if raw is not None:
+                all_results = cloudpickle.loads(raw)
+
         def load_result(a):
+            if all_results is not None:
+                return all_results[a.process_id]
             path = os.path.join(tmp, f"result.{a.process_id}.pkl")
             if not os.path.exists(path):
                 return 1, None

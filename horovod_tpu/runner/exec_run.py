@@ -95,17 +95,17 @@ def quoted_env_assignments(env: Dict[str, str],
     return " ".join(f"{k}={shlex.quote(env[k])}" for k in ks if k in env)
 
 
-def get_ssh_command(a: HostAssignment, command: Sequence[str],
-                    env: Dict[str, str], settings: Settings,
-                    cwd: Optional[str] = None,
-                    secret_on_stdin: bool = False) -> str:
-    """Build the ssh line for a remote host (reference: gloo_run.py
-    _exec_command_fn). Returned as a string for assertion-style tests.
+#: env keys that must never ride the ssh command line (visible in
+#: ``ps``/``/proc/*/cmdline`` on both hosts) — delivered over stdin like
+#: the HMAC secret. HOROVOD_RUN_FUNC_B64 is the cloudpickled user
+#: function for runner.run()'s multi-host mode: its closure may capture
+#: credentials.
+STDIN_ENV_KEYS = ("HOROVOD_RUN_FUNC_B64",)
 
-    ``secret_on_stdin``: the remote shell reads ``HOROVOD_SECRET_KEY`` from
-    its stdin (the launcher writes it via ``execute(stdin_data=...)``) so
-    the key never appears in ``ps``/``/proc/*/cmdline`` on either side.
-    """
+
+def ssh_base_command(settings: Settings) -> List[str]:
+    """The launcher's ssh invocation prefix — ONE definition shared by
+    the worker launch and the results fetch (``runner.api``)."""
     ssh = ["ssh", "-o", "PasswordAuthentication=no",
            "-o", "StrictHostKeyChecking=no"]
     if settings.ssh_port:
@@ -114,6 +114,29 @@ def get_ssh_command(a: HostAssignment, command: Sequence[str],
         ssh += ["-i", settings.ssh_identity_file]
     if settings.extra_ssh_args:
         ssh += settings.extra_ssh_args.split()
+    return ssh
+
+
+def stdin_env_lines(env: Dict[str, str]) -> List[str]:
+    """Values the remote shell reads from stdin, in the FIXED order
+    matching :func:`get_ssh_command`'s read sequence."""
+    return [env[k] for k in STDIN_ENV_KEYS if k in env]
+
+
+def get_ssh_command(a: HostAssignment, command: Sequence[str],
+                    env: Dict[str, str], settings: Settings,
+                    cwd: Optional[str] = None,
+                    secret_on_stdin: bool = False) -> str:
+    """Build the ssh line for a remote host (reference: gloo_run.py
+    _exec_command_fn). Returned as a string for assertion-style tests.
+
+    ``secret_on_stdin``: the remote shell reads ``HOROVOD_SECRET_KEY``
+    from its stdin (the launcher writes it via ``execute(stdin_data=...)``)
+    so the key never appears in ``ps``/``/proc/*/cmdline`` on either side;
+    any ``STDIN_ENV_KEYS`` present in the env follow on later stdin lines
+    for the same reason.
+    """
+    ssh = ssh_base_command(settings)
     ssh.append(a.hostname)
     inner = ""
     if cwd:
@@ -121,13 +144,16 @@ def get_ssh_command(a: HostAssignment, command: Sequence[str],
     if secret_on_stdin:
         inner += "IFS= read -r HOROVOD_SECRET_KEY && " \
                  "export HOROVOD_SECRET_KEY && "
+    for k in STDIN_ENV_KEYS:
+        if k in env:
+            inner += f"IFS= read -r {k} && export {k} && "
     # Launcher-owned env goes over the wire: forwarded prefixes plus every
     # key the user put in Settings.env (same set a local worker receives);
-    # the remote shell keeps its own PATH/HOME. The secret travels on
-    # stdin, never inline.
+    # the remote shell keeps its own PATH/HOME. The secret and
+    # STDIN_ENV_KEYS travel on stdin, never inline.
     wire_env = {k: v for k, v in env.items()
                 if (k.startswith(FORWARD_PREFIXES) or k in settings.env)
-                and k != secret.ENV_VAR}
+                and k != secret.ENV_VAR and k not in STDIN_ENV_KEYS}
     inner += f"env {quoted_env_assignments(wire_env)} "
     inner += " ".join(shlex.quote(c) for c in command)
     return " ".join(ssh) + " " + shlex.quote(inner)
@@ -232,14 +258,18 @@ def run_host_process(a: HostAssignment, command: Sequence[str],
             line = get_ssh_command(a, command, env, settings,
                                    cwd=os.getcwd(),
                                    secret_on_stdin=secret_key is not None)
+            stdin_lines = ([secret.encode(secret_key)]
+                           if secret_key is not None else [])
+            stdin_lines += stdin_env_lines(env)
             return execute(line, env=dict(os.environ), stdout=out,
                            stderr=err,
                            prefix=str(a.process_id) if settings.verbose
                            else None,
                            events=[stop],
-                           stdin_data=(secret.encode(secret_key)
-                                       + "\n").encode()
-                           if secret_key is not None else None)
+                           stdin_data=("".join(ln + "\n"
+                                               for ln in stdin_lines)
+                                       .encode()
+                                       if stdin_lines else None))
         finally:
             for f in opened:
                 f.close()

