@@ -1,52 +1,7 @@
-"""Shared sparse-DLRM training setup for benchmarks/dlrm.py AND
-benchmarks/profile_dlrm.py — ONE definition of the flat tables, pinned
-row-major layouts, donation, and jitted step, so the profiler measures
-exactly the program the bench times (they had already drifted once when
-this was two hand-maintained copies)."""
+"""Compat shim: the shared sparse-DLRM setup moved INTO the package
+(`horovod_tpu.models.dlrm.build_sparse_training`) so the user-facing
+example can reuse it too — one definition of the flat tables, pinned
+row-major layouts, and donation for the bench, the profiler AND
+`examples/train_dlrm.py`."""
 
-import jax
-import jax.numpy as jnp
-import optax
-from jax.experimental.layout import Format, Layout
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-try:  # UNSPECIFIED = "let XLA choose" (None would mean "replicate")
-    from jax._src.sharding_impls import UNSPECIFIED as _U
-except ImportError:  # pragma: no cover - older/newer jax fallback
-    _U = None
-
-
-def build_sparse_training(model, cfg, mesh, rules, params, *,
-                          lr: float = 1e-2, eps: float = 1e-7,
-                          acc0: float = 0.1):
-    """(jitted_step, dense_params, tables, accum, opt_state).
-
-    ``params`` is the unboxed full param tree; its embedding_tables
-    buffer is DONATED into the flat [T*R, D] copy (must not stay alive
-    next to the flat tables + accum). Tables/accum jit params carry a
-    pinned row-major layout — XLA's entry-layout heuristic otherwise
-    transposes the full tables around the row scatters
-    (4 x ~666MB copies/step; docs/benchmarks.md r4 DLRM section).
-    """
-    from horovod_tpu.models.dlrm import make_sparse_dlrm_step
-
-    dense_params = {k: v for k, v in params.items()
-                    if k != "embedding_tables"}
-    nrows = cfg.num_tables * cfg.rows_per_table
-    rowmajor = Format(Layout((0, 1)),
-                      NamedSharding(mesh, P("ep") if "ep" in
-                                    mesh.axis_names else P()))
-    with jax.sharding.set_mesh(mesh):
-        tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
-                         out_shardings=rowmajor, donate_argnums=0)(
-            params.pop("embedding_tables"))
-        accum = jax.jit(lambda t: jnp.full_like(t, acc0),
-                        out_shardings=rowmajor)(tables)
-    opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
-    opt_state = opt.init(dense_params)
-    jitted = jax.jit(make_sparse_dlrm_step(model, cfg, opt, lr=lr, eps=eps,
-                                           rules=rules),
-                     donate_argnums=(0, 1, 2, 3),
-                     in_shardings=(_U, rowmajor, rowmajor, _U, _U, _U, _U),
-                     out_shardings=(_U, rowmajor, rowmajor, _U, _U))
-    return jitted, dense_params, tables, accum, opt_state
+from horovod_tpu.models.dlrm import build_sparse_training  # noqa: F401

@@ -54,6 +54,12 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--sparse-embeddings", action="store_true",
+                   help="sparse row-Adagrad for the tables (the "
+                        "reference's sparse-gradient DLRM semantics; "
+                        "numerically identical to dense Adagrad, ~2x "
+                        "faster at the criteo config — see "
+                        "docs/benchmarks.md r4)")
     args = p.parse_args()
 
     hvd.init()
@@ -67,7 +73,6 @@ def main():
 
     cfg = MODELS[args.model]()
     model = DLRM(cfg)
-    opt = optax.adagrad(args.lr)
 
     rng = np.random.RandomState(0)
     dense = jnp.asarray(rng.randn(args.batch_size, cfg.dense_features)
@@ -91,32 +96,54 @@ def main():
         params = jax.jit(init_all, out_shardings=sharding)(
             jax.random.PRNGKey(0))
     params = nn.meta.unbox(params)
-    opt_state = opt.init(params)
 
-    def step(params, opt_state, d, s, y):
-        def loss_of(p):
-            with nn_partitioning.axis_rules(rules):
-                out = model.apply({"params": p}, d, s)
-            return bce_loss(out, y)
-        loss, grads = jax.value_and_grad(loss_of)(params)
-        updates, opt_state2 = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state2, loss
+    if args.sparse_embeddings:
+        # the SHARED setup (pinned row-major table layouts + donation) —
+        # hand-rolling this path loses ~2x to XLA's entry-layout
+        # transposes (docs/benchmarks.md r4 DLRM section)
+        from horovod_tpu.models.dlrm import build_sparse_training
+        sparse_step, dense_params, tables, accum, opt_state = \
+            build_sparse_training(model, cfg, mesh, rules, params,
+                                  lr=args.lr)
+        state = [dense_params, tables, accum, opt_state]
 
-    jitted = jax.jit(step, donate_argnums=(0, 1))
+        def run_one(d, s, y):
+            out = sparse_step(state[0], state[1], state[2], state[3],
+                              d, s, y)
+            state[:] = out[:4]
+            return out[4]
+    else:
+        opt = optax.adagrad(args.lr)
+        opt_state = opt.init(params)
+
+        def step(params, opt_state, d, s, y):
+            def loss_of(p):
+                with nn_partitioning.axis_rules(rules):
+                    out = model.apply({"params": p}, d, s)
+                return bce_loss(out, y)
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            updates, opt_state2 = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        state = [params, opt_state]
+
+        def run_one(d, s, y):
+            out = jitted(state[0], state[1], d, s, y)
+            state[:] = out[:2]
+            return out[2]
 
     print(f"mesh dp={dp} ep={ep} tables={cfg.num_tables}x"
           f"{cfg.rows_per_table} platform={jax.devices()[0].platform}")
     with jax.sharding.set_mesh(mesh):
+        loss = None
         for _ in range(args.warmup):
-            params2, opt_state2, loss = jitted(params, opt_state, dense,
-                                               sparse, labels)
-            params, opt_state = params2, opt_state2
+            loss = run_one(dense, sparse, labels)
         if args.warmup:
             float(np.asarray(loss))
         t0 = time.perf_counter()
         for _ in range(args.steps):
-            params, opt_state, loss = jitted(params, opt_state, dense,
-                                             sparse, labels)
+            loss = run_one(dense, sparse, labels)
         final_loss = float(np.asarray(loss))
     dt = time.perf_counter() - t0
     eps = args.batch_size * args.steps / dt

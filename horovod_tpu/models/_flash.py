@@ -10,6 +10,12 @@ import jax
 # grows with sequence length (~5x fwd+bwd at T=4096, D=64). Below ~512
 # tokens the grid is too small to amortise kernel overhead. Ring attention
 # calls the kernel explicitly with residuals, bypassing this heuristic.
+#
+# The 512 boundary is grid-size sensitive, not universal (r4): Mixtral's
+# 8-head seq-512 config measured materialised 6.5% FASTER (half BERT's
+# heads = half the grid), while seq 1024 favors flash by 21% even at 8
+# heads. Models near the boundary with few heads should pass an explicit
+# use_flash (benchmarks/mixtral.py does).
 AUTO_MIN_SEQ = 512
 
 

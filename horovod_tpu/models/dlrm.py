@@ -160,6 +160,53 @@ def sparse_adagrad_update(tables_flat, accum_flat, flat_idx, row_grads,
     return tables2, accum2
 
 
+def build_sparse_training(model, cfg, mesh, rules, params, *,
+                          lr: float = 1e-2, eps: float = 1e-7,
+                          acc0: float = 0.1):
+    """Complete sparse-embedding training setup — ONE definition of the
+    flat tables, PINNED row-major jit layouts, and donation, shared by
+    `benchmarks/dlrm.py`, `benchmarks/profile_dlrm.py` and
+    `examples/train_dlrm.py` (two hand-maintained copies drifted twice
+    in r4 review; the layout pin is load-bearing: without it XLA's
+    entry-layout heuristic transposes the whole tables around the row
+    scatters, 4 × ~666MB copies/step at the criteo config).
+
+    ``params`` is the unboxed full param tree; its ``embedding_tables``
+    buffer is DONATED into the flat [T*R, D] copy. Returns
+    ``(jitted_step, dense_params, tables_flat, accum_flat, opt_state)``;
+    thread the five through ``jitted_step(dense_params, tables, accum,
+    opt_state, d, s, y)``.
+    """
+    import optax
+    from jax.experimental.layout import Format, Layout
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    try:  # UNSPECIFIED = "let XLA choose" (None would mean "replicate")
+        from jax._src.sharding_impls import UNSPECIFIED as _u
+    except ImportError:  # pragma: no cover - older/newer jax fallback
+        _u = None
+
+    dense_params = {k: v for k, v in params.items()
+                    if k != "embedding_tables"}
+    nrows = cfg.num_tables * cfg.rows_per_table
+    rowmajor = Format(Layout((0, 1)),
+                      NamedSharding(mesh, P("ep") if "ep" in
+                                    mesh.axis_names else P()))
+    with jax.sharding.set_mesh(mesh):
+        tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
+                         out_shardings=rowmajor, donate_argnums=0)(
+            params.pop("embedding_tables"))
+        accum = jax.jit(lambda t: jnp.full_like(t, acc0),
+                        out_shardings=rowmajor)(tables)
+    opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)
+    opt_state = opt.init(dense_params)
+    jitted = jax.jit(make_sparse_dlrm_step(model, cfg, opt, lr=lr, eps=eps,
+                                           rules=rules),
+                     donate_argnums=(0, 1, 2, 3),
+                     in_shardings=(_u, rowmajor, rowmajor, _u, _u, _u, _u),
+                     out_shardings=(_u, rowmajor, rowmajor, _u, _u))
+    return jitted, dense_params, tables, accum, opt_state
+
+
 def make_sparse_dlrm_step(model, cfg, opt_dense, *, lr: float,
                           eps: float = 1e-7, loss=bce_loss, rules=None):
     """Train step with the reference's sparse-embedding semantics: the
