@@ -17,11 +17,14 @@ import torch
 from . import mpi_ops as _ops
 
 
-def broadcast_parameters(params, root_rank: int = 0) -> None:
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
     """Broadcast model parameters from ``root_rank`` to every rank.
 
     ``params`` is a ``model.state_dict()`` or a ``named_parameters``
-    iterable, as in the reference.
+    iterable, as in the reference. With ``process_set``, broadcast is
+    among the set's members (``root_rank`` is a GLOBAL rank and must be
+    a member, reference semantics).
     """
     if isinstance(params, dict):
         items = sorted(params.items())
@@ -33,13 +36,15 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
             continue
         if not torch.is_tensor(p):
             continue  # non-tensor state_dict entries are broadcast_object's job
-        handles.append(_ops.broadcast_async_(p, root_rank, name=name))
+        handles.append(_ops.broadcast_async_(p, root_rank, name=name,
+                                             process_set=process_set))
     for h in handles:
         _ops.synchronize(h)
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
-                              root_rank: int = 0) -> None:
+                              root_rank: int = 0,
+                              process_set=None) -> None:
     """Broadcast the optimizer's state (momenta etc.) from ``root_rank``.
 
     Mirrors the reference's approach: state tensors are broadcast in
@@ -67,7 +72,8 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                 for pid, pstate in state["state"].items()
             },
         }
-    meta = broadcast_object(meta, root_rank, name="optimizer.state.meta")
+    meta = broadcast_object(meta, root_rank, name="optimizer.state.meta",
+                            process_set=process_set)
     handles, tensors = [], {}
     for pid, entries in meta["tensors"].items():
         tensors[pid] = {}
@@ -77,7 +83,8 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                 local = torch.zeros(shape, dtype=dtype)
             tensors[pid][k] = local
             handles.append(_ops.broadcast_async_(
-                local, root_rank, name=f"optimizer.state.{pid}.{k}"))
+                local, root_rank, name=f"optimizer.state.{pid}.{k}",
+                process_set=process_set))
     for h in handles:
         _ops.synchronize(h)
     new_state = {
@@ -88,9 +95,11 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         {"state": new_state, "param_groups": meta["param_groups"]})
 
 
-def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast_object"):
+def broadcast_object(obj, root_rank: int = 0,
+                     name: str = "broadcast_object", process_set=None):
     """Pickle-broadcast an arbitrary Python object from ``root_rank``
-    (reference ``hvd.broadcast_object``: size first, then payload)."""
+    (reference ``hvd.broadcast_object``: size first, then payload).
+    With ``process_set``, among the set's members only."""
     if _ops.rank() == root_rank:
         buf = io.BytesIO()
         pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
@@ -100,24 +109,30 @@ def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast_object"):
         payload = None
         sz = np.zeros(1, dtype=np.int64)
     rt = _ops._rt()
-    sz = rt.engine.broadcast(f"{name}.size", sz, root_rank)
+    m = _ops._members(process_set)
+    sz = rt.engine.broadcast(f"{name}.size", sz, root_rank, members=m)
     if payload is None:
         payload = np.zeros(int(sz[0]), dtype=np.uint8)
-    payload = rt.engine.broadcast(f"{name}.data", payload, root_rank)
+    payload = rt.engine.broadcast(f"{name}.data", payload, root_rank,
+                                  members=m)
     return pickle.loads(payload.tobytes())
 
 
-def allgather_object(obj, name: str = "allgather_object") -> list:
+def allgather_object(obj, name: str = "allgather_object",
+                     process_set=None) -> list:
     """Gather one arbitrary picklable object per rank; every rank gets the
     rank-ordered list (reference ``hvd.allgather_object``: pickle + size
-    exchange + ragged byte allgather)."""
+    exchange + ragged byte allgather). With ``process_set``, member-ordered
+    among the set's members only."""
     payload = np.frombuffer(
         pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
         dtype=np.uint8).copy()
     rt = _ops._rt()
+    m = _ops._members(process_set)
     sizes = rt.engine.allgather(
-        f"{name}.size", np.asarray([payload.shape[0]], dtype=np.int64))
-    data = rt.engine.allgather(f"{name}.data", payload)
+        f"{name}.size", np.asarray([payload.shape[0]], dtype=np.int64),
+        members=m)
+    data = rt.engine.allgather(f"{name}.data", payload, members=m)
     out, off = [], 0
     for s in sizes:
         out.append(pickle.loads(data[off:off + int(s)].tobytes()))

@@ -31,12 +31,16 @@ _DEFERRED = object()
 class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters, compression,
                  backward_passes_per_step, op, gradient_predivide_factor,
-                 sparse_as_dense=False):
+                 sparse_as_dense=False, process_set=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._op = op
         self._gradient_predivide_factor = gradient_predivide_factor
         self._sparse_as_dense = sparse_as_dense
+        # Subgroup training (reference optimizer process_set kwarg):
+        # gradients reduce among the set's MEMBERS only; only member
+        # ranks may run this optimizer (engine process-set semantics).
+        self._process_set = process_set
         self.backward_passes_per_step = backward_passes_per_step
 
         if named_parameters is not None:
@@ -63,8 +67,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._sync_count = 0      # distinguishes per-step meta-round names
         self._should_synchronize = True
         self._synchronized = False
-        if _ops.size() > 1:
+        if self._nparticipants > 1:
             self._register_hooks()
+
+    @property
+    def _nparticipants(self) -> int:
+        return len(self._process_set.ranks) if self._process_set is not None \
+            else _ops.size()
 
     # -- hooks ---------------------------------------------------------------
 
@@ -142,8 +151,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         grad._values() / self.backward_passes_per_step,
                         grad.shape)
                 return ("sparse", p,
-                        _ops.sparse_allreduce_async(grad, op=self._op,
-                                                    name=name))
+                        _ops.sparse_allreduce_async(
+                            grad, op=self._op, name=name,
+                            process_set=self._process_set))
         if self.backward_passes_per_step > 1:
             grad.div_(self.backward_passes_per_step)
         if self._op == Average and self._gradient_predivide_factor != 1.0:
@@ -153,9 +163,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             return _ops.allreduce_async_(
                 grad, op=Sum, name=name, compression=self._compression,
                 prescale_factor=1.0 / f,
-                postscale_factor=f / _ops.size())
+                postscale_factor=f / self._nparticipants,
+                process_set=self._process_set)
         return _ops.allreduce_async_(
-            grad, op=self._op, name=name, compression=self._compression)
+            grad, op=self._op, name=name, compression=self._compression,
+            process_set=self._process_set)
 
     # -- synchronization -----------------------------------------------------
 
@@ -197,7 +209,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         rt = _ops._rt()
         handle = rt.submit(
             "allgather_object", f"sparse_param_meta.{self._sync_count}",
-            lambda name: allgather_object(local, name=name))
+            lambda name: allgather_object(
+                local, name=name, process_set=self._process_set))
         name_to_param = {v: k for k, v in self._param_names.items()}
         for peer_map in _ops.synchronize(handle):
             for pname, sd in peer_map.items():
@@ -210,7 +223,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         hook never fired (unused this step) are reduced here with a zero
         gradient so every rank issues the same collective set — the
         reference's missing-handle path in ``synchronize()``."""
-        if _ops.size() > 1:
+        if self._nparticipants > 1:
             if not self._sparse_as_dense:
                 self._exchange_sparse_param_meta()
             self._sync_count += 1
@@ -320,10 +333,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             return _ops.allreduce_fused_async_(
                 grads, op=Sum, name=name, compression=self._compression,
                 prescale_factor=1.0 / (f * k),
-                postscale_factor=f / _ops.size())
+                postscale_factor=f / self._nparticipants,
+                process_set=self._process_set)
         return _ops.allreduce_fused_async_(
             grads, op=self._op, name=name, compression=self._compression,
-            prescale_factor=1.0 / k)
+            prescale_factor=1.0 / k, process_set=self._process_set)
 
     @contextlib.contextmanager
     def skip_synchronize(self):
@@ -357,7 +371,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op: str = Average,
                          gradient_predivide_factor: float = 1.0,
-                         sparse_as_dense: bool = False):
+                         sparse_as_dense: bool = False,
+                         process_set=None):
     """Wrap ``optimizer`` so gradients are allreduced across ranks during
     ``loss.backward()`` (reference ``hvd.DistributedOptimizer``).
 
@@ -375,4 +390,4 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               sparse_as_dense)
+               sparse_as_dense, process_set)

@@ -922,6 +922,89 @@ def test_fused_gradient_hot_path_op_count(monkeypatch):
         torch.testing.assert_close(a, b)
 
 
+@pytest.mark.parametrize("threshold", [None, 0])
+def test_distributed_optimizer_process_set(monkeypatch, threshold):
+    """Reference optimizer `process_set=` kwarg (r4): gradients reduce
+    among the set's MEMBERS only — member ranks average over the member
+    count, the outside rank trains independently — on BOTH the fused
+    and per-tensor paths (incl. the sparse-meta round, which must meet
+    among members or the step deadlocks)."""
+    _set_fusion_threshold(monkeypatch, threshold)
+    n = 3
+    sub = (0, 2)
+    sd = _make_model(3).state_dict()
+
+    def fn(r):
+        import horovod_tpu.torch as thvd
+        model = _make_model(3)
+        model.load_state_dict(sd)
+        # rank 1 gets a singleton set: 1 participant -> purely local
+        # training (a global optimizer would wait on ranks 0/2 forever)
+        ps = thvd.add_process_set(sub if r in sub else (1,))
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), process_set=ps)
+        # members feed 1 and 5 (mean 3) - distinct from rank 1's own 2
+        x = torch.full((2, 4), float(r * r + 1))
+        model(x).sum().backward()
+        opt.step()
+        return [p.detach().clone() for p in model.parameters()]
+
+    outs = run_parallel(n, fn)
+    # members 0 and 2 averaged grads over THE SET (inputs 1 and 5)
+    for a, b in zip(outs[0], outs[2]):
+        torch.testing.assert_close(a, b)
+    # the singleton rank trained on its own data -> different params
+    assert any(not torch.allclose(a, b)
+               for a, b in zip(outs[0], outs[1]))
+    # member result == a 2-process global run on the same member data
+    sd2 = dict(sd)
+
+    def member_global(r):
+        model = _make_model(3)
+        model.load_state_dict(sd2)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        m_rank = (0, 2)[r]
+        x = torch.full((2, 4), float(m_rank * m_rank + 1))
+        model(x).sum().backward()
+        opt.step()
+        return [p.detach().clone() for p in model.parameters()]
+
+    ref = run_parallel(2, member_global)
+    for a, b in zip(outs[0], ref[0]):
+        torch.testing.assert_close(a, b)
+
+
+def test_broadcast_helpers_and_allgather_object_process_set():
+    """broadcast_parameters / broadcast_object / allgather_object accept
+    process_set (r4 — reference functions.py parity): member-only
+    rendezvous, member-ordered results, the outside rank untouched."""
+    n = 3
+    sub = (0, 2)
+
+    def fn(r):
+        import horovod_tpu.torch as thvd
+        if r == 1:
+            return ("outside", None, None)
+        ps = thvd.add_process_set(sub)
+        t = torch.full((3,), float(r))
+        hvd.broadcast_parameters([("w", t)], root_rank=0, process_set=ps)
+        obj = hvd.broadcast_object({"root": r} if r == 0 else None,
+                                   root_rank=0, process_set=ps)
+        gathered = hvd.allgather_object(("m", r), process_set=ps)
+        return (t.clone(), obj, gathered)
+
+    outs = run_parallel(n, fn)
+    assert outs[1] == ("outside", None, None)
+    for i in (0, 2):
+        t, obj, gathered = outs[i]
+        torch.testing.assert_close(t, torch.zeros(3))  # root 0's value
+        assert obj == {"root": 0}
+        assert gathered == [("m", 0), ("m", 2)]  # member order
+
+
 def test_fused_adasum_matches_per_parameter(monkeypatch):
     """VERDICT r3 #4: op=Adasum fuses like Sum/Average — O(buckets)
     engine ops with each tensor's OWN coefficient pair applied inside
