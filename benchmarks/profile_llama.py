@@ -1,0 +1,88 @@
+"""Op-level device profile of the Llama train step on the real TPU.
+
+Completes the per-BASELINE-config profiler set (ResNet r3, Mixtral/DLRM
+r4): attributes leaf-op time for the `benchmarks/llama.py` TPU config —
+flash-attention kernels vs matmul fusions vs the AdamW update vs the
+LM-head/loss path.
+
+Usage (real chip):  python benchmarks/profile_llama.py [per_chip_batch]
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+from xprof import make_categorize, parse_xplane, report  # noqa: E402
+
+STEPS = 8
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models.llama import LOGICAL_RULES, Llama, LlamaConfig
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import (create_gspmd_train_state,
+                                   make_gspmd_train_step)
+
+    hvd.init()
+    # EXACTLY the benchmarks/llama.py TPU config
+    cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
+                      n_kv_heads=8, hidden_dim=4096, max_seq_len=2048,
+                      remat_policy="full")
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    per_chip, seq = (int(pos[0]) if pos else 8), 1024
+    batch = per_chip * hvd.size()
+    print(f"device: {jax.devices()[0].device_kind}  batch {batch} "
+          f"seq {seq}", flush=True)
+
+    mesh = create_mesh({"dp": hvd.size()})
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    model = Llama(cfg)
+    opt = optax.adamw(1e-4)
+    state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
+                                     tokens, mesh, LOGICAL_RULES)
+    # donate (unlike profile_resnet): two resident 24L states OOM the chip
+    step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
+                                 donate=True)
+    state, loss = step(state, tokens)
+    np.asarray(loss)
+
+    logdir = tempfile.mkdtemp(prefix="llama_xplane_")
+    with jax.profiler.trace(logdir):
+        for _ in range(STEPS):
+            state, loss = step(state, tokens)
+        np.asarray(loss)
+
+    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
+    if not totals:
+        print(f"no device events; planes seen: {planes}")
+        return
+    V, D = cfg.vocab_size, cfg.dim
+    extra = [
+        ("flash-attn(pallas)", re.compile(r"_fa_call|_fa_bwd|_fa_fwd")),
+        # TABLE-shaped first: the embedding gather + the AdamW update of
+        # the two [V,D]/[D,V] tables are optimizer/embedding traffic,
+        # NOT the head/loss compute — order matters, the activation
+        # pattern below would otherwise swallow them
+        ("vocab-table(embed/opt)", re.compile(
+            rf"\[{V},{D}\]|\[{D},{V}\]")),
+        ("lm-head/loss", re.compile(rf",{V}\]|\[{V},")),
+    ]
+    report(f"llama_profile_b{per_chip}", totals, counts, wall_ps,
+           async_ps, STEPS,
+           categorize=make_categorize(extra),
+           extra_json={"batch": batch, "seq": seq})
+
+
+if __name__ == "__main__":
+    main()
