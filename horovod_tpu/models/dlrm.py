@@ -171,8 +171,12 @@ def build_sparse_training(model, cfg, mesh, rules, params, *,
     entry-layout heuristic transposes the whole tables around the row
     scatters, 4 × ~666MB copies/step at the criteo config).
 
-    ``params`` is the unboxed full param tree; its ``embedding_tables``
-    buffer is DONATED into the flat [T*R, D] copy. Returns
+    ``params`` is the unboxed full param tree; it is NOT mutated, but its
+    ``embedding_tables`` buffer is DONATED into the flat [T*R, D] copy —
+    afterwards that entry refers to a deleted buffer (JAX raises a
+    donated-buffer error on use), so rebuild the full tree from the
+    returned pieces (``{**dense_params, "embedding_tables":
+    tables.reshape(T, R, D)}``) for any eval ``model.apply``. Returns
     ``(jitted_step, dense_params, tables_flat, accum_flat, opt_state)``;
     thread the five through ``jitted_step(dense_params, tables, accum,
     opt_state, d, s, y)``.
@@ -194,7 +198,7 @@ def build_sparse_training(model, cfg, mesh, rules, params, *,
     with jax.sharding.set_mesh(mesh):
         tables = jax.jit(lambda t: t.reshape(nrows, cfg.embed_dim),
                          out_shardings=rowmajor, donate_argnums=0)(
-            params.pop("embedding_tables"))
+            params["embedding_tables"])
         accum = jax.jit(lambda t: jnp.full_like(t, acc0),
                         out_shardings=rowmajor)(tables)
     opt = optax.adagrad(lr, initial_accumulator_value=acc0, eps=eps)

@@ -30,24 +30,28 @@ def _fetch_remote_results(hostname: str, path: str,
     existing ssh channel (``ssh <host> cat <path>``) — the reference
     returns results over its driver/task RPC; the ssh fetch is that
     channel's role here. Cleans the remote blob up after a successful
-    read; any transport failure (hung connection, missing ssh binary)
-    degrades to ``None`` so the caller raises its normal worker-failure
-    error instead of a raw subprocess traceback."""
+    read; a transport failure (hung connection, missing ssh binary) is
+    retried once, then degrades to ``None`` — the caller distinguishes
+    "workers failed" from "workers succeeded but the fetch failed" and
+    names the stranded blob path in the latter error."""
     import shlex
     import subprocess
 
     from .exec_run import ssh_base_command
     base = ssh_base_command(settings) + [hostname]
-    try:
-        r = subprocess.run(base + [f"cat {shlex.quote(path)}"],
-                           capture_output=True, timeout=120)
-        if r.returncode != 0:
-            return None
-        subprocess.run(base + [f"rm -rf {shlex.quote(os.path.dirname(path))}"],
-                       capture_output=True, timeout=60)
-        return r.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return None
+    for _attempt in range(2):  # one retry: transient ssh errors are common
+        try:
+            r = subprocess.run(base + [f"cat {shlex.quote(path)}"],
+                               capture_output=True, timeout=120)
+            if r.returncode != 0:
+                continue
+            subprocess.run(
+                base + [f"rm -rf {shlex.quote(os.path.dirname(path))}"],
+                capture_output=True, timeout=60)
+            return r.stdout
+        except (subprocess.TimeoutExpired, OSError):
+            continue
+    return None
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
@@ -135,6 +139,16 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                     break
             raise RuntimeError(
                 f"horovod_tpu.runner.run failed (exit {code}){details}")
+        if use_env_fn and all_results is None:
+            # Workers all exited 0, so the computation succeeded and rank 0
+            # wrote the blob — only the retrieval failed. Say so (and where
+            # the results still live) instead of misreporting worker failure.
+            all_path = os.path.join(tmp, "results.all.pkl")
+            raise RuntimeError(
+                "horovod_tpu.runner.run: all workers completed but the "
+                f"results blob could not be read from "
+                f"{assignments[0].hostname}:{all_path}; the results may "
+                "still be on that host — check ssh connectivity and re-run")
         results = []
         for a in assignments:
             rcode, val = load_result(a)

@@ -153,8 +153,25 @@ class _DistributedGradientTape:
             glist = [tf.convert_to_tensor(g)
                      if isinstance(g, tf.IndexedSlices) else g
                      for g in glist]
-        # Slot-pool prefix, claimed per gradient() call and released on
-        # return: the canonical eager loop reconstructs this wrapper
+        rt = _ops._rt()
+        if not tf.executing_eagerly():
+            # Traced (tf.function): gradient() runs once at TRACE time and
+            # the names are baked into the compiled step, so a slot claimed
+            # here would be released long before any execution — two
+            # compiled steps running concurrently in threads would both
+            # carry "gradtape.0" and could cross-pair buckets. Mint a
+            # permanent per-instance prefix instead (the keras-optimizer
+            # pattern below): the trace reuses it on every execution
+            # (stable names, signature-cache hits) and distinct tapes get
+            # distinct prefixes. Allocation order is trace order — program
+            # order, identical on every rank — so names pair across ranks.
+            prefix = getattr(self, "_hvd_traced_prefix", None)
+            if prefix is None:
+                prefix = rt.autoname("gradtape.traced", None)
+                self._hvd_traced_prefix = prefix
+            return self._reduce(glist, one, prefix)
+        # Eager: slot-pool prefix, claimed per gradient() call and released
+        # on return. The canonical eager loop reconstructs this wrapper
         # EVERY step, so a monotone per-instance counter would mint a
         # fresh collective name each step and defeat the engine's
         # signature cache — the steady-state single-model step instead
@@ -163,7 +180,6 @@ class _DistributedGradientTape:
         # once (threads) hold distinct slots, so concurrent models cannot
         # cross-pair buckets; claim order is program order, identical on
         # every rank, so names still pair across ranks.
-        rt = _ops._rt()
         slot = rt.claim_slot("gradtape")
         try:
             return self._reduce(glist, one, f"gradtape.{slot}")

@@ -343,6 +343,65 @@ def test_tape_slot_pool_stable_and_distinct(monkeypatch):
     assert set(seq) == {"gradtape.0.fused.float32.0"}, seq
 
 
+def test_tape_traced_prefix_distinct_per_instance(monkeypatch):
+    """Under tf.function the tape's collective names are baked at TRACE
+    time, so the eager slot pool (claim/release around gradient()) cannot
+    keep two concurrently-executing compiled steps apart — a traced tape
+    mints a permanent per-instance prefix instead: distinct across tapes
+    (no cross-pairing between models), stable across executions
+    (signature-cache hits on the baked name)."""
+    import threading as _threading
+    from horovod_tpu.core.engine import ThreadSimEngine
+
+    class Recording(ThreadSimEngine):
+        def __init__(self, k):
+            super().__init__(k)
+            self.names = []
+            self._cl = _threading.Lock()
+
+        def allreduce(self, name, arr, op, members=None):
+            with self._cl:
+                self.names.append(name)
+            return super().allreduce(name, arr, op, members=members)
+
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(64 << 20))
+    eng = Recording(1)
+
+    def fn(r):
+        v1 = tf.Variable(np.ones(4, np.float32))
+        v2 = tf.Variable(2 * np.ones(4, np.float32))
+
+        @tf.function
+        def step_a():
+            with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+                loss = tf.reduce_sum(v1 * v1)
+            return tape.gradient(loss, [v1])
+
+        @tf.function
+        def step_b():
+            with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+                loss = tf.reduce_sum(v2)
+            return tape.gradient(loss, [v2])
+
+        (ga,) = step_a()
+        (gb,) = step_b()
+        np.testing.assert_allclose(np.asarray(ga), 2 * np.ones(4))
+        np.testing.assert_allclose(np.asarray(gb), np.ones(4))
+        step_a()  # re-execution reuses the baked (stable) names
+        step_b()
+        return None
+
+    run_parallel(1, fn, engine=eng)
+    seq = [n for n in eng.names if ".fused." in n]
+    assert len(seq) == 4, eng.names
+    prefixes = {n.split(".fused.")[0] for n in seq}
+    # two tapes -> two distinct baked prefixes, each seen twice
+    assert len(prefixes) == 2, seq
+    assert all(p.startswith("gradtape.traced.") for p in prefixes), seq
+    from collections import Counter
+    assert set(Counter(seq).values()) == {2}, seq
+
+
 def test_grouped_ops_fuse_engine_rounds(monkeypatch):
     """VERDICT r3 #3: the public grouped_* ops fuse like the gradient
     paths — a 50-tensor grouped_allreduce costs ONE engine round per
