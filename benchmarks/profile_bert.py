@@ -1,0 +1,98 @@
+"""Op-level device profile of the BERT-Large MLM train step on real TPU.
+
+Completes the per-BASELINE-config profiler set (ResNet r3,
+Mixtral/DLRM/Llama r4, BERT r4): attributes leaf-op time for the
+`benchmarks/bert.py` TPU config — flash-attention kernels vs matmul
+fusions vs the vocab-table (embedding + AdamW) traffic vs the MLM
+head/loss path, with the bf16-compressed fused gradient allreduce
+machinery active exactly as the bench runs it.
+
+Usage (real chip):  python benchmarks/profile_bert.py [per_chip_batch]
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+from xprof import make_categorize, parse_xplane, report  # noqa: E402
+
+STEPS = 8  # one scan: enough occurrences to average per-op time
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.collectives import Compression
+    from horovod_tpu.models.bert import Bert, bert_large
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    hvd.init()
+    # EXACTLY the benchmarks/bert.py TPU config
+    cfg = bert_large()
+    pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    per_chip, seq = (int(pos[0]) if pos else 8), 512
+    batch = per_chip * hvd.size()
+    print(f"device: {jax.devices()[0].device_kind}  batch {batch} "
+          f"seq {seq}", flush=True)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    raw = rng.randint(0, cfg.vocab_size, (batch, seq))
+    mask = rng.rand(batch, seq) < 0.15
+    labels = jnp.asarray(np.where(mask, raw, -1))
+
+    model = Bert(cfg)
+    dopt = distributed(optax.adamw(1e-4), compression=Compression.bf16)
+    state = create_train_state(model, jax.random.PRNGKey(0), tokens[:1],
+                               dopt)
+
+    def loss_fn(logits, y):
+        valid = y >= 0
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(y, 0))
+        return (ce * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    # donate (like profile_llama): two resident 24L AdamW states OOM the chip
+    step = make_train_step(model, dopt, loss_fn, scan_steps=STEPS,
+                           donate=True)
+    # warm/compile outside the trace
+    state, loss = step(state, tokens, labels)
+    np.asarray(loss)
+
+    logdir = tempfile.mkdtemp(prefix="bert_xplane_")
+    with jax.profiler.trace(logdir):
+        state, loss = step(state, tokens, labels)
+        np.asarray(loss)
+
+    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
+    if not totals:
+        print(f"no device events; planes seen: {planes}")
+        return
+    V, D = cfg.vocab_size, cfg.dim
+    extra = [
+        ("flash-attn(pallas)", re.compile(r"_fa_call|_fa_bwd|_fa_fwd")),
+        # TABLE-shaped first: the token-embedding gather + the AdamW
+        # update of the [V,D] table are embedding/optimizer traffic, NOT
+        # the MLM-head/loss compute — order matters, the activation
+        # pattern below would otherwise swallow them
+        ("vocab-table(embed/opt)", re.compile(
+            rf"\[{V},{D}\]|\[{D},{V}\]")),
+        ("mlm-head/loss", re.compile(rf",{V}\]|\[{V},")),
+    ]
+    report(f"bert_profile_b{per_chip}", totals, counts, wall_ps,
+           async_ps, STEPS,
+           categorize=make_categorize(extra),
+           extra_json={"batch": batch, "seq": seq})
+
+
+if __name__ == "__main__":
+    main()

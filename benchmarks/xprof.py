@@ -1,7 +1,8 @@
 """Shared xplane-trace parsing for the op-occupancy profilers.
 
 Extracted from ``profile_resnet.py`` (r3) so every BASELINE config's
-profile (`profile_resnet.py`, `profile_mixtral.py`, `profile_dlrm.py`)
+profile (`profile_resnet.py`, `profile_bert.py`, `profile_llama.py`,
+`profile_mixtral.py`, `profile_dlrm.py`)
 reads the device plane identically: the TPU device plane's "XLA Ops"
 line holds leaf HLO op spans (drop the `%while` scan umbrella and
 module events — what remains sums to device occupancy); "Async XLA Ops"
