@@ -36,13 +36,15 @@ def main():
     n = hvd.size()
     tpu = on_tpu()
     if tpu:
-        # remat_policy="full" + per-chip batch 8: measured fastest on one
-        # v5e chip (26.9k tok/s vs 25.7k at batch 4 with the "dots"
-        # policy; batch is HBM-bound — full remat frees the activation
-        # memory that buys the larger batch).
+        # remat_policy="attn" + per-chip batch 8: "full" remat buys batch
+        # 8 (26.9k tok/s vs 25.7k at batch 4 under "dots" — HBM-bound),
+        # and saving ONLY the flash-kernel residuals on top skips the
+        # fwd-kernel re-run in the backward for ~400MB: 28.9k vs 28.1k
+        # (+2.6% interleaved; +5.2% at batch 12, but batch 12 is slower
+        # for both). See benchmarks/llama_remat_ab.py.
         cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24,
                           n_heads=16, n_kv_heads=8, hidden_dim=4096,
-                          max_seq_len=2048, remat_policy="full")
+                          max_seq_len=2048, remat_policy="attn")
         per_chip, seq = 8, 1024
     else:
         cfg = llama_tiny()

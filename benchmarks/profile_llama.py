@@ -37,7 +37,7 @@ def main():
     # EXACTLY the benchmarks/llama.py TPU config
     cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
                       n_kv_heads=8, hidden_dim=4096, max_seq_len=2048,
-                      remat_policy="full")
+                      remat_policy="attn")
     pos = [a for a in sys.argv[1:] if not a.startswith("-")]
     per_chip, seq = (int(pos[0]) if pos else 8), 1024
     batch = per_chip * hvd.size()

@@ -97,6 +97,21 @@ def llama_tiny(vocab: int = 256) -> LlamaConfig:
 _REMAT_POLICIES = {
     "full": None,
     "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # "dots" + the flash-attention kernel outputs (named in
+    # ops/flash_attention.py::_fa_fwd_impl): saving (o, m, l) hands the
+    # flash custom-vjp its residuals directly, so the backward runs ONLY
+    # the dedicated bwd kernels — no fwd-kernel re-run inside the remat
+    # block. Costs [B,T,H,D] bf16 + 2x[B,H,T] f32 per layer.
+    "dots_attn": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse_m", "attn_lse_l")),
+    # Save ONLY the flash outputs: everything else recomputes as under
+    # "full", but the backward skips the fwd-kernel re-run — the +HBM is
+    # just the kernel residuals, so it composes with the HBM-bound batch
+    # that made "full" win over "dots" in the first place.
+    "attn": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "attn_lse_m", "attn_lse_l"),
 }
 
 

@@ -528,6 +528,7 @@ def _fold_bias(bias, B, H, Tk):
 
 def _fa_fwd_impl(q, k, v, bias, causal, scale, block_q, block_k):
     """Plain (non-vjp) forward shared by both custom_vjp cores."""
+    from jax.ad_checkpoint import checkpoint_name
     interpret = _use_interpret()
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -538,7 +539,13 @@ def _fa_fwd_impl(q, k, v, bias, causal, scale, block_q, block_k):
                        interpret=interpret,
                        partition=_partition_enabled())
     o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
-    return o, m.reshape(B, H, Tq), l.reshape(B, H, Tq)
+    # Named so a remat policy can SAVE the kernel's outputs — they are
+    # exactly the custom-vjp residuals (o, m, l), so a policy that keeps
+    # them (models' "dots_attn") skips the whole fwd-kernel re-run inside
+    # the backward of a remat block, at [B,T,H,D] + 2x[B,H,T] per layer.
+    return (checkpoint_name(o, "attn_out"),
+            checkpoint_name(m.reshape(B, H, Tq), "attn_lse_m"),
+            checkpoint_name(l.reshape(B, H, Tq), "attn_lse_l"))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))

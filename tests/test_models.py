@@ -120,6 +120,28 @@ def test_llama_scan_remat_variant():
     assert w1.shape[0] == cfg.n_layers
 
 
+def test_llama_remat_policies_match_full():
+    """The named-save policies (r4: "attn"/"dots_attn" keep the flash
+    kernel's (o, m, l) residuals so the backward skips the fwd-kernel
+    re-run — benchmarks/llama_remat_ab.py measures the win) must be
+    numerically identical to "full" remat: same loss trajectory on the
+    same init, flash forced on (interpret-mode Pallas on CPU)."""
+    import dataclasses
+    base = dataclasses.replace(llama_tiny(), scan_layers=True, remat=True,
+                               use_flash=True)
+    t = toks()
+    mesh = create_mesh({"dp": 8})
+    ref, _ = train_losses(
+        Llama(dataclasses.replace(base, remat_policy="full")), mesh,
+        tokens=t)
+    for pol in ("dots", "dots_attn", "attn"):
+        got, _ = train_losses(
+            Llama(dataclasses.replace(base, remat_policy=pol)), mesh,
+            tokens=t)
+        np.testing.assert_allclose(got, ref, rtol=1e-5,
+                                   err_msg=f"policy {pol}")
+
+
 def test_mixtral_trains_dp_ep():
     from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
     cfg = mixtral_tiny()
