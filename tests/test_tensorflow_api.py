@@ -762,3 +762,59 @@ def test_allreduce_bf16_compression():
 
     for o in run_parallel(n, fn):
         np.testing.assert_allclose(o, [3e5, 1.0], rtol=1e-2)
+
+
+def test_op_dtype_dim_matrix():
+    """SURVEY §4 bulk tier (reference test/parallel/test_tensorflow.py:
+    every op x dtype x dim): one 2-rank run sweeps the TF op surface over
+    the wire dtypes and 1-3D shapes against exact numpy-model
+    expectations (tiny values keep f16/bf16/uint8 sums exact)."""
+    n = 2
+    dtypes = [tf.float16, tf.bfloat16, tf.float32, tf.float64,
+              tf.uint8, tf.int8, tf.int32, tf.int64]
+    shapes = [(4,), (4, 3), (4, 3, 2)]
+
+    def fn(r):
+        for dt in dtypes:
+            npdt = dt.as_numpy_dtype
+            for shape in shapes:
+                tag = f"{dt.name}.{len(shape)}"
+                base = np.arange(int(np.prod(shape))).reshape(shape) % 5
+                of_rank = lambda s: (base + s + 1).astype(np.float64)
+                t = tf.constant((base + r + 1).astype(npdt))
+                total = of_rank(0) + of_rank(1)
+
+                o = hvd.allreduce(t, op=hvd.Sum, name=f"mx.ar.{tag}")
+                assert o.dtype == dt and tuple(o.shape) == shape
+                np.testing.assert_array_equal(
+                    np.asarray(o).astype(np.float64), total,
+                    err_msg=f"{tag} allreduce")
+
+                g = hvd.allgather(t, name=f"mx.ag.{tag}")
+                assert tuple(g.shape) == (shape[0] * n, *shape[1:])
+                for s, p in enumerate(np.split(
+                        np.asarray(g).astype(np.float64), n, axis=0)):
+                    np.testing.assert_array_equal(p, of_rank(s))
+
+                b = hvd.broadcast(t, root_rank=1, name=f"mx.bc.{tag}")
+                assert b.dtype == dt
+                np.testing.assert_array_equal(
+                    np.asarray(b).astype(np.float64), of_rank(1))
+
+                a, _ = hvd.alltoall(
+                    t, splits=tf.constant([shape[0] // n] * n),
+                    name=f"mx.a2a.{tag}")
+                exp = np.concatenate([np.split(of_rank(s), n, axis=0)[r]
+                                      for s in range(n)])
+                np.testing.assert_array_equal(
+                    np.asarray(a).astype(np.float64), exp,
+                    err_msg=f"{tag} alltoall")
+
+                rs = hvd.reducescatter(t, op=hvd.Sum, name=f"mx.rs.{tag}")
+                np.testing.assert_array_equal(
+                    np.asarray(rs).astype(np.float64),
+                    np.split(total, n, axis=0)[r],
+                    err_msg=f"{tag} reducescatter")
+        return True
+
+    assert all(run_parallel(n, fn))

@@ -1133,3 +1133,63 @@ def test_allreduce_bf16_tensor_and_compression():
             raw.float(), torch.tensor([3.0, 6.0]), rtol=1e-2, atol=1e-2)
         torch.testing.assert_close(
             comp, torch.tensor([3e5, 1.0]), rtol=1e-2, atol=1e-2)
+
+
+def test_op_dtype_dim_matrix():
+    """SURVEY §4 bulk tier (reference test/parallel/test_torch.py: every
+    op x dtype x dim): one 2-rank run sweeps the op surface over all wire
+    dtypes and 1-3D shapes against exact numpy-model expectations. Values
+    stay tiny so f16/bf16/uint8 sums are exact."""
+    n = 2
+    dtypes = [torch.float16, torch.bfloat16, torch.float32, torch.float64,
+              torch.uint8, torch.int8, torch.int16, torch.int32,
+              torch.int64]
+    shapes = [(4,), (4, 3), (4, 3, 2)]
+
+    def fn(r):
+        f64 = torch.float64
+        for dt in dtypes:
+            for shape in shapes:
+                tag = f"{str(dt).split('.')[-1]}.{len(shape)}"
+                base = (torch.arange(int(np.prod(shape)))
+                        .reshape(shape) % 5)
+                t = (base + r + 1).to(dt)
+                mine = (base + r + 1).to(f64)
+                of_rank = lambda s: (base + s + 1).to(f64)
+                total = of_rank(0) + of_rank(1)
+
+                o = hvd.allreduce(t, op=hvd.Sum, name=f"mx.ar.{tag}")
+                assert o.dtype == dt and o.shape == t.shape, (dt, shape)
+                assert torch.equal(o.to(f64), total), (dt, shape)
+
+                g = hvd.allgather(t, name=f"mx.ag.{tag}")
+                assert g.shape == (shape[0] * n, *shape[1:]), (dt, shape)
+                for s, p in enumerate(torch.chunk(g.to(f64), n, dim=0)):
+                    assert torch.equal(p, of_rank(s)), (dt, shape, s)
+
+                b = hvd.broadcast(t, root_rank=1, name=f"mx.bc.{tag}")
+                assert b.dtype == dt, (dt, shape)
+                assert torch.equal(b.to(f64), of_rank(1)), (dt, shape)
+
+                a = hvd.alltoall(t, name=f"mx.a2a.{tag}")
+                # even split: output = concat over ranks s of s's chunk r
+                exp = torch.cat([torch.chunk(of_rank(s), n, dim=0)[r]
+                                 for s in range(n)])
+                assert torch.equal(a.to(f64), exp), (dt, shape)
+
+                rs = hvd.reducescatter(t, op=hvd.Sum,
+                                       name=f"mx.rs.{tag}")
+                assert torch.equal(rs.to(f64),
+                                   torch.chunk(total, n, dim=0)[r]), \
+                    (dt, shape)
+            # grouped op: once per dtype (2-D), list stays one fused round
+            ts = [(base2 % 5 + r + 1).to(dt)
+                  for base2 in (torch.arange(6).reshape(2, 3),
+                                torch.arange(8).reshape(4, 2))]
+            outs = hvd.grouped_allreduce(ts, op=hvd.Sum,
+                                         name=f"mx.gar.{tag}")
+            for t_in, o in zip(ts, outs):
+                assert o.dtype == dt and o.shape == t_in.shape
+        return True
+
+    assert all(run_parallel(n, fn))
