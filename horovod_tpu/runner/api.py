@@ -18,10 +18,14 @@ from .hosts import get_host_assignments, parse_hosts
 from .settings import Settings
 
 
-#: env-transport ceiling for the cloudpickled function: Linux caps one env
-#: string at 128 KiB (MAX_ARG_STRLEN) and the whole wire env rides one ssh
-#: command line, so leave generous headroom for the rest of the env.
-_ENV_FN_LIMIT = 96 * 1024
+#: chunk size for the cloudpickled function's env transport: Linux caps
+#: ONE execve env string at 128 KiB (MAX_ARG_STRLEN), so the base64 is
+#: split across numbered vars with generous headroom per string.
+_ENV_FN_CHUNK = 96 * 1024
+#: total ceiling: the chunks ride the execve env on both sides (ARG_MAX
+#: counts env + argv together, commonly ~2 MiB), so refuse beyond 1 MiB
+#: and point at the shared-filesystem CLI path instead.
+_ENV_FN_LIMIT = 1024 * 1024
 
 
 def _fetch_remote_results(hostname: str, path: str,
@@ -91,7 +95,13 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                     "(`python -m horovod_tpu.runner`) instead")
             import dataclasses
             s = dataclasses.replace(s, env=dict(s.env or {}))
-            s.env["HOROVOD_RUN_FUNC_B64"] = b64
+            # split across numbered vars: MAX_ARG_STRLEN is per-string
+            # (exec_run.stdin_env_keys orders them on the wire)
+            s.env["HOROVOD_RUN_FUNC_B64"] = b64[:_ENV_FN_CHUNK]
+            for i, off in enumerate(
+                    range(_ENV_FN_CHUNK, len(b64), _ENV_FN_CHUNK), 1):
+                s.env[f"HOROVOD_RUN_FUNC_B64_{i}"] = \
+                    b64[off:off + _ENV_FN_CHUNK]
             s.env["HOROVOD_RUN_RESULTS_DIR"] = tmp
             command = [sys.executable, "-m",
                        "horovod_tpu.runner.run_task"]

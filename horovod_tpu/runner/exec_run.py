@@ -102,6 +102,25 @@ def quoted_env_assignments(env: Dict[str, str],
 #: credentials.
 STDIN_ENV_KEYS = ("HOROVOD_RUN_FUNC_B64",)
 
+#: numbered overflow chunks of HOROVOD_RUN_FUNC_B64: Linux caps ONE
+#: execve env string at 128 KiB (MAX_ARG_STRLEN), so a large pickled fn
+#: is split across several vars — each side of the stdin protocol
+#: derives the same ordered key list from the env via stdin_env_keys().
+_STDIN_CHUNK_PREFIX = "HOROVOD_RUN_FUNC_B64_"
+
+
+def stdin_env_keys(env: Dict[str, str]) -> List[str]:
+    """The ordered stdin-delivered keys for this env: the fixed
+    ``STDIN_ENV_KEYS`` plus any numbered overflow chunks, in index order
+    — the writer (:func:`stdin_env_lines`) and the remote read sequence
+    (:func:`get_ssh_command`) must agree exactly."""
+    keys = [k for k in STDIN_ENV_KEYS if k in env]
+    keys += sorted((k for k in env
+                    if k.startswith(_STDIN_CHUNK_PREFIX)
+                    and k[len(_STDIN_CHUNK_PREFIX):].isdigit()),
+                   key=lambda k: int(k[len(_STDIN_CHUNK_PREFIX):]))
+    return keys
+
 
 def ssh_base_command(settings: Settings) -> List[str]:
     """The launcher's ssh invocation prefix — ONE definition shared by
@@ -120,7 +139,7 @@ def ssh_base_command(settings: Settings) -> List[str]:
 def stdin_env_lines(env: Dict[str, str]) -> List[str]:
     """Values the remote shell reads from stdin, in the FIXED order
     matching :func:`get_ssh_command`'s read sequence."""
-    return [env[k] for k in STDIN_ENV_KEYS if k in env]
+    return [env[k] for k in stdin_env_keys(env)]
 
 
 def get_ssh_command(a: HostAssignment, command: Sequence[str],
@@ -144,16 +163,16 @@ def get_ssh_command(a: HostAssignment, command: Sequence[str],
     if secret_on_stdin:
         inner += "IFS= read -r HOROVOD_SECRET_KEY && " \
                  "export HOROVOD_SECRET_KEY && "
-    for k in STDIN_ENV_KEYS:
-        if k in env:
-            inner += f"IFS= read -r {k} && export {k} && "
+    stdin_keys = stdin_env_keys(env)
+    for k in stdin_keys:
+        inner += f"IFS= read -r {k} && export {k} && "
     # Launcher-owned env goes over the wire: forwarded prefixes plus every
     # key the user put in Settings.env (same set a local worker receives);
-    # the remote shell keeps its own PATH/HOME. The secret and
-    # STDIN_ENV_KEYS travel on stdin, never inline.
+    # the remote shell keeps its own PATH/HOME. The secret and the
+    # stdin-delivered keys travel on stdin, never inline.
     wire_env = {k: v for k, v in env.items()
                 if (k.startswith(FORWARD_PREFIXES) or k in settings.env)
-                and k != secret.ENV_VAR and k not in STDIN_ENV_KEYS}
+                and k != secret.ENV_VAR and k not in stdin_keys}
     inner += f"env {quoted_env_assignments(wire_env)} "
     inner += " ".join(shlex.quote(c) for c in command)
     return " ".join(ssh) + " " + shlex.quote(inner)

@@ -25,7 +25,12 @@ def main(fn_path: str = None, results_dir: str = None) -> int:
     env_mode = fn_path is None
     if env_mode:
         import base64
-        blob = base64.b64decode(os.environ["HOROVOD_RUN_FUNC_B64"])
+        b64 = os.environ["HOROVOD_RUN_FUNC_B64"]
+        i = 1
+        while f"HOROVOD_RUN_FUNC_B64_{i}" in os.environ:  # overflow chunks
+            b64 += os.environ[f"HOROVOD_RUN_FUNC_B64_{i}"]
+            i += 1
+        blob = base64.b64decode(b64)
         fn, args, kwargs = cloudpickle.loads(blob)
         results_dir = os.environ["HOROVOD_RUN_RESULTS_DIR"]
     else:

@@ -132,12 +132,28 @@ def test_default_coordinator_addr():
 
 def test_run_rejects_oversized_function_for_remote_transport():
     """Multi-host runner.run() ships the fn via the ssh-forwarded env
-    (r4 — the NotImplementedError is gone); a closure beyond the env
-    transport ceiling fails loudly with guidance, BEFORE launching."""
+    (r4 — the NotImplementedError is gone); a closure beyond the 1 MiB
+    total env-transport ceiling (chunked across 96 KiB vars — Linux's
+    per-string MAX_ARG_STRLEN) fails loudly with guidance, BEFORE
+    launching."""
     from horovod_tpu.runner import run
-    big = bytes(200 * 1024)  # closure > 96KiB base64 ceiling
+    big = bytes(1100 * 1024)  # closure > 1MiB base64 ceiling
     with pytest.raises(RuntimeError, match="env transport limit"):
         run(lambda: len(big), np=2, hosts="tpu-a:1,tpu-b:1")
+
+
+def test_stdin_env_keys_orders_function_chunks():
+    """Both sides of the stdin protocol derive the SAME ordered key list
+    from the env: base key first, numbered overflow chunks in index order
+    (10 after 9, not lexicographic), non-numeric suffixes ignored."""
+    from horovod_tpu.runner.exec_run import stdin_env_keys, stdin_env_lines
+    env = {f"HOROVOD_RUN_FUNC_B64_{i}": f"c{i}" for i in (10, 2, 1, 9)}
+    env["HOROVOD_RUN_FUNC_B64"] = "c0"
+    env["HOROVOD_RUN_FUNC_B64_x"] = "not-a-chunk"
+    ks = stdin_env_keys(env)
+    assert ks == ["HOROVOD_RUN_FUNC_B64"] + [
+        f"HOROVOD_RUN_FUNC_B64_{i}" for i in (1, 2, 9, 10)]
+    assert stdin_env_lines(env) == ["c0", "c1", "c2", "c9", "c10"]
 
 
 # --- CLI parsing ------------------------------------------------------------
@@ -254,6 +270,21 @@ def test_run_function_multi_host_env_transport(monkeypatch):
     results = run(fn, args=(10,), np=2, hosts="localhost:1,127.0.0.2:1",
                   settings=Settings(num_proc=2, start_timeout_s=300))
     assert results == [{"rank": 0, "val": 20}, {"rank": 1, "val": 20}]
+
+    # a closure above one MAX_ARG_STRLEN chunk (reassembled from numbered
+    # env vars on the worker side — exec_run.stdin_env_keys order)
+    import hashlib
+    big = bytes(range(256)) * 1200  # ~300 KiB -> ~400 KiB base64, 5 chunks
+    want = hashlib.sha256(big).hexdigest()
+
+    def big_fn():
+        import hashlib as h
+        import horovod_tpu as hvd
+        return hvd.cross_rank(), h.sha256(big).hexdigest()
+
+    big_results = run(big_fn, np=2, hosts="localhost:1,127.0.0.2:1",
+                      settings=Settings(num_proc=2, start_timeout_s=300))
+    assert big_results == [(0, want), (1, want)]
 
     def boom():
         raise ValueError("deliberate-worker-error")
