@@ -60,6 +60,13 @@ def main():
                    default="auto",
                    help="context-parallel attention over the sp axis "
                         "(docs/long-context.md); auto = dense/flash")
+    p.add_argument("--remat-policy",
+                   choices=["default", "full", "dots", "dots_attn", "attn"],
+                   default="default",
+                   help="checkpoint policy; attn/dots_attn save the "
+                        "flash residuals so the backward skips the "
+                        "fwd-kernel re-run (docs/benchmarks.md, remat "
+                        "section)")
     args = p.parse_args()
 
     hvd.init()
@@ -69,10 +76,13 @@ def main():
         raise SystemExit(f"dp*sp*tp = {dp}*{args.sp}*{args.tp} != {n} devices")
     mesh = create_mesh({"dp": dp, "sp": args.sp, "tp": args.tp})
 
+    import dataclasses
     cfg = MODELS[args.model]()
     if args.attention_impl != "auto":
-        import dataclasses
         cfg = dataclasses.replace(cfg, attention_impl=args.attention_impl)
+    if args.remat_policy != "default":
+        cfg = dataclasses.replace(cfg, remat=True,
+                                  remat_policy=args.remat_policy)
     model = Llama(cfg)
     opt = optax.adamw(args.lr, weight_decay=0.01)
 

@@ -138,8 +138,15 @@ class Bert(nn.Module):
 
 
 def mlm_loss(logits, labels, mask):
-    """Masked-LM cross entropy over positions where ``mask`` is set."""
-    ll = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(ll, labels[..., None], axis=-1)[..., 0]
+    """Masked-LM cross entropy over positions where ``mask`` is set.
+
+    ``logsumexp - target_logit`` rather than a materialized
+    ``log_softmax``: the [B,T,V] f32 log-probs cost an extra HBM
+    write+read per step for values immediately reduced away (the
+    next_token_loss rationale, train.py)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - tgt
     m = mask.astype(nll.dtype)
     return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)

@@ -21,6 +21,9 @@ EXAMPLES = [
     ("train_llama.py", ["--model", "tiny", "--dp", "2", "--sp", "2",
                         "--tp", "2", "--batch-size", "4", "--seq-len", "32",
                         "--steps", "2", "--warmup", "1"], "tokens/sec"),
+    ("train_llama.py", ["--model", "tiny", "--batch-size", "4",
+                        "--seq-len", "32", "--steps", "2", "--warmup", "1",
+                        "--remat-policy", "dots_attn"], "tokens/sec"),
     ("train_mixtral.py", ["--dp", "2", "--ep", "4", "--batch-size", "4",
                           "--seq-len", "32", "--steps", "2",
                           "--warmup", "1"], "tokens/sec"),
