@@ -133,6 +133,11 @@ class Bert(nn.Module):
         x = nn.gelu(x)
         x = nn.LayerNorm(epsilon=c.norm_eps, dtype=c.dtype,
                          name="mlm_norm")(x)
+        # Deliberately f32xf32 (NOT the llama.py bf16-operand head): the
+        # bf16+f32-accum variant measured 0.5% SLOWER interleaved at the
+        # bench config — XLA already decomposes this f32 matmul
+        # efficiently at [4096, 1024] x [1024, 30522] (docs/benchmarks.md,
+        # BERT profile section).
         logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32), emb)
         return logits
 
