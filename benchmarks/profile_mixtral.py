@@ -46,7 +46,8 @@ def main():
     # EXACTLY the benchmarks/mixtral.py TPU config
     cfg = MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
                         n_heads=8, n_kv_heads=4, hidden_dim=1792,
-                        n_experts=8, top_k=2, max_seq_len=1024)
+                        n_experts=8, top_k=2, max_seq_len=1024,
+                        use_flash=False, remat_policy="dots_attn")
     per_chip = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     seq = 512
     batch = per_chip * hvd.size()

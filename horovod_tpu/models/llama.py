@@ -236,7 +236,12 @@ class Attention(nn.Module):
             mask = jnp.tril(jnp.ones((T, T), bool))
             s = jnp.where(mask[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1).astype(c.dtype)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+            # same tag as the flash path so "attn"/"dots_attn" save the
+            # context on materialized-attention configs too (its einsums
+            # have batch dims, so the "dots" policy recomputes them)
+            from jax.ad_checkpoint import checkpoint_name
+            o = checkpoint_name(
+                jnp.einsum("bhqk,bkhd->bqhd", p, v), "attn_out")
         o = o.reshape(B, T, c.n_heads * head_dim)
         out = nn.Dense(
             c.dim, use_bias=False, dtype=c.dtype, name="wo",
