@@ -2,12 +2,14 @@
 
 r4 follow-up to the Llama op profile: 33.7% of the step is elementwise +
 full-remat recompute and the flash kernels (32.6%) run their forward
-TWICE per step under ``remat_policy="full"``. ``dots_attn`` saves the
-flash kernel's (o, m, l) by name (ops/flash_attention.py) so the
-backward runs only the dedicated bwd kernels. This measures
-full vs dots vs dots_attn at the bench batch, interleaved
-(``slope_time_paired``) because absolute single-run readings swing ±10%
-over the tunnel.
+TWICE per step under ``remat_policy="full"``. The "attn" policy saves
+the flash kernel's (o, m, l) by name (ops/flash_attention.py) so the
+backward runs only the dedicated bwd kernels. POLICIES below picks the
+arms — default full vs attn, the two that FIT at the bench batch (the
+"dots" family saves non-batch dot outputs, ~7 GB at this shape, and
+OOMs at batch 8; it was measured at batch 4 and for the longctx/Mixtral
+shapes instead). Interleaved (``slope_time_paired``) because absolute
+single-run readings swing ±10% over the tunnel.
 
 Usage (real chip):  python benchmarks/llama_remat_ab.py [per_chip_batch]
 """

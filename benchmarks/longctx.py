@@ -48,9 +48,10 @@ def main():
                       remat=tpu, scan_layers=tpu,
                       # saving the flash residuals pays most at long seq:
                       # +13.5% over "dots" at seq 4096 (55.6k vs 50.1k
-                      # tok/s interleaved; the materialised arm has no
-                      # named flash outputs so the policy degrades to
-                      # "dots" there). See benchmarks/llama_remat_ab.py.
+                      # tok/s interleaved). The materialised arm saves its
+                      # (named) context output too, so the in-run flash
+                      # ratio compares both arms WITH the policy applied.
+                      # See benchmarks/llama_remat_ab.py.
                       remat_policy="dots_attn" if tpu else "dots")
     per_chip = 1
     batch = per_chip * n

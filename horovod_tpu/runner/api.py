@@ -49,12 +49,15 @@ def _fetch_remote_results(hostname: str, path: str,
                                capture_output=True, timeout=120)
             if r.returncode != 0:
                 continue
+        except (subprocess.TimeoutExpired, OSError):
+            continue
+        try:  # cleanup is best-effort: the blob is already in hand
             subprocess.run(
                 base + [f"rm -rf {shlex.quote(os.path.dirname(path))}"],
                 capture_output=True, timeout=60)
-            return r.stdout
         except (subprocess.TimeoutExpired, OSError):
-            continue
+            pass
+        return r.stdout
     return None
 
 
