@@ -144,6 +144,9 @@ class ElasticDriver:
         np_ = self._target_np(hosts)
         assignments = get_host_assignments(infos, np_)
         used = {a.hostname for a in assignments}
+        # Where rank 0 ran last — runner.api's elastic function launch
+        # fetches the results blob from there after the job succeeds.
+        self.last_first_host = assignments[0].hostname
         coord = default_coordinator_addr(assignments, self._settings)
         extra = {
             C.COORD_ADDR_ENV: self._service.addr(

@@ -63,6 +63,8 @@ def _fetch_remote_results(hostname: str, path: str,
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         np: int = 1, hosts: Optional[str] = None,
+        min_np: Optional[int] = None, max_np: Optional[int] = None,
+        host_discovery_script: Optional[str] = None,
         settings: Optional[Settings] = None,
         verbose: int = 0) -> List[Any]:
     """Run ``fn(*args, **kwargs)`` on every host process; returns the list
@@ -76,13 +78,30 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     settings) environment, workers allgather their results over the
     engine so rank 0 holds all of them, rank 0 writes ONE results blob,
     and the launcher reads it locally or fetches it over ssh.
+
+    Elastic (r4; the reference accepts ``min_np``/``max_np``/discovery on
+    ``horovod.run`` too): any of ``min_np``/``max_np``/
+    ``host_discovery_script`` routes the launch through the
+    :class:`~horovod_tpu.elastic.driver.ElasticDriver` generation loop —
+    membership changes retire the generation and re-run ``fn`` on the new
+    world (use ``hvd.elastic`` state inside ``fn`` for continuity across
+    resets). Results come from the finally-successful generation, via the
+    one-blob transport (forced under elastic: per-process files could mix
+    generations), sized to THAT generation's world.
     """
     import cloudpickle
     s = settings or Settings(num_proc=np, verbose=verbose)
+    elastic = bool(min_np or max_np or host_discovery_script)
+    if elastic:
+        import dataclasses
+        s = dataclasses.replace(
+            s, elastic=True, min_np=min_np, max_np=max_np,
+            host_discovery_script=host_discovery_script,
+            hosts=parse_hosts(hosts) if hosts else s.hosts)
     hs = parse_hosts(hosts) if hosts else parse_hosts(f"localhost:{np}")
     assignments = get_host_assignments(hs, np)
     remote = any(not is_local(a.hostname) for a in assignments)
-    use_env_fn = remote or os.environ.get(
+    use_env_fn = elastic or remote or os.environ.get(
         "HOROVOD_RUN_REMOTE_TRANSPORT", "") == "1"
     blob = cloudpickle.dumps((fn, args, kwargs or {}))
     with tempfile.TemporaryDirectory(prefix="hvd_run_") as tmp:
@@ -114,10 +133,17 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 f.write(blob)
             command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
                        fn_path, tmp]
-        code = launch_job(assignments, command, s,
-                          coordinator_addr=default_coordinator_addr(
-                              assignments, s),
-                          secret_key=secret.make_secret_key())
+        result_host = assignments[0].hostname
+        if elastic:
+            from ..elastic.driver import ElasticDriver
+            driver = ElasticDriver(s, command)
+            code = driver.run()
+            result_host = getattr(driver, "last_first_host", result_host)
+        else:
+            code = launch_job(assignments, command, s,
+                              coordinator_addr=default_coordinator_addr(
+                                  assignments, s),
+                              secret_key=secret.make_secret_key())
 
         all_results = None
         if use_env_fn:
@@ -126,14 +152,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             if os.path.exists(all_path):
                 with open(all_path, "rb") as f:
                     raw = f.read()
-            elif not is_local(assignments[0].hostname):
-                raw = _fetch_remote_results(assignments[0].hostname,
-                                            all_path, s)
+            elif not is_local(result_host):
+                raw = _fetch_remote_results(result_host, all_path, s)
             if raw is not None:
                 all_results = cloudpickle.loads(raw)
 
         def load_result(a):
             if all_results is not None:
+                if a.process_id >= len(all_results):  # elastic shrink
+                    return 1, None
                 return all_results[a.process_id]
             path = os.path.join(tmp, f"result.{a.process_id}.pkl")
             if not os.path.exists(path):
@@ -160,13 +187,16 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             raise RuntimeError(
                 "horovod_tpu.runner.run: all workers completed but the "
                 f"results blob could not be read from "
-                f"{assignments[0].hostname}:{all_path}; the results may "
+                f"{result_host}:{all_path}; the results may "
                 "still be on that host — check ssh connectivity and re-run")
+        # Under elastic the successful generation's world size may differ
+        # from the requested assignments — the blob is the authority there.
+        pairs = list(all_results) if all_results is not None \
+            else [load_result(a) for a in assignments]
         results = []
-        for a in assignments:
-            rcode, val = load_result(a)
+        for pid, (rcode, val) in enumerate(pairs):
             if rcode != 0:
                 raise RuntimeError(
-                    f"worker {a.process_id} reported failure: {val!r}")
+                    f"worker {pid} reported failure: {val!r}")
             results.append(val)
         return results

@@ -286,6 +286,23 @@ def test_run_function_multi_host_env_transport(monkeypatch):
                       settings=Settings(num_proc=2, start_timeout_s=300))
     assert big_results == [(0, want), (1, want)]
 
+
+@pytest.mark.integration
+def test_run_function_elastic_fixed_hosts():
+    """min_np routes runner.run() through the ElasticDriver generation
+    loop (the reference's horovod.run accepts the elastic knobs too):
+    fixed discovery from hosts=, one successful generation, results via
+    the forced one-blob transport sized to that generation's world."""
+    from horovod_tpu.runner import run
+
+    def fn():
+        import horovod_tpu as hvd
+        return ("gen", hvd.cross_rank(), hvd.cross_size())
+
+    results = run(fn, min_np=2, hosts="localhost:1,127.0.0.2:1",
+                  settings=Settings(num_proc=2, start_timeout_s=300))
+    assert results == [("gen", 0, 2), ("gen", 1, 2)]
+
     def boom():
         raise ValueError("deliberate-worker-error")
 
