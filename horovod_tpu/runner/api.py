@@ -63,6 +63,7 @@ def _fetch_remote_results(hostname: str, path: str,
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         np: int = 1, hosts: Optional[str] = None,
+        hostfile: Optional[str] = None,
         min_np: Optional[int] = None, max_np: Optional[int] = None,
         host_discovery_script: Optional[str] = None,
         settings: Optional[Settings] = None,
@@ -90,6 +91,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     generations), sized to THAT generation's world.
     """
     import cloudpickle
+    if hostfile and not hosts:  # reference run() accepts hostfile= too
+        from .hosts import parse_host_files
+        hosts = parse_host_files(hostfile)
     s = settings or Settings(num_proc=np, verbose=verbose)
     elastic = bool(min_np or max_np or host_discovery_script)
     if elastic:

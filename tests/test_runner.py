@@ -508,3 +508,15 @@ def test_timeline_path_is_per_worker_on_multihost():
                         first_rank=0, local_size=1, world_size=1)
     env0 = get_run_env(a0, s, "a:1")
     assert env0["HOROVOD_TIMELINE"] == "/tmp/t.json"   # single proc: as-is
+
+
+def test_run_function_accepts_hostfile(tmp_path):
+    """run(hostfile=...) parses the mpirun-style file like the CLI's
+    --hostfile (reference run() accepts hostfile= too). One result per
+    HOST process — the launcher's one-process-per-host model."""
+    from horovod_tpu.runner import run
+    hf = tmp_path / "hosts.txt"
+    hf.write_text("localhost slots=1\n127.0.0.2 slots=1\n")
+    results = run(lambda: 7, np=2, hostfile=str(hf),
+                  settings=Settings(num_proc=2, start_timeout_s=300))
+    assert results == [7, 7]
