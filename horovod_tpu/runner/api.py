@@ -163,6 +163,10 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
 
         def load_result(a):
             if all_results is not None:
+                # Only reachable from the failure-details loop below (the
+                # success path consumes all_results directly); the shrink
+                # guard just keeps that loop safe when a stale generation
+                # blob has fewer entries than the requested assignments.
                 if a.process_id >= len(all_results):  # elastic shrink
                     return 1, None
                 return all_results[a.process_id]
@@ -188,6 +192,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
             # wrote the blob — only the retrieval failed. Say so (and where
             # the results still live) instead of misreporting worker failure.
             all_path = os.path.join(tmp, "results.all.pkl")
+            if is_local(result_host):
+                # Local host: there is no fetch step, so absence of the blob
+                # means rank 0 never wrote it — a write failure, not a
+                # connectivity problem.
+                raise RuntimeError(
+                    "horovod_tpu.runner.run: all workers completed but rank "
+                    f"0 never wrote the results blob {all_path} on the "
+                    "local host — check disk space/permissions for the "
+                    "results directory")
             raise RuntimeError(
                 "horovod_tpu.runner.run: all workers completed but the "
                 f"results blob could not be read from "

@@ -286,6 +286,30 @@ def test_run_function_multi_host_env_transport(monkeypatch):
                       settings=Settings(num_proc=2, start_timeout_s=300))
     assert big_results == [(0, want), (1, want)]
 
+    # a failing worker's traceback must surface through the SAME forced
+    # env/one-blob transport (the monkeypatched env var is still live here)
+    def boom():
+        raise ValueError("deliberate-worker-error")
+
+    with pytest.raises(RuntimeError, match="deliberate-worker-error"):
+        run(boom, np=2, hosts="localhost:1,127.0.0.2:1",
+            settings=Settings(num_proc=2, start_timeout_s=300))
+
+
+@pytest.mark.integration
+def test_run_function_failure_per_rank_files():
+    """The DEFAULT transport (all-local hosts, no env forcing) reports a
+    failing worker via its per-rank result.N.pkl — the load_result file
+    branch, distinct from the env/one-blob path tested above."""
+    from horovod_tpu.runner import run
+
+    def boom():
+        raise ValueError("deliberate-worker-error")
+
+    with pytest.raises(RuntimeError, match="deliberate-worker-error"):
+        run(boom, np=2, hosts="localhost:1,127.0.0.2:1",
+            settings=Settings(num_proc=2, start_timeout_s=300))
+
 
 @pytest.mark.integration
 def test_run_function_elastic_fixed_hosts():
@@ -302,13 +326,6 @@ def test_run_function_elastic_fixed_hosts():
     results = run(fn, min_np=2, hosts="localhost:1,127.0.0.2:1",
                   settings=Settings(num_proc=2, start_timeout_s=300))
     assert results == [("gen", 0, 2), ("gen", 1, 2)]
-
-    def boom():
-        raise ValueError("deliberate-worker-error")
-
-    with pytest.raises(RuntimeError, match="deliberate-worker-error"):
-        run(boom, np=2, hosts="localhost:1,127.0.0.2:1",
-            settings=Settings(num_proc=2, start_timeout_s=300))
 
 
 def test_get_run_env_blocklist_and_timeout(monkeypatch):
