@@ -197,6 +197,43 @@ class CollectiveEngine:
     def barrier(self, name: str = "barrier", members=None) -> None:
         raise NotImplementedError
 
+    # -- object helpers (generic over the public ops) ------------------------
+
+    def gather_object(self, obj, name: str = "gather_object",
+                      members=None) -> list:
+        """One picklable object per (member) process → member-ordered list
+        (reference ``hvd.allgather_object`` transport). Built on the public
+        ``allgather`` so every engine inherits the mismatch protocol and —
+        on JaxProcessEngine — the transport stall watchdog."""
+        import pickle
+        blob = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8).copy()
+        sizes = np.asarray(self.allgather(
+            f"{name}.sizes", np.asarray([blob.size], dtype=np.int64),
+            members)).reshape(-1)
+        rows = np.asarray(self.allgather(f"{name}.bytes", blob, members))
+        out, off = [], 0
+        for s in sizes.tolist():
+            out.append(pickle.loads(rows[off:off + int(s)].tobytes()))
+            off += int(s)
+        return out
+
+    def broadcast_object(self, obj, root_rank: int = 0,
+                         name: str = "broadcast_object", members=None):
+        """Root's picklable object to every (member) process (reference
+        ``hvd.broadcast_object`` transport): receivers pass ``arr=None``
+        and learn the byte length from the root's header round."""
+        import pickle
+        if self.rank() == root_rank:
+            blob = np.frombuffer(
+                pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                dtype=np.uint8).copy()
+            self.broadcast(name, blob, root_rank, members)
+            return obj
+        rows = self.broadcast(name, None, root_rank, members)
+        return pickle.loads(np.asarray(rows, dtype=np.uint8).tobytes())
+
     def _check_member(self, members) -> None:
         if members is not None and self.rank() not in members:
             raise ValueError(
@@ -532,6 +569,7 @@ class JaxProcessEngine(CollectiveEngine):
         self._joined = False
         self._device_fns: dict = {}  # (len, dtype, op, scatter) -> jitted
         self._cache_init()
+        self._stall_init()
 
     #: mpi_ops keys on this to serialize submission (program order).
     requires_ordered_submission = True
@@ -576,6 +614,108 @@ class JaxProcessEngine(CollectiveEngine):
         # full round for that op (the protocol's normal asymmetric path).
         self._sig_seen: "collections.OrderedDict[tuple, int]" = \
             collections.OrderedDict()
+
+    # -- transport stall watchdog --------------------------------------------
+    #
+    # The reference surfaces a dead peer THROUGH the collective itself: a
+    # NCCL abort / Gloo timeout / MPI failure errors the op and the worker
+    # raises HorovodInternalError, which ``@hvd.elastic.run`` catches for
+    # recovery (SURVEY.md §3.4, ``horovod/common/operations.cc`` status
+    # propagation). XLA's DCN collectives have no such deadline — a rank
+    # blocked in ``process_allgather`` against a dead peer waits forever.
+    # The analog here (VERDICT r4 #1): every blocking transport call runs on
+    # a dedicated round thread while the caller waits with the
+    # ``HOROVOD_STALL_CHECK_*`` windows — warn after the warning window
+    # (reference stall_inspector.cc warning) and raise
+    # ``HorovodInternalError`` in the blocked op after the shutdown window
+    # (reference ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``, same default of 0
+    # = never; the elastic driver arms it for its workers, where a relaunch
+    # makes the error recoverable — see elastic/driver.py).
+
+    def _stall_init(self) -> None:
+        from . import context_api as _ctx
+        from .config import Config
+        cfg = _ctx.context().config if _ctx.is_initialized() \
+            else Config.from_env()
+        disabled = bool(cfg.stall_check_disable)
+        self._stall_warn = 0.0 if disabled \
+            else float(cfg.stall_check_warning_sec)
+        self._stall_shutdown = 0.0 if disabled \
+            else float(cfg.stall_check_shutdown_sec)
+        self._stall_queue = None         # created on first bounded call
+        self._stall_in_pool = threading.local()
+        self._transport_lost: Optional[str] = None
+
+    def _stall_worker(self) -> None:
+        """Round-thread loop. A DAEMON thread on purpose: after a stall
+        it stays parked in the dead collective forever, and a non-daemon
+        thread there would hang interpreter shutdown — ``sys.exit(RESTART)``
+        in elastic/run_fn.py must actually exit so the driver can relaunch
+        (concurrent.futures' non-daemon workers are joined at exit, which
+        is why this is a bare thread + queue and not a ThreadPoolExecutor).
+        """
+        self._stall_in_pool.flag = True
+        while True:
+            fn, box = self._stall_queue.get()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                box["error"] = e
+            box["done"].set()
+
+    def _bounded(self, fn, what: str):
+        """Run one blocking transport call under the stall watchdog.
+
+        With both windows unset this is a direct call (zero overhead, the
+        pre-watchdog behavior). Armed, ``fn`` runs on the engine's round
+        thread; on shutdown-window expiry the CALLER unblocks with
+        ``HorovodInternalError`` while the round thread stays parked on the
+        dead collective — the engine is then marked transport-lost (every
+        later op raises immediately) because recovery requires re-init:
+        process restart under the elastic driver, exactly like the
+        reference's shutdown-after-stall escalation.
+        """
+        warn, shutdown = self._stall_warn, self._stall_shutdown
+        if warn <= 0 and shutdown <= 0:
+            return fn()
+        if getattr(self._stall_in_pool, "flag", False):
+            return fn()   # nested transport call, already on the round thread
+        if self._transport_lost is not None:
+            from .exceptions import HorovodInternalError
+            raise HorovodInternalError(self._transport_lost)
+        if self._stall_queue is None:
+            import queue
+            self._stall_queue = queue.Queue()
+            threading.Thread(target=self._stall_worker, daemon=True,
+                             name="hvd-engine-round").start()
+        box = {"done": threading.Event()}
+        self._stall_queue.put((fn, box))
+        import time as _time
+        start = _time.monotonic()
+        warned = False
+        while True:
+            if box["done"].wait(timeout=0.25):
+                if "error" in box:
+                    raise box["error"]
+                return box["result"]
+            idle = _time.monotonic() - start
+            if warn > 0 and idle >= warn and not warned:
+                warned = True
+                from .logging import get_logger
+                get_logger().warning(
+                    "engine %s blocked for %.0fs — a peer may be dead "
+                    "or hung (reference stall_inspector warning; "
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=%.0f)",
+                    what, idle, shutdown)
+            if shutdown > 0 and idle >= shutdown:
+                from .exceptions import HorovodInternalError
+                self._transport_lost = (
+                    f"engine {what} stalled for >{shutdown:.0f}s "
+                    "(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); the "
+                    "transport is considered lost — re-init required "
+                    "(under hvdrun --min-np the elastic driver "
+                    "relaunches the job)")
+                raise HorovodInternalError(self._transport_lost)
 
     @staticmethod
     def _sig_hash(sig: tuple) -> int:
@@ -670,12 +810,19 @@ class JaxProcessEngine(CollectiveEngine):
     def _allgather_fixed(self, arr: np.ndarray, members=None) -> np.ndarray:
         """[...]-shaped array from each (member) process → [k, ...] stack
         in member order. The ONLY transport primitive; everything else is
-        protocol. ``members=None`` = all processes."""
+        protocol. ``members=None`` = all processes. Runs under the stall
+        watchdog: a dead peer bounds out with HorovodInternalError instead
+        of blocking forever (see ``_bounded``)."""
+        arr = np.asarray(arr)
         if members is not None:
-            return self._device_gather(np.asarray(arr), members)
+            return self._bounded(
+                lambda: self._device_gather(arr, members),
+                "subgroup gather round")
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            np.asarray(arr), tiled=False))
+        return self._bounded(
+            lambda: np.asarray(multihost_utils.process_allgather(
+                arr, tiled=False)),
+            "allgather round")
 
     def _member_mesh(self, members):
         """One-device-per-member-process mesh (the reference's
@@ -781,9 +928,18 @@ class JaxProcessEngine(CollectiveEngine):
                     "controller would stall here)")
             if not active:
                 return headers, None
-            ref = next(h for h in headers if not h["joined"])
+            # Shape-unknown broadcast receivers (arr=None, marked
+            # "noshape") cannot define the payload geometry — the shape
+            # reference must come from a rank that actually has data.
+            try:
+                ref = next(h for h in headers
+                           if not h["joined"] and not h.get("noshape"))
+            except StopIteration:
+                raise RuntimeError(
+                    "broadcast: every active rank passed arr=None — the "
+                    "root must supply the tensor")
             shape1 = tuple(ref["shape"][1:])
-            if header["joined"]:
+            if header["joined"] or payload is None:
                 payload = np.zeros((0,) + shape1, dtype=ref["dtype"])
             payloads = self._gather_var(payload, shape1, ref["dtype"],
                                         members)
@@ -851,10 +1007,14 @@ class JaxProcessEngine(CollectiveEngine):
             self._device_fns[key] = entry
         fn, mesh = entry
         from jax.experimental import multihost_utils
-        gx = multihost_utils.host_local_array_to_global_array(
-            flat[None], mesh, P("p"))
-        out = fn(gx)
-        return np.asarray(out.addressable_shards[0].data)
+
+        def _execute():
+            gx = multihost_utils.host_local_array_to_global_array(
+                flat[None], mesh, P("p"))
+            out = fn(gx)
+            return np.asarray(out.addressable_shards[0].data)
+
+        return self._bounded(_execute, "device-reduce payload")
 
     # -- collectives ---------------------------------------------------------
 
@@ -963,9 +1123,10 @@ class JaxProcessEngine(CollectiveEngine):
         sig = None if arr is None else (
             "gather", "broadcast", name, tuple(arr.shape), str(arr.dtype),
             root_rank, members)
-        headers, payloads = self._round(
-            self._header("broadcast", name, payload,
-                         {"root": root_rank}), payload, members, sig=sig)
+        hdr = self._header("broadcast", name, payload, {"root": root_rank})
+        if arr is None:
+            hdr["noshape"] = True   # receiver: learn geometry from the root
+        headers, payloads = self._round(hdr, payload, members, sig=sig)
         # headers/payloads are in member order; root_rank is a GLOBAL rank.
         if members is not None:
             if root_rank not in members:

@@ -37,3 +37,14 @@ DEFAULT_POLL_INTERVAL_S = 0.2
 
 #: driver: how many failures (within the cooldown window) blacklist a host.
 BLACKLIST_STRIKES = 2
+
+#: driver: default HOROVOD_STALL_SHUTDOWN_TIME_SECONDS armed for workers
+#: it launches (the engine's transport watchdog — a survivor of a dead
+#: peer errors out and the driver relaunches the generation). Standalone
+#: runs keep the reference default of 0 (warn only). Sized to clear a
+#: straggler peer that is merely SLOW into a round (first-step XLA
+#: compile, big checkpoint restore), not dead — a too-small window turns
+#: that into a restart loop re-hitting the same slow phase each
+#: generation (bounded by --reset-limit). Jobs with >10-minute compiles
+#: or restores should raise it, or set 0 to disable (reference default).
+DEFAULT_STALL_SHUTDOWN_S = 600

@@ -155,6 +155,16 @@ class ElasticDriver:
             C.COMMIT_DIR_ENV: commit_dir,
             C.RESET_LIMIT_ENV: str(self._settings.reset_limit or 0),
         }
+        # Arm the engine's transport stall watchdog (core/engine.py
+        # _bounded): standalone runs keep the reference default (warn only,
+        # never shutdown — nobody would relaunch them), but under THIS
+        # driver a hung survivor of a dead peer is strictly worse than an
+        # error, because HorovodInternalError → RESTART exit → we relaunch
+        # the generation. User-provided values (env or settings) win.
+        stall_env = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+        if not os.environ.get(stall_env) and \
+                stall_env not in (self._settings.env or {}):
+            extra[stall_env] = str(C.DEFAULT_STALL_SHUTDOWN_S)
         out_dir = None
         if self._settings.output_filename:
             out_dir = os.path.join(self._settings.output_filename,

@@ -21,7 +21,6 @@ SURVEY.md §7 "hard parts").
 
 from __future__ import annotations
 
-import pickle
 from typing import Any, Optional
 
 import jax
@@ -49,20 +48,15 @@ def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
 def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     """Broadcast an arbitrary picklable Python object from ``root_rank``'s
     process (reference: ``hvd.broadcast_object`` via cloudpickle + byte
-    allgather). Single-host: identity."""
+    allgather). Single-host: identity. Rides the shared process engine
+    (the same transport as the torch/TF bindings), so a dead peer bounds
+    out via the engine's stall watchdog instead of hanging forever in a
+    raw ``multihost_utils`` broadcast."""
     if jax.process_count() == 1:
         return obj
-    from jax.experimental import multihost_utils
-    is_src = jax.process_index() == root_rank
-    payload = pickle.dumps(obj) if is_src else b""
-    # Length first (fixed shape), then padded byte buffer.
-    n = np.asarray([len(payload)], np.int32)
-    n = multihost_utils.broadcast_one_to_all(n, is_source=is_src)
-    buf = np.zeros((int(n[0]),), np.uint8)
-    if is_src:
-        buf[:] = np.frombuffer(payload, np.uint8)
-    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
-    return pickle.loads(buf.tobytes())
+    from horovod_tpu.core.context_api import process_engine
+    return process_engine().broadcast_object(obj, root_rank,
+                                             name="jax.broadcast_object")
 
 
 def join_allreduce(grads: Any, have_data, *,
@@ -114,12 +108,5 @@ def allgather_object(obj: Any) -> list:
     gather, the same shape discipline as ``broadcast_object``."""
     if jax.process_count() == 1:
         return [obj]
-    from jax.experimental import multihost_utils
-    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.asarray([payload.shape[0]], np.int64), tiled=False)).reshape(-1)
-    padded = np.zeros((int(sizes.max()),), np.uint8)
-    padded[:payload.shape[0]] = payload
-    g = np.asarray(multihost_utils.process_allgather(padded, tiled=False))
-    return [pickle.loads(g[i, :int(s)].tobytes())
-            for i, s in enumerate(sizes)]
+    from horovod_tpu.core.context_api import process_engine
+    return process_engine().gather_object(obj, name="jax.allgather_object")

@@ -85,7 +85,11 @@ def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
         if _state is not None:
             return
         if engine is None:
-            engine = _engine.default_engine()
+            # Shared with torch + the JAX-path object helpers (see
+            # core/context_api.process_engine): one instance = one round
+            # ordering + one signature cache across every binding.
+            from ..core.context_api import process_engine
+            engine = process_engine()
         _state = _TfRuntime(engine)
 
 

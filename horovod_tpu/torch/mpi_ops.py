@@ -136,8 +136,12 @@ def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
         if _state is not None:
             return
         if engine is None:
-            from ..core.engine import default_engine
-            engine = default_engine()
+            # The ONE shared process engine (context_api.process_engine):
+            # torch, TF, and the JAX-path object helpers must issue rounds
+            # through the same instance, or their unordered rounds over the
+            # one coordination service could cross-pair (r5 review).
+            from ..core.context_api import process_engine
+            engine = process_engine()
         _state = _TorchRuntime(engine)
 
 

