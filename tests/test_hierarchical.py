@@ -282,3 +282,52 @@ def test_train_step_hierarchical_end_to_end():
             ls.append(float(loss))
         losses[mode] = ls
     np.testing.assert_allclose(losses["hier"], losses["flat"], rtol=1e-5)
+
+
+def test_hierarchical_wire_byte_accounting():
+    """VERDICT r4 #6: operand bytes of the emitted collectives match the
+    ring-formula accounting without needing a second chip. Flat: one 8-way
+    all_reduce moving 2(n-1)/n*B per device on a group spanning BOTH
+    slices. Hierarchical: the only cross-slice (DCN) collective carries
+    B/n_intra — the slow-fabric phase shrinks by the intra factor while
+    the per-device grand total stays equal (the bytes move fabrics, they
+    don't disappear)."""
+    from wire_accounting import collective_wire_costs
+
+    x = jnp.asarray(np.random.RandomState(5).randn(8, 64).astype(np.float32))
+    B = 64 * 4                                     # per-device payload bytes
+    costs = {}
+    for flag in (False, True):
+        m2 = init_hier(flag)
+        f = shard_map(lambda t: ops.allreduce(t, hvd.Sum), mesh=m2,
+                      in_specs=P(("cross", "intra")),
+                      out_specs=P(("cross", "intra")))
+        costs[flag] = collective_wire_costs(jax.jit(f).lower(x).as_text())
+
+    flat = costs[False]
+    assert len(flat) == 1 and flat[0]["op"] == "all_reduce", flat
+    assert flat[0]["group_size"] == 8
+    assert flat[0]["operand_bytes"] == B
+    assert flat[0]["ring_bytes"] == pytest.approx(2 * 7 / 8 * B)
+    # its single group spans both slices — all B ride the cross fabric too
+    g0 = flat[0]["groups"][0]
+    assert any(d < 4 for d in g0) and any(d >= 4 for d in g0)
+
+    hier = costs[True]
+    by_op = {c["op"]: c for c in hier}
+    assert set(by_op) == {"reduce_scatter", "all_reduce", "all_gather"}, hier
+    rs, ar, ag = (by_op["reduce_scatter"], by_op["all_reduce"],
+                  by_op["all_gather"])
+    assert rs["group_size"] == 4 and rs["operand_bytes"] == B
+    assert rs["ring_bytes"] == pytest.approx(3 / 4 * B)
+    # the cross (DCN) phase carries only B/n_intra = B/4
+    assert ar["group_size"] == 2 and ar["operand_bytes"] == B // 4
+    assert ar["ring_bytes"] == pytest.approx(2 * (1 / 2) * (B // 4))
+    for grp in ar["groups"]:   # every cross group pairs slice 0 with slice 1
+        assert sum(d < 4 for d in grp) == 1 and sum(d >= 4 for d in grp) == 1
+    assert ag["group_size"] == 4 and ag["result_bytes"] == B
+    assert ag["ring_bytes"] == pytest.approx(3 / 4 * B)
+    # per-device grand total equals the flat ring cost: the win is WHERE
+    # the bytes ride (3/4 of them stay on ICI), not how many there are
+    assert sum(c["ring_bytes"] for c in hier) == \
+        pytest.approx(flat[0]["ring_bytes"])

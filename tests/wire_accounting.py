@@ -1,0 +1,76 @@
+"""Stablehlo collective wire-byte accounting (shared test helper).
+
+VERDICT r4 #6: the north-star bus-bandwidth formulas
+(benchmarks/collectives.py, NCCL-tests convention) have never been
+checkable on one chip — so instead of timing, these utilities parse the
+LOWERED program and compute each collective's per-device ring wire bytes
+from its operand sizes and replica groups:
+
+    all_reduce:     2(g-1)/g * operand_bytes
+    reduce_scatter:  (g-1)/g * operand_bytes
+    all_gather:      (g-1)/g * result_bytes
+    all_to_all:      (g-1)/g * operand_bytes
+
+Tests assert these against the same formulas evaluated analytically,
+which pins the wire contract (what rides which fabric, and how much)
+without needing a second chip.
+"""
+
+import re
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+                "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+_COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
+
+
+def _tensor_bytes(spec: str) -> int:
+    """'16xf32' / '2x4xi64' / 'f32' (scalar) -> total bytes."""
+    parts = spec.split("x")
+    elems = 1
+    for p in parts[:-1]:
+        elems *= int(p)
+    return elems * _DTYPE_BYTES[parts[-1]]
+
+
+def collective_wire_costs(hlo_text: str) -> list:
+    """Find every stablehlo collective; return a list (program order) of
+    dicts: op, group_size, groups (list of device-id lists), operand_bytes,
+    result_bytes, ring_bytes."""
+    lines = hlo_text.splitlines()
+    out = []
+    for i, line in enumerate(lines):
+        m = re.search(r'"stablehlo\.(%s)"' % "|".join(_COLLECTIVES), line)
+        if not m:
+            continue
+        op = m.group(1)
+        gm = re.search(
+            r"replica_groups = dense<(.*?)> : tensor<(\d+)x(\d+)xi64>", line)
+        assert gm, f"no replica_groups on collective line: {line[:200]}"
+        group_size = int(gm.group(3))
+        groups = [[int(v) for v in grp.split(",")]
+                  for grp in re.findall(r"\[([\d,\s]+)\]", gm.group(1))]
+        # The op's function signature ": (operands) -> results" sits on the
+        # same line (region-free ops) or on the region-closing line a few
+        # lines below; region bodies (add/min/...) carry no "->".
+        sig = None
+        for j in range(i, min(i + 16, len(lines))):
+            sm = re.search(r":\s*\(([^)]*)\)\s*->\s*(.+)$", lines[j])
+            if sm and "tensor<" in sm.group(1):
+                sig = sm
+                break
+        assert sig, f"no signature found for {op} at line {i}"
+        operand_bytes = sum(_tensor_bytes(s) for s in
+                            re.findall(r"tensor<([^>]+)>", sig.group(1)))
+        result_bytes = sum(_tensor_bytes(s) for s in
+                           re.findall(r"tensor<([^>]+)>", sig.group(2)))
+        g = group_size
+        ring = {"all_reduce": 2 * (g - 1) / g * operand_bytes,
+                "reduce_scatter": (g - 1) / g * operand_bytes,
+                "all_gather": (g - 1) / g * result_bytes,
+                "all_to_all": (g - 1) / g * operand_bytes}[op]
+        out.append({"op": op, "group_size": group_size, "groups": groups,
+                    "operand_bytes": operand_bytes,
+                    "result_bytes": result_bytes, "ring_bytes": ring})
+    return out
