@@ -1,16 +1,39 @@
-"""Round-over-round multi-chip guardrail: DP scaling efficiency on the
-8-virtual-device CPU mesh.
+"""Round-over-round multi-chip guardrail: distributed-machinery overhead
+on the 8-virtual-device CPU mesh.
 
-Why this exists (VERDICT r1 #9): real multi-chip hardware isn't available in
-this environment, so a regression in the collective path (gradient allreduce
-growing, BN sync duplicating, shard_map layout copies) would be invisible
-until real pods. This prints ONE JSON line comparing a 1-device train step
-at local batch b against the 8-device DP step at global batch 8b on the SAME
-virtual-CPU backend: per-chip work is identical, so ideal efficiency is 1.0
-and anything persistently below ~0.8 means the distributed machinery got
-more expensive relative to compute. CPU collectives are memcpys, not ICI —
-the ABSOLUTE number is not a TPU prediction; its round-over-round MOVEMENT
-is the signal (ratio-based, like bench.py's vs_baseline).
+Why this exists (VERDICT r1 #9): real multi-chip hardware isn't available
+in this environment, so a regression in the collective path (gradient
+allreduce growing, BN sync duplicating, shard_map layout copies,
+GSPMD-inserted collectives) would be invisible until real pods. Each arm
+compares a DISTRIBUTED 8-device train step against a no-collective
+"plain" step on the SAME 8-device mesh — identical models, batches, and
+core contention — so the ratio isolates exactly the machinery under
+guard. Ideal efficiency is 1.0 by construction; anything persistently
+below ~0.8 means the distributed path got more expensive relative to
+compute. CPU collectives are memcpys, not ICI — the ABSOLUTE number is
+not a TPU prediction; its round-over-round MOVEMENT is the signal.
+
+History note (VERDICT r4 weak #6 / #5): through r4 the baseline arm was a
+1-DEVICE step and ideal was ``t8 = 8*t1``. That read super-linear
+(1.02-1.05) because the two arms loaded the shared host differently: one
+small-kernel ResNetTiny program cannot fill every core, while 8
+concurrent device programs saturate them, so the fixed-compute-budget
+ideal was pessimistic and the "efficiency" inflated by the 1-device
+arm's underutilization — a bias larger than the regressions the
+guardrail exists to catch. r5 removed it by normalizing against a plain
+(collective-free) step on the same 8-device mesh: both arms now run 8
+concurrent programs, so host-parallelism effects cancel. History entries
+from 2026-07-31T13:00Z onward use the new normalization.
+
+Arms:
+- ``dp8``    ResNetTiny explicit shard_map DP (hvd allreduce + stat sync)
+             vs plain local-grad shard_map step.
+- ``hier8``  same step on the 2x4 cross/intra mesh with
+             HOROVOD_HIERARCHICAL_ALLREDUCE (reducescatter -> cross psum
+             -> allgather) vs the same plain step.
+- ``gspmd8`` tiny-Llama ``make_gspmd_train_step`` on a dp=8 GSPMD mesh
+             (the path all r4 perf work rides; XLA inserts the grad
+             allreduce from shardings) vs a plain local-grad Llama step.
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      python benchmarks/scaling.py
@@ -29,7 +52,7 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from common import median_ratio, slope_time_paired  # noqa: E402  (sets backend)
+from common import median_ratio, slope_time_paired, sync  # noqa: E402  (sets backend)
 
 import jax  # noqa: E402
 
@@ -38,31 +61,32 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+try:  # noqa: E402
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 
 S_SHORT, S_LONG = 4, 16
 LOCAL_BATCH = 8
+LLAMA_LOCAL_BATCH = 2
+LLAMA_SEQ = 64
 
 
-def main():
-    import horovod_tpu as hvd
+def _resnet_arms(hvd, rng, loss_fn):
+    """dist (hvd DP) / hier (2x4 hierarchical) / plain (no collectives)
+    ResNetTiny steps, all over the same 8 devices."""
     from horovod_tpu.models import ResNetTiny
     from horovod_tpu.optimizer import distributed
     from horovod_tpu.train import create_train_state, make_train_step
 
-    hvd.init()
     n = hvd.size()
-    assert n == 8, f"guardrail expects the 8-virtual-device mesh, got {n}"
+    batch = LOCAL_BATCH * n
+    images = jnp.asarray(rng.randn(batch, 32, 32, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 100, size=(batch,)))
 
-    rng = np.random.RandomState(0)
-
-    def loss_fn(logits, y):
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
-
-    def sync(x):
-        np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0]
-
-    def build(mesh, axis_name, batch):
+    def build_dist(mesh, axis_name):
         model = ResNetTiny(num_classes=100, dtype=jnp.float32,
                            axis_name=axis_name)
         # axis_name EXPLICIT everywhere: the jitted steps trace lazily at
@@ -70,8 +94,6 @@ def main():
         # mesh (this script rebuilds it for the hierarchical variant).
         dopt = distributed(optax.sgd(0.1, momentum=0.9),
                            axis_name=axis_name)
-        images = jnp.asarray(rng.randn(batch, 32, 32, 3).astype(np.float32))
-        labels = jnp.asarray(rng.randint(0, 100, size=(batch,)))
         state = create_train_state(model, jax.random.PRNGKey(0), images[:1],
                                    dopt)
         steps = {k: make_train_step(model, dopt, loss_fn, mesh=mesh,
@@ -84,10 +106,55 @@ def main():
             sync(loss)
         return run
 
+    def build_plain(mesh):
+        """Identical model/batch/optimizer, ZERO collectives: each device
+        trains on its local shard (stats and grads local). The compute
+        floor the distributed arms are normalized against."""
+        model = ResNetTiny(num_classes=100, dtype=jnp.float32,
+                           axis_name=None)
+        opt = optax.sgd(0.1, momentum=0.9)
+        variables = model.init(jax.random.PRNGKey(0), images[:1],
+                               train=False)
+        params, stats = variables["params"], variables.get("batch_stats", {})
+        opt_state = opt.init(params)
+
+        def local_step(carry, imgs, labs):
+            params, stats, opt_state = carry
+
+            def loss_of(p):
+                out, mut = model.apply(
+                    {"params": p, "batch_stats": stats}, imgs, train=True,
+                    mutable=["batch_stats"])
+                return loss_fn(out, labs), mut["batch_stats"]
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            updates, new_opt = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_stats,
+                    new_opt), loss
+
+        def make(k):
+            def stepk(params, stats, opt_state, imgs, labs):
+                def body(c, _):
+                    return local_step(c, imgs, labs)
+                (p, s, o), losses = jax.lax.scan(
+                    body, (params, stats, opt_state), None, length=k)
+                return losses[-1]
+            return jax.jit(shard_map(
+                stepk, mesh=mesh,
+                in_specs=(P(), P(), P(), P(mesh.axis_names), P(mesh.axis_names)),
+                out_specs=P(), check_vma=False))
+
+        steps = {k: make(k) for k in (S_SHORT, S_LONG)}
+
+        def run(k):
+            sync(steps[k](params, stats, opt_state, images, labels))
+        return run
+
     mesh8 = hvd.mesh()
-    mesh1 = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), (hvd.RANK_AXIS,))
-    run8 = build(mesh8, hvd.RANK_AXIS, LOCAL_BATCH * n)
-    run1 = build(mesh1, hvd.RANK_AXIS, LOCAL_BATCH)
+    run_dp = build_dist(mesh8, hvd.RANK_AXIS)
+    run_plain = build_plain(mesh8)
+
     # Hierarchical variant: same step over a 2x4 cross/intra mesh with
     # HOROVOD_HIERARCHICAL_ALLREDUCE semantics, guarding the
     # reducescatter->cross-psum->allgather path's cost each round.
@@ -96,37 +163,120 @@ def main():
     mesh_h = jax.sharding.Mesh(
         np.asarray(jax.devices()).reshape(2, n // 2), ("cross", "intra"))
     hvd.init(mesh=mesh_h, config=Config(hierarchical_allreduce=True))
-    run8h = build(mesh_h, ("cross", "intra"), LOCAL_BATCH * n)
+    run_hier = build_dist(mesh_h, ("cross", "intra"))
+    return run_dp, run_hier, run_plain
 
-    # Interleaved ratio. The 8 virtual devices SHARE the host's cores, so
-    # the 8-device step does 8x the total compute of the 1-device step on a
-    # fixed compute budget: ideal t8 = n*t1, i.e. ideal n*(t1/t8) = 1.0.
-    # Anything persistently below ~0.8 means the distributed machinery
-    # (allreduce, BN sync, shard_map layout moves) grew relative to compute.
+
+def _llama_arms(rng):
+    """GSPMD dp=8 tiny-Llama step (XLA-inserted grad allreduce) vs a plain
+    local-grad Llama step on the same mesh."""
+    from horovod_tpu.models.llama import LOGICAL_RULES, Llama, llama_tiny
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import (create_gspmd_train_state,
+                                   make_gspmd_train_step, next_token_loss)
+
+    n = len(jax.devices())
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    opt = optax.adamw(1e-3)
+    tokens = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (LLAMA_LOCAL_BATCH * n, LLAMA_SEQ)))
+
+    mesh = create_mesh({"dp": n}, devices=jax.devices())
+    state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(1),
+                                     tokens, mesh, LOGICAL_RULES)
+    gstep = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
+                                  donate=False)
+
+    def run_gspmd(k):
+        st, loss = state, None
+        for _ in range(k):
+            st, loss = gstep(st, tokens)
+        sync(loss)
+
+    from flax.linen import partitioning as nn_partitioning
+    with nn_partitioning.axis_rules(()):
+        variables = model.init(jax.random.PRNGKey(1), tokens[:1])
+    import flax.linen as nn
+    params = nn.meta.unbox(variables["params"])
+    opt_state = opt.init(params)
+
+    def plain_step(params, opt_state, toks):
+        def loss_of(p):
+            with nn_partitioning.axis_rules(()):
+                logits = model.apply({"params": p}, toks)
+            return next_token_loss(logits, toks)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    pstep = jax.jit(shard_map(
+        plain_step, mesh=mesh, in_specs=(P(), P(), P("dp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    def run_plain(k):
+        p, o, loss = params, opt_state, None
+        for _ in range(k):
+            p, o, loss = pstep(p, o, tokens)
+        sync(loss)
+
+    return run_gspmd, run_plain
+
+
+def main():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    n = hvd.size()
+    assert n == 8, f"guardrail expects the 8-virtual-device mesh, got {n}"
+
+    rng = np.random.RandomState(0)
+
+    def loss_fn(logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    run_dp, run_hier, run_plain = _resnet_arms(hvd, rng, loss_fn)
+    run_gspmd, run_lplain = _llama_arms(rng)
+
+    # Interleaved per-round ratios (common.py): every arm runs both scan
+    # lengths each round, so host drift and contention land on all arms
+    # equally; plain/dist on the SAME mesh makes ideal exactly 1.0.
     sec, rounds = slope_time_paired(
-        {"dp8": run8, "dp1": run1, "hier8": run8h},
+        {"dp8": run_dp, "hier8": run_hier, "plain8": run_plain,
+         "gspmd8": run_gspmd, "lplain8": run_lplain},
         S_SHORT, S_LONG, return_rounds=True)
-    eff = n * median_ratio(rounds, "dp1", "dp8")
-    eff_h = n * median_ratio(rounds, "dp1", "hier8")
+    eff = median_ratio(rounds, "plain8", "dp8")
+    eff_h = median_ratio(rounds, "plain8", "hier8")
+    eff_g = median_ratio(rounds, "lplain8", "gspmd8")
 
     rec = {
         "metric": "dp8_virtual_scaling_efficiency",
         "value": round(eff, 4),
-        "unit": f"n*t1/t8 (shared-core CPU mesh, ResNetTiny, "
-                f"batch {LOCAL_BATCH}/dev; ideal 1.0)",
+        "unit": f"t_plain/t_dist, same 8-dev CPU mesh, ResNetTiny, "
+                f"batch {LOCAL_BATCH}/dev; ideal 1.0",
         "vs_baseline": round(eff, 4),
     }
     rec_h = {
         "metric": "dp8_hierarchical_scaling_efficiency",
         "value": round(eff_h, 4),
-        "unit": "n*t1/t8, 2x4 cross/intra mesh, hierarchical allreduce",
+        "unit": "t_plain/t_dist, 2x4 cross/intra mesh, hierarchical "
+                "allreduce; ideal 1.0",
         "vs_baseline": round(eff_h, 4),
     }
-    print(json.dumps(rec))
-    print(json.dumps(rec_h))
+    rec_g = {
+        "metric": "llama_gspmd_scaling_efficiency",
+        "value": round(eff_g, 4),
+        "unit": f"t_plain/t_dist, dp=8 GSPMD tiny-Llama, batch "
+                f"{LLAMA_LOCAL_BATCH}/dev seq {LLAMA_SEQ}; ideal 1.0",
+        "vs_baseline": round(eff_g, 4),
+    }
+    for r in (rec, rec_h, rec_g):
+        print(json.dumps(r))
     if os.environ.get("HOROVOD_SCALING_NO_HISTORY", "").lower() \
             not in ("1", "true"):
-        _append_history([rec, rec_h])
+        _append_history([rec, rec_h, rec_g])
 
 
 def _append_history(records) -> None:
