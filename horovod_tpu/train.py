@@ -313,6 +313,25 @@ def gspmd_shardings(model, optimizer, rng, sample_tokens, mesh, rules):
         nn.get_partition_spec(abs_params), mesh, rules)
     opt_sharding = nn.logical_to_mesh_sharding(
         nn.get_partition_spec(abs_opt), mesh, rules)
+
+    def _fit_rank(sh, leaf):
+        # Rank-CHANGING optimizer states (Adafactor's factored v_row/v_col,
+        # SM3 diagonals, ...) inherit the full param's axis names from the
+        # flax box; a spec longer than the leaf's rank is invalid — store
+        # those small reduced moments replicated instead.
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is None:
+            # the spec tree's leaf pairs with a still-BOXED abs subtree
+            # (nn.Partitioned around one ShapeDtypeStruct)
+            inner = jax.tree_util.tree_leaves(leaf)
+            ndim = getattr(inner[0], "ndim", None) if len(inner) == 1 \
+                else None
+        if ndim is not None and isinstance(sh, NamedSharding) \
+                and len(sh.spec) > ndim:
+            return NamedSharding(mesh, P())
+        return sh
+
+    opt_sharding = jax.tree_util.tree_map(_fit_rank, opt_sharding, abs_opt)
     return param_sharding, opt_sharding
 
 
