@@ -33,7 +33,13 @@ import numpy as np
 from common import emit, lm_train_flops_per_token, mfu_fields, on_tpu, \
     params_count, slope_time_paired, sync
 
-VARIANTS = ("adamw", "bf16_nu", "bf16_munu", "factored", "deferred")
+#: "deferred2" = TWO-program deferral (optimizer.deferred_pair): the
+#: lax.cond "deferred" form measured ~flat because cond cannot alias the
+#: pass-through moments; two jitted programs with donation CAN (the skip
+#: program's expert bank aliases straight through). "deferred2_bf16nu"
+#: stacks the bf16 second moment on the apply program.
+VARIANTS = ("adamw", "bf16_nu", "bf16_munu", "factored", "deferred",
+            "deferred2", "deferred2_bf16nu")
 
 
 def main():
@@ -68,6 +74,27 @@ def main():
     model = Mixtral(cfg)
 
     def build(variant):
+        from horovod_tpu.optimizer import deferred_pair
+        from horovod_tpu.train import make_gspmd_deferred_train_step
+        if variant.startswith("deferred2"):
+            nu = jnp.bfloat16 if variant.endswith("bf16nu") else None
+            opt_a, opt_s = deferred_pair(1e-4, every=4, expert_nu_dtype=nu)
+            state = create_gspmd_train_state(model, opt_a,
+                                             jax.random.PRNGKey(0), tokens,
+                                             mesh, LOGICAL_RULES)
+            step = make_gspmd_deferred_train_step(
+                model, opt_a, opt_s, 4, mesh, LOGICAL_RULES,
+                aux_weight=cfg.router_aux_weight, donate=True)
+            box = {"state": state}
+
+            def run(k):
+                st, loss = box["state"], None
+                for _ in range(k):
+                    st, loss = step(st, tokens)
+                box["state"] = st
+                sync(loss)
+
+            return run, box
         opt = moe_adamw(1e-4, expert_variant=variant, every=4)
         state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
                                          tokens, mesh, LOGICAL_RULES)

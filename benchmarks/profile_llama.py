@@ -78,10 +78,23 @@ def main():
             rf"\[{V},{D}\]|\[{D},{V}\]")),
         ("lm-head/loss", re.compile(rf",{V}\]|\[{V},")),
     ]
+    cat = make_categorize(extra)
     report(f"llama_profile_b{per_chip}", totals, counts, wall_ps,
-           async_ps, STEPS,
-           categorize=make_categorize(extra),
+           async_ps, STEPS, categorize=cat,
            extra_json={"batch": batch, "seq": seq})
+
+    # r5 (VERDICT r4 #3): NAME the gather/scatter slice — dump the top
+    # instructions in that category with enough of the instruction text
+    # (shapes + fused-op structure) to attribute them to a source
+    # (scan-carry layer-weight slicing, rotary indexing, loss gather, ...).
+    gs = [(name, ps) for name, ps in totals.items()
+          if cat(name) in ("gather", "scatter", "gather/scatter")]
+    gs.sort(key=lambda kv: -kv[1])
+    grand = sum(totals.values())
+    print("\ngather/scatter attribution (top 10, full instruction text):")
+    for name, ps in gs[:10]:
+        print(f"  {ps/1e9:8.3f} ms {ps/grand:6.1%} n={counts[name]:<4} "
+              f"{name[:240]}")
 
 
 if __name__ == "__main__":

@@ -210,6 +210,55 @@ def is_expert_param(path: str) -> bool:
     return "moe" in path and path.rsplit("/", 1)[-1] in ("w1", "w2", "w3")
 
 
+def frozen_like(inner: optax.GradientTransformation):
+    """Same state STRUCTURE as ``inner``, zero updates, state passed
+    through untouched. The skip-program half of :func:`deferred_pair`:
+    because the state is an unmodified donated jit input, XLA aliases its
+    buffers to the output — zero HBM traffic — which ``lax.cond`` inside
+    one program cannot do (measured: the cond form's pass-through copies
+    ate the entire saving, docs/benchmarks.md r5)."""
+
+    def update(updates, state, params=None):
+        del params
+        return jax.tree_util.tree_map(jnp.zeros_like, updates), state
+
+    return optax.GradientTransformation(inner.init, update)
+
+
+def deferred_pair(learning_rate, *, every: int = 4,
+                  weight_decay: float = 1e-4, b1: float = 0.9,
+                  b2: float = 0.999, eps: float = 1e-8,
+                  expert_nu_dtype=None,
+                  is_expert: Callable[[str], bool] = is_expert_param):
+    """TWO-program expert-update deferral: returns ``(opt_apply,
+    opt_skip)`` with identical state structure. Compile each into its own
+    jitted step with donation (``train.make_gspmd_deferred_train_step``);
+    the skip program's expert param/m/v alias straight through (zero
+    optimizer HBM for the bank on k-1 of k steps) while the apply program
+    applies the ``every``-scaled AdamW update from the current gradient.
+    Constant LR only (same constraint as :func:`every_k`).
+    ``expert_nu_dtype=jnp.bfloat16`` stacks the reduced-precision second
+    moment on the apply program."""
+    if callable(learning_rate):
+        raise ValueError("deferred_pair needs a constant learning rate "
+                         "(the expert arm ticks only on apply steps)")
+    dense = optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                        weight_decay=weight_decay)
+    if expert_nu_dtype is not None:
+        expert_inner = adamw_low_precision(
+            learning_rate, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay, nu_dtype=expert_nu_dtype)
+    else:
+        expert_inner = optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps,
+                                   weight_decay=weight_decay)
+    expert_apply = optax.chain(expert_inner, optax.scale(float(every)))
+    labeler = (lambda p: "expert" if is_expert(p) else "dense")
+    opt_apply = partition({"dense": dense, "expert": expert_apply}, labeler)
+    opt_skip = partition({"dense": dense,
+                          "expert": frozen_like(expert_apply)}, labeler)
+    return opt_apply, opt_skip
+
+
 def moe_adamw(learning_rate, *, expert_variant: str = "adamw",
               weight_decay: float = 1e-4, b1: float = 0.9, b2: float = 0.999,
               eps: float = 1e-8, every: int = 4,

@@ -398,3 +398,27 @@ def make_gspmd_train_step(model, optimizer, mesh, rules, *,
             return jitted(state, tokens)
 
     return run
+
+
+def make_gspmd_deferred_train_step(model, opt_apply, opt_skip, every: int,
+                                   mesh, rules, **kw):
+    """Two-PROGRAM expert-update deferral (``optimizer.deferred_pair``):
+    compiles one step per optimizer and dispatches by a host-side step
+    counter — k-1 skip steps, then one apply step. The skip program's
+    untouched expert param/m/v are donated jit inputs returned unchanged,
+    so XLA aliases their buffers (zero optimizer HBM for the bank),
+    which a ``lax.cond`` inside ONE program cannot achieve (its
+    pass-through copies measured the saving away — docs/benchmarks.md
+    r5). Both optimizers must share a state structure; init with
+    ``opt_apply``. Requires ``donate=True`` (the default) for the
+    aliasing to exist."""
+    step_apply = make_gspmd_train_step(model, opt_apply, mesh, rules, **kw)
+    step_skip = make_gspmd_train_step(model, opt_skip, mesh, rules, **kw)
+    counter = {"n": 0}
+
+    def step(state, tokens):
+        counter["n"] += 1
+        fn = step_apply if counter["n"] % every == 0 else step_skip
+        return fn(state, tokens)
+
+    return step

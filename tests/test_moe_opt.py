@@ -142,3 +142,119 @@ def test_moe_adamw_dense_matches_plain_adamw():
         p_tx = optax.apply_updates(p_tx, u_tx)
     np.testing.assert_array_equal(np.asarray(p_ref["dense"]),
                                   np.asarray(p_tx["dense"]))
+
+
+def test_deferred_pair_two_program_semantics():
+    """deferred_pair: the skip program leaves the expert bank bit-identical
+    (pass-through state), the apply program moves it with the k-scaled
+    update; dense params move every step under both. Structures are
+    interchangeable (one init serves both)."""
+    params = _params()
+    from horovod_tpu.optimizer import deferred_pair
+    opt_a, opt_s = deferred_pair(1e-2, every=3)
+    state = opt_a.init(params)
+    p = params
+    moved_at = []
+    for step in range(1, 7):
+        tx = opt_a if step % 3 == 0 else opt_s
+        u, state = tx.update(_grads(step), state, p)
+        prev = np.asarray(p["moe"]["w1"]).copy()
+        dense_prev = np.asarray(p["dense"]).copy()
+        p = optax.apply_updates(p, u)
+        if not np.array_equal(np.asarray(p["moe"]["w1"]), prev):
+            moved_at.append(step)
+        assert not np.array_equal(np.asarray(p["dense"]), dense_prev)
+    assert moved_at == [3, 6], moved_at
+
+
+def test_deferred_pair_schedule_rejected():
+    from horovod_tpu.optimizer import deferred_pair
+    with pytest.raises(ValueError, match="constant learning rate"):
+        deferred_pair(optax.linear_schedule(1e-3, 1e-4, 100), every=4)
+
+
+def test_make_gspmd_deferred_train_step_counts():
+    """The two-program dispatcher applies the expert bank every k-th call
+    on a real (tiny, CPU) GSPMD Mixtral step."""
+    import jax
+    from horovod_tpu.models.llama import LOGICAL_RULES
+    from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+    from horovod_tpu.optimizer import deferred_pair
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import (create_gspmd_train_state,
+                                   make_gspmd_deferred_train_step)
+
+    cfg = mixtral_tiny()
+    mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    model = Mixtral(cfg)
+    opt_a, opt_s = deferred_pair(1e-3, every=2)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)))
+    state = create_gspmd_train_state(model, opt_a, jax.random.PRNGKey(0),
+                                     tokens, mesh, LOGICAL_RULES)
+    step = make_gspmd_deferred_train_step(model, opt_a, opt_s, 2, mesh,
+                                          LOGICAL_RULES, donate=False)
+
+    def expert_leaf(st):
+        flat, _ = jax.tree_util.tree_flatten_with_path(st.params)
+        for path, leaf in flat:
+            joined = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                              for k in path).lower()
+            if "moe" in joined and joined.rsplit("/", 1)[-1] == "w1":
+                return np.asarray(leaf).copy()
+        raise AssertionError("no expert leaf found")
+
+    moved = []
+    prev = expert_leaf(state)
+    for i in range(1, 5):
+        state, loss = step(state, tokens)
+        now = expert_leaf(state)
+        moved.append(not np.array_equal(now, prev))
+        prev = now
+        assert np.isfinite(float(np.asarray(loss)))
+    assert moved == [False, True, False, True], moved
+
+
+def test_deferred_pair_trains_comparably_to_adamw():
+    """Training QUALITY guard for the adopted deferred2 bench optimizer:
+    30 steps of tiny-Mixtral under deferred_pair(every=4, 4x-scaled LR)
+    must reach a final loss in the same regime as exact AdamW (standard
+    MoE practice, but it IS an algorithm change — keep it honest)."""
+    import jax
+    from horovod_tpu.models.llama import LOGICAL_RULES
+    from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+    from horovod_tpu.optimizer import deferred_pair
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.train import (create_gspmd_train_state,
+                                   make_gspmd_train_step,
+                                   make_gspmd_deferred_train_step)
+
+    cfg = mixtral_tiny()
+    mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    model = Mixtral(cfg)
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 24)))
+
+    def run(make_step, opt_init):
+        state = create_gspmd_train_state(model, opt_init,
+                                         jax.random.PRNGKey(1), tokens,
+                                         mesh, LOGICAL_RULES)
+        step = make_step(state)
+        losses = []
+        for _ in range(30):
+            state, loss = step(state, tokens)
+            losses.append(float(np.asarray(loss)))
+        return losses
+
+    ref_opt = optax.adamw(3e-3)
+    ref = run(lambda st: make_gspmd_train_step(
+        model, ref_opt, mesh, LOGICAL_RULES, donate=False), ref_opt)
+    opt_a, opt_s = deferred_pair(3e-3, every=4)
+    dfr = run(lambda st: make_gspmd_deferred_train_step(
+        model, opt_a, opt_s, 4, mesh, LOGICAL_RULES, donate=False), opt_a)
+
+    assert ref[-1] < ref[0] and dfr[-1] < dfr[0], (ref[:2], dfr[:2])
+    # same regime: deferred's final loss within 25% of AdamW's progress
+    ref_drop = ref[0] - ref[-1]
+    dfr_drop = dfr[0] - dfr[-1]
+    assert dfr_drop > 0.75 * ref_drop, (ref_drop, dfr_drop)
