@@ -80,11 +80,12 @@ def main():
         # AdamW number.
         from horovod_tpu.optimizer import deferred_pair
         from horovod_tpu.train import make_gspmd_deferred_train_step
-        opt, opt_skip = deferred_pair(1e-4, every=4)
-        state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
+        pair = deferred_pair(1e-4, every=4)
+        state = create_gspmd_train_state(model, pair.apply,
+                                         jax.random.PRNGKey(0),
                                          tokens, mesh, LOGICAL_RULES)
         step = make_gspmd_deferred_train_step(
-            model, opt, opt_skip, 4, mesh, LOGICAL_RULES,
+            model, pair, mesh, LOGICAL_RULES,
             aux_weight=cfg.router_aux_weight, donate=True)
     else:
         opt = optax.adamw(1e-4)
@@ -101,7 +102,11 @@ def main():
             state, loss = step(state, tokens)
         sync(loss)
 
-    tps = batch * seq / slope_time(run, 2, 8)
+    # 4/8 windows: both are multiples of the deferred2 cadence (every=4),
+    # so each timing cell holds whole apply+skip windows — a 2-step short
+    # cell would let min-over-repeats cherry-pick a 0-apply phase and
+    # bias the slope optimistic (r5 review).
+    tps = batch * seq / slope_time(run, 4, 8)
     # Active params per token: non-expert params + top_k/n_experts of the
     # routed expert bank (the MoE MFU convention — compute follows the
     # routed fraction, not the resident parameter count).

@@ -74,37 +74,29 @@ def main():
     model = Mixtral(cfg)
 
     def build(variant):
-        from horovod_tpu.optimizer import deferred_pair
-        from horovod_tpu.train import make_gspmd_deferred_train_step
         if variant.startswith("deferred2"):
+            from horovod_tpu.optimizer import deferred_pair
+            from horovod_tpu.train import make_gspmd_deferred_train_step
             nu = jnp.bfloat16 if variant.endswith("bf16nu") else None
-            opt_a, opt_s = deferred_pair(1e-4, every=4, expert_nu_dtype=nu)
-            state = create_gspmd_train_state(model, opt_a,
+            pair = deferred_pair(1e-4, every=4, expert_nu_dtype=nu)
+            state = create_gspmd_train_state(model, pair.apply,
                                              jax.random.PRNGKey(0), tokens,
                                              mesh, LOGICAL_RULES)
             step = make_gspmd_deferred_train_step(
-                model, opt_a, opt_s, 4, mesh, LOGICAL_RULES,
+                model, pair, mesh, LOGICAL_RULES,
                 aux_weight=cfg.router_aux_weight, donate=True)
-            box = {"state": state}
-
-            def run(k):
-                st, loss = box["state"], None
-                for _ in range(k):
-                    st, loss = step(st, tokens)
-                box["state"] = st
-                sync(loss)
-
-            return run, box
-        opt = moe_adamw(1e-4, expert_variant=variant, every=4)
-        state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
-                                         tokens, mesh, LOGICAL_RULES)
-        # donate=True (the bench setting): without donation the deferred
-        # variant's lax.cond COPIES the whole expert m/v through on every
-        # skip step — the copy costs more than the AdamW pass it skips
-        # (measured -14.6% with donate=False).
-        step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
-                                     aux_weight=cfg.router_aux_weight,
-                                     donate=True)
+        else:
+            opt = moe_adamw(1e-4, expert_variant=variant, every=4)
+            state = create_gspmd_train_state(model, opt,
+                                             jax.random.PRNGKey(0), tokens,
+                                             mesh, LOGICAL_RULES)
+            # donate=True (the bench setting): without donation the
+            # lax.cond deferred variant COPIES the whole expert m/v
+            # through on every skip step — the copy costs more than the
+            # AdamW pass it skips (measured -14.6% with donate=False).
+            step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
+                                         aux_weight=cfg.router_aux_weight,
+                                         donate=True)
         box = {"state": state}
 
         def run(k):

@@ -67,19 +67,23 @@ def main():
         # aliasing is the whole point).
         from horovod_tpu.optimizer import deferred_pair
         from horovod_tpu.train import make_gspmd_deferred_train_step
-        opt_a, opt_s = deferred_pair(1e-4, every=4)
-        state = create_gspmd_train_state(model, opt_a, jax.random.PRNGKey(0),
+        pair = deferred_pair(1e-4, every=4)
+        state = create_gspmd_train_state(model, pair.apply,
+                                         jax.random.PRNGKey(0),
                                          tokens, mesh, LOGICAL_RULES)
         step = make_gspmd_deferred_train_step(
-            model, opt_a, opt_s, 4, mesh, LOGICAL_RULES,
+            model, pair, mesh, LOGICAL_RULES,
             aux_weight=cfg.router_aux_weight, donate=True)
-    else:
+    elif variant == "adamw":
         opt = optax.adamw(1e-4)
         state = create_gspmd_train_state(model, opt, jax.random.PRNGKey(0),
                                          tokens, mesh, LOGICAL_RULES)
         step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
                                      aux_weight=cfg.router_aux_weight,
-                                     donate=(variant == "deferred2"))
+                                     donate=False)
+    else:
+        raise SystemExit(f"unknown MIXTRAL_PROFILE_OPT={variant!r} "
+                         "(use 'adamw' or 'deferred2')")
     if variant == "deferred2":
         state, loss = step(state, tokens)   # warm both programs
         for _ in range(3):

@@ -3,16 +3,17 @@ from .distributed import (DistributedOptimizer, DistributedState,
 from .functions import (allgather_object, broadcast_object,
                         broadcast_optimizer_state, broadcast_parameters,
                         join, join_allreduce)
-from .moe_opt import (adamw_low_precision, deferred_pair, every_k,
-                      frozen_like, is_expert_param, moe_adamw, partition,
-                      scale_by_adam_low_precision)
+from .moe_opt import (DeferredPair, adamw_low_precision, deferred_pair,
+                      every_k, frozen_like, is_expert_param, moe_adamw,
+                      partition, scale_by_adam_low_precision)
 from .sync_batch_norm import SyncBatchNorm
 
 __all__ = [
     "DistributedOptimizer", "DistributedState", "distributed",
     "allgather_object", "broadcast_object", "broadcast_optimizer_state", "broadcast_parameters",
     "join", "join_allreduce", "SyncBatchNorm",
-    "adamw_low_precision", "deferred_pair", "every_k", "frozen_like",
+    "DeferredPair", "adamw_low_precision", "deferred_pair", "every_k",
+    "frozen_like",
     "is_expert_param", "moe_adamw", "partition",
     "scale_by_adam_low_precision",
 ]

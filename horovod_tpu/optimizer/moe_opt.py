@@ -225,18 +225,28 @@ def frozen_like(inner: optax.GradientTransformation):
     return optax.GradientTransformation(inner.init, update)
 
 
+class DeferredPair(NamedTuple):
+    """A matched (apply, skip) optimizer pair plus its cadence — one
+    value, so the update scale (baked into ``apply``) and the dispatch
+    cadence (consumed by ``train.make_gspmd_deferred_train_step``) can
+    never disagree."""
+    apply: Any
+    skip: Any
+    every: int
+
+
 def deferred_pair(learning_rate, *, every: int = 4,
                   weight_decay: float = 1e-4, b1: float = 0.9,
                   b2: float = 0.999, eps: float = 1e-8,
                   expert_nu_dtype=None,
                   is_expert: Callable[[str], bool] = is_expert_param):
-    """TWO-program expert-update deferral: returns ``(opt_apply,
-    opt_skip)`` with identical state structure. Compile each into its own
-    jitted step with donation (``train.make_gspmd_deferred_train_step``);
-    the skip program's expert param/m/v alias straight through (zero
-    optimizer HBM for the bank on k-1 of k steps) while the apply program
-    applies the ``every``-scaled AdamW update from the current gradient.
-    Constant LR only (same constraint as :func:`every_k`).
+    """TWO-program expert-update deferral: returns a :class:`DeferredPair`
+    of optimizers with identical state structure. Compile each into its
+    own jitted step with donation (``train.make_gspmd_deferred_train_
+    step``); the skip program's expert param/m/v alias straight through
+    (zero optimizer HBM for the bank on k-1 of k steps) while the apply
+    program applies the ``every``-scaled AdamW update from the current
+    gradient. Constant LR only (same constraint as :func:`every_k`).
     ``expert_nu_dtype=jnp.bfloat16`` stacks the reduced-precision second
     moment on the apply program."""
     if callable(learning_rate):
@@ -256,7 +266,7 @@ def deferred_pair(learning_rate, *, every: int = 4,
     opt_apply = partition({"dense": dense, "expert": expert_apply}, labeler)
     opt_skip = partition({"dense": dense,
                           "expert": frozen_like(expert_apply)}, labeler)
-    return opt_apply, opt_skip
+    return DeferredPair(opt_apply, opt_skip, every)
 
 
 def moe_adamw(learning_rate, *, expert_variant: str = "adamw",

@@ -400,20 +400,24 @@ def make_gspmd_train_step(model, optimizer, mesh, rules, *,
     return run
 
 
-def make_gspmd_deferred_train_step(model, opt_apply, opt_skip, every: int,
-                                   mesh, rules, **kw):
-    """Two-PROGRAM expert-update deferral (``optimizer.deferred_pair``):
-    compiles one step per optimizer and dispatches by a host-side step
-    counter — k-1 skip steps, then one apply step. The skip program's
-    untouched expert param/m/v are donated jit inputs returned unchanged,
-    so XLA aliases their buffers (zero optimizer HBM for the bank),
-    which a ``lax.cond`` inside ONE program cannot achieve (its
-    pass-through copies measured the saving away — docs/benchmarks.md
-    r5). Both optimizers must share a state structure; init with
-    ``opt_apply``. Requires ``donate=True`` (the default) for the
-    aliasing to exist."""
-    step_apply = make_gspmd_train_step(model, opt_apply, mesh, rules, **kw)
-    step_skip = make_gspmd_train_step(model, opt_skip, mesh, rules, **kw)
+def make_gspmd_deferred_train_step(model, pair, mesh, rules, **kw):
+    """Two-PROGRAM expert-update deferral: ``pair`` is the
+    ``optimizer.deferred_pair`` result (apply/skip optimizers + cadence
+    in ONE value, so the k baked into the apply program's update scale
+    and the k used for dispatch cannot disagree). Compiles one step per
+    optimizer and dispatches by a host-side step counter — k-1 skip
+    steps, then one apply step. The skip program's untouched expert
+    param/m/v are donated jit inputs returned unchanged, so XLA aliases
+    their buffers (zero optimizer HBM for the bank) AND dead-code-
+    eliminates the bank's dL/dW einsums (their only consumer was the
+    skipped update) — which a ``lax.cond`` inside ONE program cannot
+    achieve (its pass-through copies measured the saving away —
+    docs/benchmarks.md r5). Both optimizers share a state structure;
+    init with ``pair.apply``. Requires ``donate=True`` (the default)
+    for the aliasing to exist."""
+    step_apply = make_gspmd_train_step(model, pair.apply, mesh, rules, **kw)
+    step_skip = make_gspmd_train_step(model, pair.skip, mesh, rules, **kw)
+    every = int(pair.every)
     counter = {"n": 0}
 
     def step(state, tokens):

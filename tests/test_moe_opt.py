@@ -151,7 +151,9 @@ def test_deferred_pair_two_program_semantics():
     interchangeable (one init serves both)."""
     params = _params()
     from horovod_tpu.optimizer import deferred_pair
-    opt_a, opt_s = deferred_pair(1e-2, every=3)
+    pair = deferred_pair(1e-2, every=3)
+    opt_a, opt_s = pair.apply, pair.skip
+    assert pair.every == 3
     state = opt_a.init(params)
     p = params
     moved_at = []
@@ -187,12 +189,13 @@ def test_make_gspmd_deferred_train_step_counts():
     cfg = mixtral_tiny()
     mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
     model = Mixtral(cfg)
-    opt_a, opt_s = deferred_pair(1e-3, every=2)
+    pair = deferred_pair(1e-3, every=2)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)))
-    state = create_gspmd_train_state(model, opt_a, jax.random.PRNGKey(0),
+    state = create_gspmd_train_state(model, pair.apply,
+                                     jax.random.PRNGKey(0),
                                      tokens, mesh, LOGICAL_RULES)
-    step = make_gspmd_deferred_train_step(model, opt_a, opt_s, 2, mesh,
+    step = make_gspmd_deferred_train_step(model, pair, mesh,
                                           LOGICAL_RULES, donate=False)
 
     def expert_leaf(st):
@@ -249,9 +252,9 @@ def test_deferred_pair_trains_comparably_to_adamw():
     ref_opt = optax.adamw(3e-3)
     ref = run(lambda st: make_gspmd_train_step(
         model, ref_opt, mesh, LOGICAL_RULES, donate=False), ref_opt)
-    opt_a, opt_s = deferred_pair(3e-3, every=4)
+    pair = deferred_pair(3e-3, every=4)
     dfr = run(lambda st: make_gspmd_deferred_train_step(
-        model, opt_a, opt_s, 4, mesh, LOGICAL_RULES, donate=False), opt_a)
+        model, pair, mesh, LOGICAL_RULES, donate=False), pair.apply)
 
     assert ref[-1] < ref[0] and dfr[-1] < dfr[0], (ref[:2], dfr[:2])
     # same regime: deferred's final loss within 25% of AdamW's progress
