@@ -42,9 +42,18 @@ def main():
         # fwd-kernel re-run in the backward for ~400MB: 28.9k vs 28.1k
         # (+2.6% interleaved; +5.2% at batch 12, but batch 12 is slower
         # for both). See benchmarks/llama_remat_ab.py.
+        # scan_layers=False (r5): the Llama profile's 14.1% gather/scatter
+        # slice was attributed to the scan's loop-carried gradient stacks
+        # (dynamic-update-slice of each layer's dW into [24,...] f32
+        # accumulators, ~0.5 ms per write at an effective ~33 GB/s).
+        # Unrolling the layer loop removes them: 29.3k -> 33.0k tok/s
+        # (+12.8%, alternated single-arm runs — the two arms' states
+        # can't fit on-chip together). Cost: compile ~120 s vs ~35 s;
+        # the model default stays scan_layers=True for iteration speed.
         cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24,
                           n_heads=16, n_kv_heads=8, hidden_dim=4096,
-                          max_seq_len=2048, remat_policy="attn")
+                          max_seq_len=2048, remat_policy="attn",
+                          scan_layers=False)
         per_chip, seq = 8, 1024
     else:
         cfg = llama_tiny()
@@ -63,16 +72,22 @@ def main():
         dopt = distributed(optax.adamw(1e-4), op=op)
         state = create_train_state(model, jax.random.PRNGKey(0),
                                    tokens[:1], dopt)
+        n_params = params_count(state.params)
+        # donate + thread the state (r5): the unrolled 24L program's live
+        # set no longer fits alongside an undonated persistent state
+        box = {"s": state}
         steps = {k: make_train_step(model, dopt, loss_fn, scan_steps=k,
-                                    donate=False) for k in (2, 8)}
+                                    donate=True) for k in (2, 8)}
 
         def run(k):
-            _, loss = steps[k](state, tokens, tokens)
+            st, loss = steps[k](box["s"], tokens, tokens)
+            box["s"] = st
             sync(loss)
 
         tps = batch * seq / slope_time(run, 2, 8)
+        del box, state
         flops_tok = lm_train_flops_per_token(
-            params_count(state.params), cfg.n_layers, cfg.dim, seq)
+            n_params, cfg.n_layers, cfg.dim, seq)
         emit(f"llama_tokens_per_sec_per_chip_{op_name}", tps / n,
              f"tokens/sec/chip (dim {cfg.dim} x {cfg.n_layers}L, seq "
              f"{seq}, op={op_name}, {n} devices)",

@@ -34,10 +34,15 @@ def main():
                                    make_gspmd_train_step)
 
     hvd.init()
-    # EXACTLY the benchmarks/llama.py TPU config
+    # EXACTLY the benchmarks/llama.py TPU config (scan_layers=False since
+    # r5); LLAMA_PROFILE_SCAN=1 re-profiles the scan-over-layers variant
+    # (the config the r5 gather/scatter diagnosis was made on).
+    scan_env = os.environ.get("LLAMA_PROFILE_SCAN", "0")
+    if scan_env not in ("0", "1"):
+        raise SystemExit(f"LLAMA_PROFILE_SCAN={scan_env!r}: use 0 or 1")
     cfg = LlamaConfig(vocab_size=32000, dim=1024, n_layers=24, n_heads=16,
                       n_kv_heads=8, hidden_dim=4096, max_seq_len=2048,
-                      remat_policy="attn")
+                      remat_policy="attn", scan_layers=scan_env == "1")
     pos = [a for a in sys.argv[1:] if not a.startswith("-")]
     per_chip, seq = (int(pos[0]) if pos else 8), 1024
     batch = per_chip * hvd.size()
