@@ -45,7 +45,11 @@ def main():
                       n_kv_heads=8 if tpu else 2,
                       hidden_dim=2816 if tpu else 128, max_seq_len=seq,
                       dtype=jnp.bfloat16 if tpu else jnp.float32,
-                      remat=tpu, scan_layers=tpu,
+                      # scan_layers=False on TPU (r5): the scan's
+                      # loop-carried dW stacks cost here too — unroll
+                      # measured +14.5% interleaved (llama bench analysis,
+                      # docs/benchmarks.md r5); 8 layers compile in ~100 s
+                      remat=tpu, scan_layers=False,
                       # saving the flash residuals pays most at long seq:
                       # +13.5% over "dots" at seq 4096 (55.6k vs 50.1k
                       # tok/s interleaved). The materialised arm saves its
