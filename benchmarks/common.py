@@ -181,3 +181,18 @@ def mfu_fields(per_chip_rate: float, flops_per_item: float) -> dict:
         return {}
     return {"mfu": round(per_chip_rate * flops_per_item / peak, 4),
             "peak_tflops": round(peak / 1e12, 1)}
+
+
+def mixtral_bench_config(scan_layers: bool = False):
+    """THE Mixtral TPU bench config — single source for mixtral.py,
+    profile_mixtral.py and mixtral_opt_ab.py so the profiler's
+    'exactly the bench config' contract cannot drift (r5 review).
+    scan_layers=False since r5 (the unroll adoption); pass True to
+    reproduce pre-r5 scan-variant measurements."""
+    import jax.numpy as jnp
+    from horovod_tpu.models.mixtral import MixtralConfig
+    return MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
+                         n_heads=8, n_kv_heads=4, hidden_dim=1792,
+                         n_experts=8, top_k=2, max_seq_len=1024,
+                         use_flash=False, remat_policy="dots_attn",
+                         scan_layers=scan_layers)

@@ -43,10 +43,14 @@ def main():
         # policy saves the per-layer context and the backward skips its
         # recompute — +3.4% interleaved over "dots" (105.1k vs 101.8k
         # tok/s in the same harness).
-        cfg = MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
-                            n_heads=8, n_kv_heads=4, hidden_dim=1792,
-                            n_experts=8, top_k=2, max_seq_len=1024,
-                            use_flash=False, remat_policy="dots_attn")
+        # scan_layers=False (r5, via the shared config): Mixtral
+        # inherited the Llama scan and paid the same loop-carried
+        # dW-stack tax — worse, the stacks include the EXPERT BANK
+        # ([8L,8E,1792,512]x3 f32). Unroll measured +21.8% interleaved
+        # (median per-round ratio; min-slope endpoints 126.0k -> 157.7k,
+        # +25%) on top of deferred2; compile ~120 s vs ~35 s.
+        from common import mixtral_bench_config
+        cfg = mixtral_bench_config()
         # per-chip batch 16 (r4): the AdamW update of the 8x-overprovisioned
         # expert bank is a fixed ~7ms/step of HBM traffic regardless of
         # batch — 16 amortizes it 17% better per-token than 8, and 32 adds

@@ -56,10 +56,11 @@ def main():
     n = hvd.size()
     tpu = on_tpu()
     if tpu:
-        cfg = MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
-                            n_heads=8, n_kv_heads=4, hidden_dim=1792,
-                            n_experts=8, top_k=2, max_seq_len=1024,
-                            use_flash=False, remat_policy="dots_attn")
+        # shared bench config (scan_layers=False since r5) so variants
+        # A/B at the adopted config (the r5 A/B table in
+        # docs/benchmarks.md was measured on the scan config)
+        from common import mixtral_bench_config
+        cfg = mixtral_bench_config()
         pos = [a for a in sys.argv[1:] if not a.startswith("-")]
         per_chip, seq = (int(pos[0]) if pos else 16), 512
     else:

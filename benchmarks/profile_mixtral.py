@@ -44,10 +44,13 @@ def main():
 
     hvd.init()
     # EXACTLY the benchmarks/mixtral.py TPU config
-    cfg = MixtralConfig(vocab_size=32000, dim=512, n_layers=8,
-                        n_heads=8, n_kv_heads=4, hidden_dim=1792,
-                        n_experts=8, top_k=2, max_seq_len=1024,
-                        use_flash=False, remat_policy="dots_attn")
+    # scan_layers=False since r5 (the bench config); MIXTRAL_PROFILE_SCAN=1
+    # re-profiles the scan variant the pre-r5 tables were made on.
+    scan_env = os.environ.get("MIXTRAL_PROFILE_SCAN", "0")
+    if scan_env not in ("0", "1"):
+        raise SystemExit(f"MIXTRAL_PROFILE_SCAN={scan_env!r}: use 0 or 1")
+    from common import mixtral_bench_config
+    cfg = mixtral_bench_config(scan_layers=scan_env == "1")
     per_chip = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     seq = 512
     batch = per_chip * hvd.size()
