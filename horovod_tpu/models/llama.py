@@ -68,6 +68,13 @@ class LlamaConfig:
     # trade — elementwise recompute is HBM-cheap, matmuls are not).
     # "full": save nothing inside the block.
     remat_policy: str = "dots"
+    # scan_layers=True compiles fast (one traced layer) but trains slower:
+    # the scan's loop-carried [L,...] gradient stacks cost a
+    # dynamic-update-slice write-back per weight per layer per step —
+    # measured 12.6% of the Llama step, and +13% / +22% / +14.5%
+    # throughput from unrolling at the Llama / Mixtral / longctx bench
+    # configs (r5, docs/benchmarks.md). Prefer False for production
+    # training runs when the ~3x compile time is acceptable.
     scan_layers: bool = True
     tie_embeddings: bool = False
     # None = auto: Pallas flash attention on TPU, materialised softmax
