@@ -63,10 +63,8 @@ import numpy as np  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
-try:  # noqa: E402
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+import horovod_tpu  # noqa: E402,F401  (installs jax API-drift shims first)
+from jax import shard_map  # noqa: E402  (compat-installed on older jax)
 
 S_SHORT, S_LONG = 4, 16
 LOCAL_BATCH = 8

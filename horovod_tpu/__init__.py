@@ -18,6 +18,9 @@ Two ways to use the collectives:
   global mesh, for startup broadcast, tools and parity tests.
 """
 
+from . import compat
+compat.install()  # before collectives/train import shard_map (see compat.py)
+
 from . import collectives, core
 from .collectives import (Adasum, Average, Compression, Max, Min, Product,
                           Sum, adasum_allreduce, allgather, allgather_v,

@@ -1,0 +1,35 @@
+"""hvd-analyze: static collective-consistency checker + trap lint.
+
+Parity: the reference Horovod catches cross-rank collective disagreement at
+RUNTIME via the controller's negotiation (``horovod/common/controller.cc``
+raises a mismatch Response when ranks submit different tensor streams).
+Under SPMD/GSPMD there is no negotiation — divergence surfaces as a hang,
+caught today only at runtime (``tools/mismatch.py``) or after the fact
+(the stall watchdog).  This package is the static complement: it catches
+the deadlock patterns, the cotangent-scaling psum trap and the cond-copy
+trap BEFORE a multi-host TPU job launches, plus an AST lint that encodes
+the environment traps documented in CLAUDE.md.
+
+Two engines:
+
+- :func:`analyze_step` — jaxpr-level collective-graph analysis.  Traces a
+  step function abstractly (``jax.make_jaxpr`` on ``ShapeDtypeStruct``
+  args: no device execution, works on CPU with zero chips), walks the
+  closed jaxpr including ``pjit``/``scan``/``cond``/``while``/``shard_map``
+  sub-jaxprs, extracts the ordered collective signature stream and runs
+  the JAX* checks listed in ``docs/analysis.md``.
+- :func:`lint_paths` — AST trap lint over source files (no execution),
+  the LINT* checks.
+
+CLI: ``python -m horovod_tpu.analysis <target> ...`` (see ``__main__.py``).
+"""
+
+from .findings import Finding, Severity, format_findings
+from .jaxpr import CollectiveCall, analyze_step, collective_stream
+from .trap_lint import lint_paths, lint_source
+
+__all__ = [
+    "Finding", "Severity", "format_findings",
+    "CollectiveCall", "analyze_step", "collective_stream",
+    "lint_paths", "lint_source",
+]

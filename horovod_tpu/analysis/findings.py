@@ -1,0 +1,65 @@
+"""Finding/severity model shared by both hvd-analyze engines.
+
+Machine-readable by construction (``Finding.to_dict`` → ``--json``) and
+stable in text form: one line per finding,
+``file:line: SEVERITY [check-id] message``, mirroring the compiler-style
+output of the reference controller's mismatch errors
+(``horovod/common/controller.cc`` builds the same “who disagreed, about
+what” string per tensor).
+"""
+
+from enum import Enum
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class Severity(str, Enum):
+    """Finding severity.
+
+    ``ERROR``   — will deadlock, silently corrupt gradients, or abort the
+                  process on a real multi-host job.
+    ``WARNING`` — measured performance trap or resume-correctness hazard.
+    ``INFO``    — stylistic / advisory.
+    """
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class Finding(NamedTuple):
+    check_id: str
+    severity: Severity
+    file: str
+    line: int
+    message: str
+    # Optional structured payload (shapes, axis names, byte counts ...)
+    detail: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "check_id": self.check_id,
+            "severity": self.severity.value,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        return f"{loc}: {self.severity.value.upper()} " \
+               f"[{self.check_id}] {self.message}"
+
+
+def format_findings(findings: List[Finding]) -> str:
+    return "\n".join(f.format() for f in findings)
+
+
+def max_severity(findings: List[Finding]) -> Optional[Severity]:
+    order = [Severity.INFO, Severity.WARNING, Severity.ERROR]
+    worst = None
+    for f in findings:
+        if worst is None or order.index(f.severity) > order.index(worst):
+            worst = f.severity
+    return worst

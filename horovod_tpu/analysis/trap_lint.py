@@ -1,0 +1,289 @@
+"""AST trap lint: mechanically enforce CLAUDE.md's environment traps.
+
+Pure ``ast`` analysis — nothing is imported or executed, so linting a
+script can never trigger the traps it looks for.  Each check encodes a
+failure mode that cost real debugging time on this codebase (see
+CLAUDE.md "Environment traps"):
+
+- ``lint-xla-flags`` (ERROR): mutation of ``os.environ["XLA_FLAGS"]``
+  outside the ``HOROVOD_FUSION_APPLY_XLA_FLAGS`` opt-in guard with flags
+  beyond the known-safe set.  XLA **F-aborts the process** on unknown
+  flag names, and both backends here reject the collective-combiner
+  flags.
+- ``lint-torch-seed`` (WARNING): ``torch.manual_seed`` inside a nested
+  function — the thread-sim rank-fn pattern, where concurrent rank
+  threads race torch's GLOBAL RNG.  Top-level calls (before ranks fork)
+  are fine.
+- ``lint-late-platform-pin`` (WARNING): a file sets
+  ``JAX_PLATFORMS=cpu`` in the environment but never calls
+  ``jax.config.update("jax_platforms", ...)``.  This image
+  pre-registers the axon TPU backend via sitecustomize, so the env var
+  alone does NOT switch backends.
+- ``lint-slope-cadence`` (WARNING): a bench file builds a stepped arm
+  with ``deferred_pair(..., every=k)`` but passes ``slope_time_paired``
+  window lengths that are not multiples of ``k`` — min-over-repeats then
+  cherry-picks the cheap phase of the cadence.
+
+Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
+line.
+"""
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from .findings import Finding, Severity
+
+SUPPRESS_PRAGMA = "hvd-analyze: ok"
+
+# XLA flags that are safe on both backends in this image (the CPU
+# device-count fake used by the whole test tier).
+SAFE_XLA_FLAGS = frozenset({"--xla_force_host_platform_device_count"})
+
+XLA_GUARD_ENV = "HOROVOD_FUSION_APPLY_XLA_FLAGS"
+
+# Directory names never linted (fixture corpora are known-bad on purpose).
+EXCLUDED_DIR_NAMES = frozenset({
+    "analysis_fixtures", "__pycache__", ".git", "node_modules",
+})
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_os_environ(node) -> bool:
+    """Matches ``os.environ`` or a bare ``environ`` name."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    if isinstance(node, ast.Name) and node.id == "environ":
+        return True
+    return False
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted name of a call target (``torch.manual_seed``)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _Lint(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: List[Finding] = []
+        self._func_depth = 0
+        self._xla_guard_depth = 0
+        # lint-late-platform-pin state
+        self.sets_jax_platforms_cpu: Optional[int] = None  # line
+        self.calls_platform_update = False
+        # lint-slope-cadence state
+        self.cadences: List[int] = []           # every=k constants
+        self.slope_windows: List = []           # (line, [window ints])
+
+    # -- helpers -------------------------------------------------------
+
+    def _suppressed(self, node) -> bool:
+        line = getattr(node, "lineno", 0)
+        if 0 < line <= len(self.lines):
+            return SUPPRESS_PRAGMA in self.lines[line - 1]
+        return False
+
+    def _add(self, check_id, severity, node, message, detail=None):
+        if not self._suppressed(node):
+            self.findings.append(Finding(
+                check_id, severity, self.path,
+                getattr(node, "lineno", 0), message, detail))
+
+    def _statement_flags(self, node) -> List[str]:
+        """All ``--flag_name`` tokens in string constants under node."""
+        flags = []
+        for sub in ast.walk(node):
+            s = _const_str(sub)
+            if s:
+                for tok in s.split():
+                    if tok.startswith("--"):
+                        flags.append(tok.split("=", 1)[0])
+        return flags
+
+    def _check_environ_store(self, key_node, stmt, value_nodes):
+        key = _const_str(key_node)
+        if key == "XLA_FLAGS":
+            if self._xla_guard_depth > 0:
+                return  # inside the documented opt-in guard
+            flags = [f for v in value_nodes for f in self._statement_flags(v)]
+            unsafe = [f for f in flags if f not in SAFE_XLA_FLAGS]
+            if unsafe or not flags:
+                self._add(
+                    "lint-xla-flags", Severity.ERROR, stmt,
+                    f"XLA_FLAGS mutated outside the {XLA_GUARD_ENV} "
+                    f"opt-in guard"
+                    + (f" with non-allowlisted flags {unsafe}" if unsafe
+                       else " with flags not statically known")
+                    + "; XLA F-aborts the process on unknown flag names",
+                    {"flags": flags})
+        elif key == "JAX_PLATFORMS":
+            vals = [_const_str(v) for v in value_nodes]
+            if any(v and "cpu" in v for v in vals):
+                if self.sets_jax_platforms_cpu is None:
+                    self.sets_jax_platforms_cpu = stmt.lineno
+
+    # -- visitors ------------------------------------------------------
+
+    def visit_If(self, node):
+        guarded = any(
+            isinstance(sub, ast.Constant) and sub.value == XLA_GUARD_ENV
+            for sub in ast.walk(node.test))
+        if guarded:
+            self._xla_guard_depth += 1
+            for child in node.body:
+                self.visit(child)
+            self._xla_guard_depth -= 1
+            for child in node.orelse:
+                self.visit(child)
+        else:
+            self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) and _is_os_environ(tgt.value):
+                key = tgt.slice
+                if isinstance(key, ast.Index):  # py<3.9 AST, defensive
+                    key = key.value
+                self._check_environ_store(key, node, [node.value])
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        name = _dotted(node.func)
+
+        # os.environ.setdefault("XLA_FLAGS", ...) / .update({...})
+        if isinstance(node.func, ast.Attribute) \
+                and _is_os_environ(node.func.value):
+            if node.func.attr == "setdefault" and node.args:
+                self._check_environ_store(
+                    node.args[0], node, node.args[1:2])
+            elif node.func.attr == "update":
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        for k, v in zip(arg.keys, arg.values):
+                            if k is not None:
+                                self._check_environ_store(k, node, [v])
+
+        if name.endswith("manual_seed") and name.startswith("torch"):
+            if self._func_depth >= 2:
+                self._add(
+                    "lint-torch-seed", Severity.WARNING, node,
+                    "torch.manual_seed inside a nested function (rank-fn "
+                    "pattern): thread-sim ranks race torch's global RNG — "
+                    "seed once before forking ranks, or init weights "
+                    "deterministically without it")
+
+        if name.endswith("config.update") and node.args:
+            if _const_str(node.args[0]) == "jax_platforms":
+                self.calls_platform_update = True
+
+        if name.endswith("deferred_pair"):
+            for kw in node.keywords:
+                if kw.arg == "every" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    self.cadences.append(kw.value.value)
+
+        if name.endswith("slope_time_paired"):
+            windows = []
+            for arg in node.args[1:3]:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, int):
+                    windows.append(arg.value)
+            for kw in node.keywords:
+                if kw.arg in ("s_short", "s_long") \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int):
+                    windows.append(kw.value.value)
+            if windows:
+                self.slope_windows.append((node, windows))
+
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- file-level checks ---------------------------------------------
+
+    def finish(self):
+        if self.sets_jax_platforms_cpu is not None \
+                and not self.calls_platform_update:
+            line = self.sets_jax_platforms_cpu
+            node = ast.Pass()
+            node.lineno = line
+            self._add(
+                "lint-late-platform-pin", Severity.WARNING, node,
+                'sets JAX_PLATFORMS=cpu in the environment but never calls '
+                'jax.config.update("jax_platforms", ...); this image '
+                "pre-registers the axon TPU backend via sitecustomize, so "
+                "the env var alone does NOT switch backends")
+
+        for node, windows in self.slope_windows:
+            for k in self.cadences:
+                bad = [w for w in windows if w % k != 0]
+                if bad:
+                    self._add(
+                        "lint-slope-cadence", Severity.WARNING, node,
+                        f"slope_time_paired windows {windows} are not all "
+                        f"multiples of the apply cadence every={k} used in "
+                        f"this file; min-over-repeats will cherry-pick the "
+                        f"cheap phase of the cadence",
+                        {"windows": windows, "every": k})
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Finding]:
+    """Lint one Python source string; returns findings (never executes)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("lint-syntax", Severity.ERROR, path,
+                        e.lineno or 0, f"cannot parse: {e.msg}")]
+    lint = _Lint(path, source)
+    lint.visit(tree)
+    lint.finish()
+    return lint.findings
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in EXCLUDED_DIR_NAMES]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint files/directories (recursively; fixture dirs excluded)."""
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding("lint-io", Severity.ERROR, path, 0,
+                                    f"cannot read: {e}"))
+            continue
+        findings.extend(lint_source(source, path))
+    return findings

@@ -49,6 +49,24 @@ Max = "max"
 Product = "product"
 Adasum = "adasum"
 
+#: The named-axis collective primitives every op in this module lowers
+#: through, mapped to the jaxpr param holding their axis names.  This is
+#: the vocabulary ``analysis/jaxpr.py`` walks when it extracts the static
+#: collective signature stream (the SPMD stand-in for the reference
+#: controller's negotiated tensor stream) — extend it here if an op ever
+#: lowers through a new primitive, and hvd-analyze follows automatically.
+COLLECTIVE_PRIMITIVES = {
+    "psum": "axes",
+    "pmin": "axes",
+    "pmax": "axes",
+    "all_gather": "axis_name",
+    "all_to_all": "axis_name",
+    "reduce_scatter": "axis_name",
+    "ppermute": "axis_name",
+    "pbroadcast": "axis_name",
+    "axis_index": "axis_name",
+}
+
 
 def _axis(axis_name: Optional[AxisName]) -> AxisName:
     if axis_name is not None:

@@ -1,0 +1,183 @@
+"""jax API-drift shims (reference: horovod/common/util.py's version gates).
+
+The image's jax version moves between rounds (CLAUDE.md "Environment
+facts").  ``shard_map`` has lived in two places with two spellings of the
+replication-check kwarg:
+
+- new jax:  ``jax.shard_map(..., check_vma=...)``
+- old jax:  ``jax.experimental.shard_map.shard_map(..., check_rep=...)``
+
+The repo writes the NEW spelling everywhere.  :func:`shard_map` below
+accepts it on either jax, translating the kwarg to whatever the installed
+version understands, and :func:`install` republishes it as
+``jax.shard_map`` on old jax so module-level ``from jax import shard_map``
+(tests, benchmarks, examples) keeps working unmodified.
+"""
+
+import inspect
+
+import jax
+from jax import lax as _lax
+
+try:
+    from jax import shard_map as _shard_map  # new-style jax
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, **kwargs):
+    """``shard_map`` that accepts both ``check_vma`` and ``check_rep``.
+
+    Whichever spelling the caller used is translated to the one the
+    installed jax accepts (the semantics are identical; only the name
+    changed).  With ``f=None`` returns a partial, mirroring upstream.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def set_mesh(mesh):
+    """``jax.sharding.set_mesh`` for jax versions that predate it.
+
+    Old jax spells "make this the ambient mesh" as entering the ``Mesh``
+    itself (``with mesh:``), so the compat shim just returns the mesh —
+    ``with set_mesh(mesh):`` then does exactly that.
+    """
+    return mesh
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` for jax versions that predate it.
+
+    ``lax.psum(1, axis)`` is the historical spelling: psum of a Python
+    scalar is folded to the static axis size at trace time (no collective
+    is emitted), including over tuples of names.
+    """
+    return _lax.psum(1, axis_name)
+
+
+def _install_custom_partitioning():
+    """Let new-style ``def_partition`` calls run on pre-Shardy jax.
+
+    Newer jax grew Shardy declarations on
+    ``custom_partitioning.def_partition`` (``sharding_rule``,
+    ``need_replication_factors``); older jax has only the legacy GSPMD
+    path, which consumes the ``partition``/``infer_sharding_from_operands``
+    callbacks that callers (ops/flash_attention.py) already pass — the
+    Shardy kwargs are pure declarations for a partitioner that does not
+    exist here, so dropping them is lossless.  Callback calling
+    conventions match (``*static_args, mesh, arg_shapes, result_shape``).
+    """
+    from jax._src.custom_partitioning import custom_partitioning as _cp
+    params = frozenset(inspect.signature(_cp.def_partition).parameters)
+    if "sharding_rule" in params:
+        return
+    _orig = _cp.def_partition
+
+    def def_partition(self, *args, **kwargs):
+        return _orig(self, *args, **{k: v for k, v in kwargs.items()
+                                     if k in params})
+
+    _cp.def_partition = def_partition
+
+
+def _install_layout():
+    """Backfill ``jax.experimental.layout.Format`` on jax versions that
+    predate the rename.  The pair is identical modulo names:
+
+    - new jax: ``Format(Layout(major_to_minor), sharding)``
+    - old jax: ``Layout(DeviceLocalLayout(major_to_minor), sharding)``
+
+    so the shim republishes old ``Layout`` as ``Format`` and old
+    ``DeviceLocalLayout`` as ``Layout`` (constructor signatures match
+    positionally on both).
+    """
+    from jax.experimental import layout as L
+    if hasattr(L, "Format"):
+        return
+    L.Format, L.Layout = L.Layout, L.DeviceLocalLayout
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh`` for jax versions that predate it.
+
+    Old jax tracks the ambient mesh (entered via ``with mesh:`` — what
+    :func:`set_mesh` compiles down to here) in thread-local resources.
+    Returns the concrete ``Mesh`` (same ``axis_names``/``shape`` surface,
+    accepted by ``shard_map``), or None when no mesh is ambient — callers
+    in this repo treat None and the empty abstract mesh alike.
+    """
+    from jax._src import mesh as _mesh_lib
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def enable_multiprocess_cpu_collectives():
+    """Arm gloo CPU collectives ahead of ``jax.distributed.initialize``.
+
+    Newer jax defaults ``jax_cpu_collectives_implementation`` to gloo,
+    which is what makes multi-process CPU meshes (the hvdrun integration
+    tests) work at all; this image's jax defaults to "none" and fails any
+    cross-process computation with "Multiprocess computations aren't
+    implemented on the CPU backend".  This jaxlib's gloo constructor also
+    REQUIRES a live distributed client, so the flag can only be flipped on
+    the multi-process path — call this right before
+    ``jax.distributed.initialize`` (the flag is read later, at CPU client
+    creation).  No-op when the option is gone (newer jax) or already set.
+    """
+    try:
+        if jax.config._read("jax_cpu_collectives_implementation") == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, LookupError):  # pragma: no cover - newer jax
+        pass
+
+
+def distributed_is_initialized():
+    """``jax.distributed.is_initialized`` for jax versions that predate it.
+
+    The distributed runtime keeps one process-global client; "initialized"
+    has always meant that client exists (exactly what the newer public
+    accessor reports).
+    """
+    from jax._src import distributed as _distributed
+    return _distributed.global_state.client is not None
+
+
+def install():
+    """Backfill drifted jax attributes the repo spells the new way.
+
+    Idempotent; each patch is a no-op when the installed jax already ships
+    the attribute.  Called from ``horovod_tpu/__init__`` so any
+    ``from jax import shard_map`` / ``lax.axis_size`` executed after
+    importing the package resolves on either jax version.
+    """
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(_lax, "axis_size"):
+        _lax.axis_size = axis_size
+    if not hasattr(jax.sharding, "set_mesh"):
+        jax.sharding.set_mesh = set_mesh
+    if not hasattr(jax.distributed, "is_initialized"):
+        jax.distributed.is_initialized = distributed_is_initialized
+    try:
+        # Newer jax defaults to the partitionable threefry, which is what
+        # makes jax.random sharding-invariant — "sharding never changes
+        # math" (the parity suite) is FALSE for sharded inits under the
+        # old default (measured: 0.28 max param-init diff dp1 vs dp2×fsdp4).
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:  # pragma: no cover - future jax drops the knob
+        pass
+    try:
+        jax.sharding.get_abstract_mesh
+    except AttributeError:
+        jax.sharding.get_abstract_mesh = get_abstract_mesh
+    _install_layout()
+    _install_custom_partitioning()

@@ -108,6 +108,10 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                         float(os.environ["HOROVOD_START_TIMEOUT"]))
                 except (TypeError, ValueError):
                     pass
+            # Multi-process CPU meshes need gloo collectives; older jax
+            # defaults them off (compat.py has the full story).
+            from .. import compat
+            compat.enable_multiprocess_cpu_collectives()
             jax.distributed.initialize(
                 coordinator_address=coord, num_processes=nproc,
                 process_id=pid, **kw)
@@ -208,6 +212,11 @@ def shutdown() -> None:
         if _context is not None and _context.timeline is not None:
             _context.timeline.close()
         _context = None
+        # context_api OWNS the shared engine's lifecycle: shut it down
+        # before dropping the reference (the frontends below only release
+        # their own _state and must not tear down an engine they share).
+        if _process_engine is not None:
+            _process_engine.shutdown()
         _process_engine = None
     # The torch/TF runtimes cache the shared engine; letting them keep a
     # pre-shutdown instance while the next lazy caller creates a fresh one

@@ -35,6 +35,11 @@ RESET_LIMIT_ENV = "HOROVOD_ELASTIC_RESET_LIMIT"
 #: more frequent than this reuse the cached answer.
 DEFAULT_POLL_INTERVAL_S = 0.2
 
+#: env: driver-set override of the worker poll interval, wired to the
+#: driver's own discovery cadence — polling slower than the driver
+#: discovers can miss a membership bump entirely on short generations.
+POLL_INTERVAL_ENV = "HOROVOD_ELASTIC_POLL_INTERVAL"
+
 #: driver: how many failures (within the cooldown window) blacklist a host.
 BLACKLIST_STRIKES = 2
 

@@ -154,6 +154,11 @@ class ElasticDriver:
             C.WORLD_VERSION_ENV: str(version),
             C.COMMIT_DIR_ENV: commit_dir,
             C.RESET_LIMIT_ENV: str(self._settings.reset_limit or 0),
+            # Workers must not poll for membership slower than this driver
+            # discovers it — a generation whose whole commit stream fits
+            # inside one poll window would miss the bump and finish at the
+            # old world size.
+            C.POLL_INTERVAL_ENV: str(self._settings.discovery_interval_s),
         }
         # Arm the engine's transport stall watchdog (core/engine.py
         # _bounded): standalone runs keep the reference default (warn only,
@@ -165,6 +170,16 @@ class ElasticDriver:
         if not os.environ.get(stall_env) and \
                 stall_env not in (self._settings.env or {}):
             extra[stall_env] = str(C.DEFAULT_STALL_SHUTDOWN_S)
+            armed_stall, stall_src = extra[stall_env], "driver default"
+        else:
+            armed_stall = os.environ.get(stall_env) or \
+                (self._settings.env or {}).get(stall_env)
+            stall_src = "user-provided"
+        # Logged per generation so operators can correlate a restart loop
+        # with the watchdog window it ran under (ADVICE r5 #4).
+        get_logger().info(
+            "generation %d: %s=%s (%s)", version, stall_env, armed_stall,
+            stall_src)
         out_dir = None
         if self._settings.output_filename:
             out_dir = os.path.join(self._settings.output_filename,

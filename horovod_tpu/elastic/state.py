@@ -75,6 +75,14 @@ class WorkerNotificationManager:
         self._client = CoordinatorClient(addr, _secret.decode(key_s))
         v = os.environ.get(C.WORLD_VERSION_ENV)
         self._launch_version = int(v) if v else None
+        iv = os.environ.get(C.POLL_INTERVAL_ENV)
+        if iv:
+            try:
+                # The driver pins this to its discovery cadence so a short
+                # generation (few commits) still observes a mid-run bump.
+                self._poll_interval_s = float(iv)
+            except ValueError:
+                pass
 
     def check(self) -> None:
         """Raise HostsUpdatedInterrupt if membership moved past the version

@@ -13,9 +13,14 @@ exact AdamW:
 - Adafactor-style factored second moment for the expert tensors only
   (via :func:`partition` + ``optax.adafactor``).
 - :func:`every_k` — apply the expert-bank update every k-th step with
-  the update scaled by k (same expected LR), skipping the entire
-  param/m/v read-modify-write on the other k-1 steps (``lax.cond``
-  executes one branch at runtime).
+  the update scaled by k (same expected LR). CAUTION: this single-program
+  ``lax.cond`` form does NOT realize the HBM saving — cond cannot alias
+  loop-carried state across the branch, so the skip branch's pass-through
+  of m/v/params is a COPY that measured away the entire win (and -15%
+  with donation disabled; VERDICT r5 #2). For the real saving use
+  :func:`deferred_pair` + ``train.make_gspmd_deferred_train_step``
+  (two jitted programs; the skip program aliases donated buffers and
+  DCEs the dead dL/dW einsums — +22% on Mixtral).
 
 :func:`partition` routes subtrees to different transforms by parameter
 path (``optax.multi_transform`` with a path-predicate labeler).
@@ -139,12 +144,21 @@ def every_k(inner: optax.GradientTransformation, k: int,
             scale: Optional[float] = None):
     """Apply ``inner`` only every k-th step, scaling its update by
     ``scale`` (default k, preserving the expected per-step LR); the other
-    k-1 steps emit zero updates and do NOT touch inner state — under
-    ``lax.cond`` the param/m/v read-modify-write is skipped at runtime,
-    cutting the expert bank's optimizer HBM traffic by ~(k-1)/k. The
+    k-1 steps emit zero updates and do NOT touch inner state. The
     applied update uses the CURRENT gradient (no accumulator: an
     accumulator would itself read+write a bank-sized buffer every step,
     spending what the deferral saves).
+
+    PERFORMANCE CAUTION: do not expect an HBM saving from this form.
+    ``lax.cond`` cannot alias the untouched m/v through the branch, so
+    the skip branch COPIES the moments every step — measured to cancel
+    the entire ~(k-1)/k traffic win (VERDICT r5 #2; hvd-analyze flags
+    the pattern as ``jax-cond-carry``). ``every_k`` remains useful for
+    SEMANTIC deferral (same expected LR with stale-free updates); for
+    the real HBM/throughput win use :func:`deferred_pair` with
+    ``train.make_gspmd_deferred_train_step``, which compiles separate
+    apply/skip programs so donated buffers alias and the dead gradient
+    einsums are DCE'd.
 
     CONSTRAINT: ``inner``'s internal step count only advances on apply
     steps (its state is untouched on skips), so any schedule or

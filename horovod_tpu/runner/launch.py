@@ -13,6 +13,8 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -302,9 +304,42 @@ def parse_settings(argv: List[str]) -> "tuple[Settings, List[str]]":
     return s, command
 
 
+def _maybe_preflight_analyze(command: List[str]) -> None:
+    """Opt-in static preflight (``HOROVOD_PREFLIGHT_ANALYZE=1``).
+
+    Runs hvd-analyze over the entry script BEFORE any worker spawns: the
+    AST trap lint always, plus the jaxpr collective checks when the
+    script defines an ``HVD_ANALYZE`` factory (see docs/analysis.md).
+    Runs in a subprocess pinned to CPU so tracing can never touch this
+    process' backend state or a real chip.  ERROR findings abort the
+    launch (the whole point: catch the deadlock before N hosts hang);
+    set the variable to ``warn`` to report without aborting.
+    """
+    val = os.environ.get("HOROVOD_PREFLIGHT_ANALYZE", "").lower()
+    if val not in ("1", "true", "yes", "on", "warn"):
+        return
+    script = next((c for c in command if c.endswith(".py")), None)
+    if script is None or not os.path.exists(script):
+        return
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis",
+         "--preflight", script],
+        env=env, capture_output=True, text=True)
+    out = (proc.stdout or "") + (proc.stderr or "")
+    if out.strip():
+        print(f"[hvdrun] preflight analyze ({script}):\n{out.strip()}")
+    if proc.returncode == 1 and val != "warn":
+        raise SystemExit(
+            f"[hvdrun] preflight analyze found ERROR findings in "
+            f"{script}; fix them or relaunch with "
+            f"HOROVOD_PREFLIGHT_ANALYZE=warn to proceed anyway")
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     s, command = parse_settings(argv if argv is not None
                                 else sys.argv[1:])
+    _maybe_preflight_analyze(command)
     if s.elastic:
         try:
             from ..elastic.driver import run_elastic

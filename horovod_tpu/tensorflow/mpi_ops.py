@@ -94,10 +94,13 @@ def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:
 
 
 def shutdown() -> None:
+    # Release only this binding's _state; the engine is the SHARED process
+    # engine (context_api.process_engine, also ridden by torch and the
+    # JAX-path object helpers) and is torn down by core.context_api's
+    # shutdown, which owns its lifecycle (ADVICE r5 #3).
     global _state
     with _lock:
         if _state is not None:
-            _state.engine.shutdown()
             _state = None
 
 

@@ -15,6 +15,13 @@ processes (one tiny allgather) and raises with the divergent processes
 listed. Enable via ``HOROVOD_MISMATCH_CHECK=1`` (eager ops record
 automatically) and call ``verify()`` at step/epoch boundaries, or use it
 standalone around any suspect region.
+
+This is the RUNTIME half of the story; the STATIC half is hvd-analyze
+(``horovod_tpu/analysis``), which extracts the same per-collective
+signature stream from the jaxpr before launch — run it first
+(``python -m horovod_tpu.analysis``, or ``HOROVOD_PREFLIGHT_ANALYZE=1``
+on the launcher) and reach for this digest when divergence is
+data-dependent and only reproduces live.
 """
 
 from __future__ import annotations

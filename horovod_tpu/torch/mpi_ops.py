@@ -121,9 +121,13 @@ class _TorchRuntime:
                                  kind, name)
 
     def shutdown(self):
+        # Release only what THIS binding owns (its executors).  The
+        # engine is the shared process engine (context_api.process_engine,
+        # also used by TF and the JAX-path object helpers); its teardown
+        # belongs to core.context_api.shutdown — shutting it down here
+        # would yank it from under the other frontends (ADVICE r5 #3).
         for ex in self._executors.values():
             ex.shutdown(wait=True)
-        self.engine.shutdown()
 
 
 def init(engine: Optional[_engine.CollectiveEngine] = None) -> None:

@@ -418,9 +418,22 @@ def make_gspmd_deferred_train_step(model, pair, mesh, rules, **kw):
     step_apply = make_gspmd_train_step(model, pair.apply, mesh, rules, **kw)
     step_skip = make_gspmd_train_step(model, pair.skip, mesh, rules, **kw)
     every = int(pair.every)
-    counter = {"n": 0}
+    # Seeded from state.step on first call (not 0) so a checkpoint /
+    # elastic resume keeps the apply-vs-skip cadence PHASE: a job that
+    # restarts mid-window must not stretch the window, or the apply
+    # program's update scale (k baked in by deferred_pair) and the real
+    # number of accumulated skip steps disagree.
+    counter = {"n": None}
 
     def step(state, tokens):
+        if counter["n"] is None:
+            try:
+                counter["n"] = int(state.step)
+            except jax.errors.ConcretizationTypeError:
+                # Abstract tracing (hvd-analyze / make_jaxpr): this
+                # host-side dispatcher picks ONE program per call, so the
+                # phase seed is moot — fall back to 0.
+                counter["n"] = 0
         counter["n"] += 1
         fn = step_apply if counter["n"] % every == 0 else step_skip
         return fn(state, tokens)

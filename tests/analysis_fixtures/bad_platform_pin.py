@@ -1,0 +1,11 @@
+"""lint-late-platform-pin fixture: sets the env var but never calls
+jax.config.update("jax_platforms", ...) — on this image the axon TPU
+backend is pre-registered by sitecustomize, so the env var alone does
+not switch backends."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # <- lint-late-platform-pin
+
+import jax  # noqa: E402
+
+print(len(jax.devices()))

@@ -1,0 +1,13 @@
+"""lint-slope-cadence fixture: the deferred arm applies every k=4 steps
+but the slope windows (3, 8) aren't both multiples of 4 — min-over-
+repeats then cherry-picks windows that dodge the expensive apply step."""
+from benchmarks.common import slope_time_paired
+
+from horovod_tpu.optimizer import deferred_pair
+
+
+def main():
+    pair = deferred_pair(1e-4, every=4)
+    runs = {"deferred": lambda s: None}
+    del pair
+    return slope_time_paired(runs, 3, 8)  # <- lint-slope-cadence

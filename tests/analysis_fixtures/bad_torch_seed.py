@@ -1,0 +1,10 @@
+"""lint-torch-seed fixture: seeding torch's GLOBAL RNG inside a rank fn
+that thread-sim ranks run concurrently."""
+import torch
+
+
+def launch(run_parallel):
+    def rank_fn(rank):
+        torch.manual_seed(rank)  # <- lint-torch-seed
+        return torch.randn(2, 2)
+    return run_parallel(2, rank_fn)

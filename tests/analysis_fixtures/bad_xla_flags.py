@@ -1,0 +1,5 @@
+"""lint-xla-flags fixture: unguarded mutation with a non-allowlisted
+flag — XLA F-aborts the process on names the backend doesn't know."""
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_gpu_all_reduce_combine_threshold_bytes=1048576"  # <- lint-xla-flags

@@ -380,7 +380,8 @@ WORKER_SCRIPT = textwrap.dedent("""\
     @elastic.run
     def train(st):
         crash_at = os.environ.get("TEST_CRASH_AT_STEP")
-        while st.steps < 6:
+        total = int(os.environ.get("TEST_TOTAL_STEPS", "6"))
+        while st.steps < total:
             st.steps += 1
             if (crash_at and st.steps == int(crash_at)
                     and gen == "1" and pid == "0"):
@@ -441,6 +442,11 @@ def test_elastic_driver_grows_on_host_add(tmp_path):
                  host_discovery_script=dscript,
                  discovery_interval_s=0.1, start_timeout_s=60,
                  env={"TEST_MARKER_DIR": str(marker),
+                      # Long enough (150 x 0.02s = 3s of commits) that the
+                      # t=1s host-add always lands mid-generation — with the
+                      # default 6 steps a fast worker finishes before the
+                      # membership ever changes and the test races itself.
+                      "TEST_TOTAL_STEPS": "150",
                       "PYTHONPATH": _WORKER_PYTHONPATH})
     d = elastic.ElasticDriver(s, [sys.executable, str(script)])
 
@@ -456,7 +462,7 @@ def test_elastic_driver_grows_on_host_add(tmp_path):
     done = sorted(os.listdir(marker))
     # The final generation must include a 2-process world completion...
     assert any(f.endswith("p1") for f in done), done
-    assert all((marker / f).read_text() == "6" for f in done)
+    assert all((marker / f).read_text() == "150" for f in done)
 
 
 def test_sampler_epoch_tail_padding_stays_even():
