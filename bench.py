@@ -139,10 +139,28 @@ def main():
         "unit": f"images/sec/chip ({'bf16, s2d stem' if tpu else 'tiny/fp32'}"
                 f", batch {per_chip_batch}/chip, {n}x{platform})",
         "vs_baseline": round(vs_baseline, 4),
-        # Single-run tunnel noise on this ratio is ±1-2% (median of
-        # interleaved round-local ratios; docs/benchmarks.md methodology)
-        # — readings in [0.98, 1.02] are parity with the plain-JAX step.
-        "vs_baseline_noise": "±0.02",
+        # ACROSS-SESSION noise band, re-derived r6 from the five committed
+        # readings (r01-r05: 0.9996/0.9886/0.9985/0.9999/0.9631 — spread
+        # 0.037 with an HLO-identity test proving zero graph tax, see
+        # tests/test_bench_parity.py + docs/benchmarks.md "Parity band").
+        # The old ±0.02 described single-run round noise only and r05's
+        # 0.9631 breached it without any graph change.
+        "vs_baseline_noise": "±0.04 across sessions",
+        # Single-run evidence for the band: the min/max/spread of THIS
+        # run's interleaved round-local ratios.
+        "vs_baseline_rounds": {
+            "rounds": len([r for r in rounds
+                           if r.get("plain", 0) > 2e-9
+                           and r.get("hvd", 0) > 2e-9]),
+            "ratio_min": round(min((r["plain"] / r["hvd"] for r in rounds
+                                    if r.get("plain", 0) > 2e-9
+                                    and r.get("hvd", 0) > 2e-9),
+                                   default=float("nan")), 4),
+            "ratio_max": round(max((r["plain"] / r["hvd"] for r in rounds
+                                    if r.get("plain", 0) > 2e-9
+                                    and r.get("hvd", 0) > 2e-9),
+                                   default=float("nan")), 4),
+        },
     }
     peak = peak_flops()
     if tpu and np.isfinite(peak):

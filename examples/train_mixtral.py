@@ -12,6 +12,14 @@ CPU smoke test (8 virtual devices, dp2×ep4):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/train_mixtral.py --dp 2 --ep 4 --batch-size 4 \
         --seq-len 64 --steps 3
+
+Layer-loop trade (``MixtralConfig.scan_layers``, inherited from
+LlamaConfig): the default "auto" unrolls small configs (n_layers ≤ 8 —
+this script's tiny model) and scans big ones (mixtral_8x7b). The HEADLINE
+bench numbers (docs/benchmarks.md r5) run ``scan_layers=False`` even at
+32 layers: +22% Mixtral step throughput for ~3x compile time. Pin an
+explicit True/False for runs whose checkpoints must survive config edits
+(the param tree differs between the two layouts).
 """
 
 import argparse

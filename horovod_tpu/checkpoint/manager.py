@@ -65,16 +65,53 @@ class CheckpointManager:
     def restore(self, step: Optional[int] = None,
                 like: Optional[Any] = None) -> Any:
         """Restore ``step`` (default: newest). ``like`` gives the target
-        structure/shardings for direct-to-device placement."""
+        structure/shardings for direct-to-device placement.
+
+        When no explicit ``step`` is requested and the newest checkpoint is
+        unreadable (truncated by a crash mid-copy, a full disk, or the
+        chaos harness's ``corrupt`` fault), restore walks ``all_steps()``
+        newest→oldest and returns the first readable one — losing a save
+        interval beats losing the job (docs/failure_model.md). A stale
+        restore is loud (error log listing the skipped steps), and when
+        EVERY step fails with the same error the failure is systematic
+        (e.g. a ``like`` structure/sharding mismatch after a config
+        change), not per-file corruption — the original error is
+        re-raised instead of being buried under FileNotFoundError. Pass
+        an explicit ``step=`` to disable the fallback entirely."""
         import orbax.checkpoint as ocp
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found under {self._dir}")
         args = (ocp.args.StandardRestore(like) if like is not None
                 else ocp.args.StandardRestore())
-        return self._mgr.restore(step, args=args)
+        if step is not None:
+            return self._mgr.restore(step, args=args)
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self._dir}")
+        failed = []   # (step, exc), newest first
+        for s in reversed(steps):
+            try:
+                out = self._mgr.restore(s, args=args)
+            except Exception as e:   # noqa: BLE001 — orbax raises various
+                failed.append((s, e))
+                get_logger().error(
+                    "checkpoint step %d under %s unreadable (%s) — "
+                    "falling back to the previous step", s, self._dir, e)
+                continue
+            if failed:
+                get_logger().error(
+                    "restored STALE checkpoint step %d under %s — newer "
+                    "steps %s were skipped as unreadable. If their "
+                    "errors above are structural (a config change "
+                    "altered the state tree) this silently rewinds "
+                    "training; pass step= to fail loudly instead.",
+                    s, self._dir, [f[0] for f in failed])
+            return out
+        newest_exc = failed[0][1]
+        if len({(type(e).__name__, str(e)) for _, e in failed}) == 1:
+            raise newest_exc
+        raise FileNotFoundError(
+            f"no readable checkpoint under {self._dir} "
+            f"({len(failed)} unreadable steps)") from newest_exc
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

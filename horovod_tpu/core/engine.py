@@ -645,6 +645,12 @@ class JaxProcessEngine(CollectiveEngine):
         self._stall_queue = None         # created on first bounded call
         self._stall_in_pool = threading.local()
         self._transport_lost: Optional[str] = None
+        # The jit-step deadline monitor (core/watchdog.py) marks registered
+        # engines transport-lost when a compiled step is abandoned — the
+        # dead collective wedges both planes, so the next engine op must
+        # fail fast instead of hanging behind it.
+        from . import watchdog as _watchdog
+        _watchdog.monitor().register_engine(self)
 
     def _stall_worker(self) -> None:
         """Round-thread loop. A DAEMON thread on purpose: after a stall
@@ -675,8 +681,22 @@ class JaxProcessEngine(CollectiveEngine):
         process restart under the elastic driver, exactly like the
         reference's shutdown-after-stall escalation.
         """
+        import os as _os
+        if _os.environ.get("HOROVOD_FAULT_SPEC"):   # faults.FAULT_SPEC_ENV
+            # Chaos hook (testing/faults.py): delay/drop faults schedule on
+            # the engine-round axis. Production pays one environ lookup.
+            from ..testing.faults import fault_harness as _fh
+            h = _fh()
+            if h is not None:
+                h.before_engine_round(what)
+        from . import watchdog as _watchdog
         warn, shutdown = self._stall_warn, self._stall_shutdown
-        if warn <= 0 and shutdown <= 0:
+        # The peer-liveness push needs a waiting caller to deliver the
+        # rescue to, so a coordinator-armed process routes rounds through
+        # the round thread even with both stall windows unset (STALL=0 —
+        # the reference default that used to mean "blocked forever").
+        peer_armed = _watchdog.engine_peer_watch_armed()
+        if warn <= 0 and shutdown <= 0 and not peer_armed:
             return fn()
         if getattr(self._stall_in_pool, "flag", False):
             return fn()   # nested transport call, already on the round thread
@@ -693,29 +713,48 @@ class JaxProcessEngine(CollectiveEngine):
         import time as _time
         start = _time.monotonic()
         warned = False
-        while True:
-            if box["done"].wait(timeout=0.25):
-                if "error" in box:
-                    raise box["error"]
-                return box["result"]
-            idle = _time.monotonic() - start
-            if warn > 0 and idle >= warn and not warned:
-                warned = True
-                from .logging import get_logger
-                get_logger().warning(
-                    "engine %s blocked for %.0fs — a peer may be dead "
-                    "or hung (reference stall_inspector warning; "
-                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=%.0f)",
-                    what, idle, shutdown)
-            if shutdown > 0 and idle >= shutdown:
-                from .exceptions import HorovodInternalError
-                self._transport_lost = (
-                    f"engine {what} stalled for >{shutdown:.0f}s "
-                    "(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); the "
-                    "transport is considered lost — re-init required "
-                    "(under hvdrun --min-np the elastic driver "
-                    "relaunches the job)")
-                raise HorovodInternalError(self._transport_lost)
+        if peer_armed:
+            _watchdog.monitor().begin_engine_wait()
+        try:
+            while True:
+                if box["done"].wait(timeout=0.25):
+                    if "error" in box:
+                        raise box["error"]
+                    return box["result"]
+                idle = _time.monotonic() - start
+                if warn > 0 and idle >= warn and not warned:
+                    warned = True
+                    from .logging import get_logger
+                    get_logger().warning(
+                        "engine %s blocked for %.0fs — a peer may be dead "
+                        "or hung (reference stall_inspector warning; "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=%.0f)",
+                        what, idle, shutdown)
+                if shutdown > 0 and idle >= shutdown:
+                    from .exceptions import HorovodInternalError
+                    self._transport_lost = (
+                        f"engine {what} stalled for >{shutdown:.0f}s "
+                        "(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); the "
+                        "transport is considered lost — re-init required "
+                        "(under hvdrun --min-np the elastic driver "
+                        "relaunches the job)")
+                    raise HorovodInternalError(self._transport_lost)
+                reason = _watchdog.engine_deadline_reason(start)
+                if reason is not None:
+                    # Step-timeout / peer-death deadlines bound engine
+                    # rounds too (docs/failure_model.md) — the round thread
+                    # stays parked in the dead collective, same escalation
+                    # as the stall shutdown above.
+                    from .exceptions import HorovodInternalError
+                    self._transport_lost = (
+                        f"engine {what} abandoned: {reason}; the transport "
+                        "is considered lost — re-init required (under "
+                        "hvdrun --min-np the elastic driver relaunches "
+                        "the job)")
+                    raise HorovodInternalError(self._transport_lost)
+        finally:
+            if peer_armed:
+                _watchdog.monitor().end_engine_wait()
 
     @staticmethod
     def _sig_hash(sig: tuple) -> int:

@@ -195,8 +195,16 @@ class ElasticDriver:
                 codes[a.hostname] = code
             # Fate sharing: first non-zero exit retires the whole
             # generation. RESTART exits retire it too (that is their
-            # purpose) but are not failures.
+            # purpose) but are not failures. Real failures are ALSO
+            # published on /world (peer-liveness push) before the SIGTERM
+            # sweep, so survivors wedged inside the XLA runtime — where
+            # SIGTERM's Python handler never runs — arm the short
+            # HOROVOD_PEER_FAILURE_GRACE_SECONDS deadline on their
+            # in-flight step instead of blocking until the stall window
+            # (docs/failure_model.md).
             if code != 0:
+                if code != C.RESTART_EXIT_CODE and not stop.is_set():
+                    self._service.mark_failure(a.hostname, code)
                 stop.set()
 
         threads = [threading.Thread(target=run_one, args=(a,), daemon=True)

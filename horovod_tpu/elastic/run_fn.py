@@ -60,6 +60,11 @@ def _reinitialize() -> None:
     import horovod_tpu as hvd
     hvd.shutdown()
     hvd.init()
+    # The step monitor's peer-failure flag is scoped to the OLD world:
+    # left armed, its long-expired grace deadline would instantly abandon
+    # every step of the recovered run (core/watchdog.py).
+    from ..core.watchdog import monitor
+    monitor().reset_for_recovery()
 
 
 def run(func: Callable) -> Callable:
@@ -91,7 +96,17 @@ def run(func: Callable) -> Callable:
                 if _mode() == "restart":
                     # State was persisted at the last commit; ask the driver
                     # for a relaunch with whatever membership is now alive.
-                    sys.exit(C.RESTART_EXIT_CODE)
+                    # HARD exit (no atexit): this error means the data-plane
+                    # transport is lost, and the graceful path runs the
+                    # distributed runtime's shutdown barrier — which blocks
+                    # forever against the hung/dead peer that caused this
+                    # very error (the hung-peer chaos test wedged exactly
+                    # there). The driver only needs the exit code. The
+                    # HostsUpdatedInterrupt path below keeps sys.exit: there
+                    # every peer is alive and exiting together.
+                    sys.stdout.flush()
+                    sys.stderr.flush()
+                    os._exit(C.RESTART_EXIT_CODE)
                 state.restore()
                 _reinitialize()
                 # Repair cross-process divergence: peers may have committed

@@ -46,6 +46,14 @@ class CoordinatorService:
         self._hosts: Dict[str, int] = {}
         self._np = 0
         self._started: Dict[int, float] = {}   # process_id -> monotonic ts
+        # Peer-liveness push (docs/failure_model.md): worker exits the
+        # driver observed this generation. ``_failure_seq`` is monotonic
+        # across generations so a worker's watcher can detect NEW failures
+        # by comparing sequence numbers; the failure list itself is scoped
+        # to one generation (cleared by update_world) so a relaunched
+        # survivor does not re-arm on its predecessor's death.
+        self._failures: list = []
+        self._failure_seq = 0
 
         svc = self
 
@@ -66,7 +74,9 @@ class CoordinatorService:
                 if self.path == "/world":
                     with svc._lock:
                         self._reply({"version": svc._version,
-                                     "hosts": svc._hosts, "np": svc._np})
+                                     "hosts": svc._hosts, "np": svc._np,
+                                     "failures": list(svc._failures),
+                                     "failure_seq": svc._failure_seq})
                 else:
                     self._reply({"error": "not found"}, 404)
 
@@ -104,7 +114,19 @@ class CoordinatorService:
             self._version += 1
             self._hosts = dict(hosts)
             self._np = np_
+            self._failures = []   # failures are per-generation; seq stays
             return self._version
+
+    def mark_failure(self, host: str, code: int) -> int:
+        """Record a worker-process death for the peer-liveness push
+        (driver's ``run_one`` calls this the moment a worker exits
+        non-zero). Survivors' step monitors poll it off ``/world`` and arm
+        the ``HOROVOD_PEER_FAILURE_GRACE_SECONDS`` deadline on the step
+        they are blocked in. Returns the new failure sequence number."""
+        with self._lock:
+            self._failure_seq += 1
+            self._failures.append({"host": host, "code": int(code)})
+            return self._failure_seq
 
     @property
     def version(self) -> int:

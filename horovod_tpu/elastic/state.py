@@ -33,6 +33,8 @@ TPU deltas:
 from __future__ import annotations
 
 import copy
+import hashlib
+import hmac
 import os
 import pickle
 import tempfile
@@ -167,9 +169,41 @@ def _commit_path(commit_dir: str) -> str:
     return os.path.join(commit_dir, "state.latest.pkl")
 
 
+def _prev_commit_path(commit_dir: str) -> str:
+    return os.path.join(commit_dir, "state.prev.pkl")
+
+
+#: Commit-integrity trailer: <pickle body><16-byte blake2b digest><magic>.
+#: The magic goes LAST so a truncation — the dominant real-world corruption
+#: (full disk, killed writer, chopped copy) — always destroys it and the
+#: file is recognizably damaged rather than mis-verified.
+_CHECK_MAGIC = b"HVDCK1\n"
+_CHECK_DIGEST_SIZE = 16
+
+
+def _frame(body: bytes) -> bytes:
+    digest = hashlib.blake2b(body, digest_size=_CHECK_DIGEST_SIZE).digest()
+    return body + digest + _CHECK_MAGIC
+
+
+def _unframe(blob: bytes) -> Optional[bytes]:
+    """Verified pickle body, or None when the checksum fails. Files without
+    the trailer (pre-integrity commits) are accepted as-is — their only
+    protection is pickle's own parse errors, exactly the legacy behavior."""
+    if not blob.endswith(_CHECK_MAGIC):
+        return blob
+    body = blob[:-(len(_CHECK_MAGIC) + _CHECK_DIGEST_SIZE)]
+    digest = blob[len(body):-len(_CHECK_MAGIC)]
+    want = hashlib.blake2b(body, digest_size=_CHECK_DIGEST_SIZE).digest()
+    return body if hmac.compare_digest(digest, want) else None
+
+
 def _persist(commit_dir: str, payload: Dict[str, Any]) -> None:
     """Atomic write (tmp + rename) so a crash mid-commit never corrupts the
-    restore point.
+    restore point, with a checksum trailer and one-deep rotation: the
+    previous committed generation survives as ``state.prev.pkl`` so
+    ``load_persisted`` can fall back when the newest commit fails
+    verification (docs/failure_model.md — corruption containment).
 
     EVERY process persists to its own local disk (the commit_dir path is
     per-host), so losing any host — including the one that was process 0 —
@@ -180,8 +214,13 @@ def _persist(commit_dir: str, payload: Dict[str, Any]) -> None:
     fd, tmp = tempfile.mkstemp(dir=commit_dir, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, _commit_path(commit_dir))
+            f.write(_frame(pickle.dumps(payload)))
+        latest = _commit_path(commit_dir)
+        if os.path.exists(latest):
+            # Rotate BEFORE replacing: latest is still intact here, so the
+            # fallback is always a fully-written commit.
+            os.replace(latest, _prev_commit_path(commit_dir))
+        os.replace(tmp, latest)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -190,12 +229,33 @@ def _persist(commit_dir: str, payload: Dict[str, Any]) -> None:
         raise
 
 
-def load_persisted(commit_dir: str) -> Optional[Dict[str, Any]]:
+def _load_verified(path: str) -> Optional[Dict[str, Any]]:
     try:
-        with open(_commit_path(commit_dir), "rb") as f:
-            return pickle.load(f)
+        with open(path, "rb") as f:
+            blob = f.read()
+        body = _unframe(blob)
+        if body is None:
+            get_logger().error(
+                "commit %s failed checksum verification — ignoring it",
+                path)
+            return None
+        return pickle.loads(body)
     except (OSError, pickle.UnpicklingError, EOFError):
         return None
+
+
+def load_persisted(commit_dir: str) -> Optional[Dict[str, Any]]:
+    """The newest VERIFIED local commit: ``state.latest.pkl`` when its
+    checksum holds, else the previous committed generation."""
+    payload = _load_verified(_commit_path(commit_dir))
+    if payload is not None:
+        return payload
+    payload = _load_verified(_prev_commit_path(commit_dir))
+    if payload is not None:
+        get_logger().warning(
+            "newest commit in %s unreadable — falling back to the previous "
+            "committed generation (seq=%s)", commit_dir, payload.get("seq"))
+    return payload
 
 
 def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:

@@ -75,7 +75,14 @@ class LlamaConfig:
     # throughput from unrolling at the Llama / Mixtral / longctx bench
     # configs (r5, docs/benchmarks.md). Prefer False for production
     # training runs when the ~3x compile time is acceptable.
-    scan_layers: bool = True
+    # "auto" (the default): unroll when n_layers is small enough to
+    # compile fast (≤ SCAN_LAYERS_AUTO_THRESHOLD), scan above it —
+    # small/test configs get the throughput win for free, big configs
+    # keep bounded compile time. NOTE: the choice is checkpoint-visible
+    # (scan stacks params [L,...] under one "layers" node; unrolled uses
+    # block_0..block_{L-1}), so pin True/False explicitly for any run
+    # whose checkpoints must outlive config edits.
+    scan_layers: Any = "auto"
     tie_embeddings: bool = False
     # None = auto: Pallas flash attention on TPU, materialised softmax
     # elsewhere (interpret-mode Pallas is too slow for CPU test meshes).
@@ -88,6 +95,20 @@ class LlamaConfig:
     # (parallel/ulysses.py; needs n_heads % sp == 0). Both engage only
     # when the ambient mesh has an "sp" axis of size > 1.
     attention_impl: "str | None" = None
+
+
+#: ``scan_layers="auto"`` unrolls at or below this layer count. 8 unrolled
+#: tiny-config layers trace in seconds on the CPU test mesh; the 32-layer
+#: production configs stay on scan (their ~3x compile cost is the real
+#: trade — see the field comment above).
+SCAN_LAYERS_AUTO_THRESHOLD = 8
+
+
+def resolve_scan_layers(c: "LlamaConfig") -> bool:
+    """The effective scan-vs-unroll choice for ``c`` (handles "auto")."""
+    if c.scan_layers == "auto":
+        return c.n_layers > SCAN_LAYERS_AUTO_THRESHOLD
+    return bool(c.scan_layers)
 
 
 def llama3_8b() -> LlamaConfig:
@@ -316,7 +337,7 @@ def decoder_trunk(mdl: nn.Module, c: LlamaConfig, tokens, block_cls,
     x = nn_partitioning.with_sharding_constraint(x, ("batch", "seq", "embed"))
     positions = jnp.arange(tokens.shape[1])[None, :]
 
-    if c.scan_layers:
+    if resolve_scan_layers(c):
         scanned = scanned_cls
         if c.remat:
             scanned = _remat(scanned_cls, c.remat_policy)

@@ -88,6 +88,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="worker log level (HOROVOD_LOG_LEVEL)")
     p.add_argument("--no-stall-check", action="store_true",
                    dest="no_stall_check")
+    p.add_argument("--step-timeout-seconds", type=float,
+                   dest="step_timeout_seconds",
+                   help="jit-step deadline monitor window "
+                        "(HOROVOD_STEP_TIMEOUT_SECONDS; 0 disables)")
+    p.add_argument("--fault-spec", dest="fault_spec",
+                   help="deterministic fault-injection schedule for chaos "
+                        "runs (HOROVOD_FAULT_SPEC; see "
+                        "horovod_tpu/testing/faults.py for the grammar, "
+                        "e.g. 'kill:rank=1,step=3')")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    dest="stall_check_warning_time_seconds")
     p.add_argument("--stall-check-shutdown-time-seconds", type=float,
@@ -254,6 +263,14 @@ def _tuning_env(args) -> dict:
     if args.stall_check_shutdown_time_seconds is not None:
         env["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = str(
             args.stall_check_shutdown_time_seconds)
+    if args.step_timeout_seconds is not None:
+        env["HOROVOD_STEP_TIMEOUT_SECONDS"] = str(args.step_timeout_seconds)
+    if args.fault_spec:
+        # Validate on the LAUNCHER so a typo'd chaos schedule fails the run
+        # up front instead of silently testing nothing on the workers.
+        from ..testing.faults import FaultSpec
+        FaultSpec.parse(args.fault_spec)
+        env["HOROVOD_FAULT_SPEC"] = args.fault_spec
     return env
 
 

@@ -146,6 +146,16 @@ class _DistributedGradientTape:
         return self._tape.__exit__(*exc)
 
     def gradient(self, target, sources, output_gradients=None):
+        # Heartbeat span (core/watchdog.py): the blocking engine rounds in
+        # _reduce get their deadline rescue from the engine's _bounded; the
+        # span keeps the step heartbeat honest for the peer-liveness
+        # watcher. The call stays on THIS thread — tf.function tracing on
+        # a side thread would serialize on TF's tracing lock.
+        from ..core import watchdog as _watchdog
+        with _watchdog.monitor().step_span("tf_gradient"):
+            return self._gradient_inner(target, sources, output_gradients)
+
+    def _gradient_inner(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
         one = not isinstance(grads, (list, tuple))
         glist = [grads] if one else list(grads)

@@ -1,0 +1,7 @@
+"""Test-support utilities shipped with the package (not test-only code:
+the fault-injection harness is wired into the runner and engine so chaos
+scenarios are reproducible in any deployment, mirroring how the reference
+exposes timeline/stall instrumentation in-tree)."""
+
+from .faults import (FaultSpec, fault_harness, maybe_poison,  # noqa: F401
+                     on_step, will_fire)

@@ -1,0 +1,353 @@
+"""Deterministic fault-injection harness for chaos tests.
+
+Reference parity: upstream horovod proves its elastic recovery with
+scripted worker failures in ``test/integration/test_elastic_torch.py``
+(a hostfile edit plus an exception raised at an exact epoch on an exact
+rank). This module generalizes that pattern into one declarative,
+env-driven schedule so every failure mode the containment layer handles
+(docs/failure_model.md) is reproducible on demand — in tests AND in real
+deployments (``hvdrun --fault-spec`` for game-days).
+
+Design rule: **determinism by schedule, never by sleeps.** A fault fires
+when a specific RANK reaches a specific STEP (or engine-round) count.
+Wall-clock never decides *whether* a fault fires — only how long rescue
+takes, which is what chaos tests assert bounds on.
+
+Spec grammar (``HOROVOD_FAULT_SPEC``)::
+
+    fault[;fault...]
+    fault   := kind ":" key "=" val ["," key "=" val ...]
+    common  := rank=<int>          only this rank fires (default: all)
+               step=<int>          fire when on_step(step) reaches this
+    kinds   := kill   [signal=SIGKILL|SIGTERM]   kill own process mid-step
+               hang   [seconds=<float>]          block (forever by default)
+               delay  seconds=<float> [round=<int>]   delay one engine round
+               drop   [round=<int>]              block one engine round forever
+               corrupt path=<dir> [bytes=<int>]  truncate newest commit file
+               nan    [value=nan|inf]            poison gradients via
+                                                 maybe_poison()
+
+Examples::
+
+    kill:rank=1,step=3                      # SIGKILL rank 1 at step 3
+    hang:rank=1,step=3                      # rank 1 stops participating
+    kill:rank=1,step=3,signal=SIGTERM;nan:rank=0,step=5
+    delay:rank=0,round=4,seconds=2.5        # slow one engine round
+    corrupt:rank=0,step=4,path=/tmp/commits # truncate newest commit
+
+One-shot semantics: each fault fires at most once per PROCESS LIFETIME
+GENERATION — a marker file in ``HOROVOD_FAULT_MARKER_DIR`` (default: the
+elastic commit dir, else a spec-keyed tmpdir) records firings so a
+relaunched worker replaying steps 0..N does not re-fire the fault that
+killed its predecessor. That is what makes "kill rank 1 at step 3, then
+recover" a terminating scenario instead of a crash loop.
+
+Hook points:
+
+- ``on_step(step, rank)`` — called from watchdog-monitored step wrappers
+  and chaos workers at the top of each step (kill/hang/corrupt/nan arm).
+- ``before_engine_round(what)`` — called by core/engine.py before each
+  transport round when the spec env is set (delay/drop).
+- ``maybe_poison(tree)`` — returns ``tree`` with NaN/Inf splatted into
+  every leaf when a ``nan`` fault is armed for this step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import signal as _signal
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core.logging import get_logger
+
+FAULT_SPEC_ENV = "HOROVOD_FAULT_SPEC"
+FAULT_MARKER_DIR_ENV = "HOROVOD_FAULT_MARKER_DIR"
+
+_KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan")
+
+
+@dataclass
+class Fault:
+    kind: str
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    round: Optional[int] = None
+    params: Dict[str, str] = field(default_factory=dict)
+    index: int = 0
+
+    def matches(self, rank: Optional[int], count: int,
+                counter: str) -> bool:
+        """Does this fault fire for (rank, count)? ``counter`` selects
+        which schedule axis applies: "step" faults only match on_step
+        calls; "round" faults only match engine rounds."""
+        if self.rank is not None and rank is not None and self.rank != rank:
+            return False
+        want = self.step if counter == "step" else self.round
+        if want is None:
+            # A kind with no schedule on this axis never fires on it.
+            return False
+        return count == want
+
+    def marker_name(self) -> str:
+        return (f"hvd_fault.{self.index}.{self.kind}"
+                f".r{'any' if self.rank is None else self.rank}"
+                f".s{self.step if self.step is not None else self.round}"
+                ".done")
+
+
+@dataclass
+class FaultSpec:
+    faults: List[Fault] = field(default_factory=list)
+    raw: str = ""
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """Parse the ``HOROVOD_FAULT_SPEC`` grammar. Raises ValueError on
+        malformed specs — a chaos run with a typo'd spec silently testing
+        nothing is worse than a crash."""
+        spec = cls(raw=text.strip())
+        for idx, part in enumerate(p for p in text.split(";") if p.strip()):
+            kind, _, args = part.strip().partition(":")
+            kind = kind.strip().lower()
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (want one of {_KINDS})")
+            f = Fault(kind=kind, index=idx)
+            for kv in (a for a in args.split(",") if a.strip()):
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    raise ValueError(f"malformed fault arg {kv!r} "
+                                     "(want key=value)")
+                k, v = k.strip().lower(), v.strip()
+                if k == "rank":
+                    f.rank = int(v)
+                elif k == "step":
+                    f.step = int(v)
+                elif k == "round":
+                    f.round = int(v)
+                else:
+                    f.params[k] = v
+            if kind in ("delay", "drop") and f.round is None and \
+                    f.step is not None:
+                # delay/drop schedule on the engine-round axis; accept
+                # step= as an alias for convenience.
+                f.round, f.step = f.step, None
+            if kind not in ("delay", "drop") and f.step is None:
+                raise ValueError(f"fault {part!r} needs step=<int>")
+            if kind in ("delay", "drop") and f.round is None:
+                raise ValueError(f"fault {part!r} needs round=<int>")
+            if kind == "corrupt" and "path" not in f.params:
+                raise ValueError("corrupt fault needs path=<dir>")
+            spec.faults.append(f)
+        return spec
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultSpec"]:
+        text = os.environ.get(FAULT_SPEC_ENV)
+        return cls.parse(text) if text else None
+
+
+class FaultHarness:
+    """Per-process executor of a FaultSpec."""
+
+    def __init__(self, spec: FaultSpec,
+                 marker_dir: Optional[str] = None):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._round_count = 0
+        self._poison_armed: Optional[Fault] = None
+        if marker_dir is None:
+            marker_dir = os.environ.get(FAULT_MARKER_DIR_ENV)
+        if marker_dir is None:
+            from ..elastic import constants as C
+            marker_dir = os.environ.get(C.COMMIT_DIR_ENV)
+        if marker_dir is None:
+            # Spec-keyed so two concurrent test jobs cannot share markers.
+            h = hashlib.blake2b(spec.raw.encode(), digest_size=6).hexdigest()
+            marker_dir = os.path.join(tempfile.gettempdir(),
+                                      f"hvd_faults_{h}")
+        self.marker_dir = marker_dir
+        os.makedirs(self.marker_dir, exist_ok=True)
+
+    # -- one-shot bookkeeping ----------------------------------------------
+
+    def _fired(self, f: Fault) -> bool:
+        return os.path.exists(os.path.join(self.marker_dir, f.marker_name()))
+
+    def _mark_fired(self, f: Fault) -> None:
+        # Marker is written BEFORE the action: a kill fault must not
+        # re-fire on relaunch just because the process died mid-write.
+        path = os.path.join(self.marker_dir, f.marker_name())
+        with open(path, "w") as fh:
+            fh.write(f"{time.time()}\n")
+
+    def will_fire(self, kind: str, rank: Optional[int], step: int) -> bool:
+        """Query (without firing): would a ``kind`` fault fire for this
+        (rank, step)? Lets chaos workers stage side effects (e.g. rewrite
+        the discovery hostfile just before their own kill) without
+        wall-clock coordination."""
+        counter = "round" if kind in ("delay", "drop") else "step"
+        return any(f.kind == kind and f.matches(rank, step, counter)
+                   and not self._fired(f) for f in self.spec.faults)
+
+    # -- step-axis faults ---------------------------------------------------
+
+    def on_step(self, step: int, rank: Optional[int] = None) -> None:
+        """Fire any step-scheduled faults for (rank, step). Called at the
+        top of each training step."""
+        for f in self.spec.faults:
+            if not f.matches(rank, step, "step") or self._fired(f):
+                continue
+            if f.kind == "nan":
+                with self._lock:
+                    self._poison_armed = f
+                self._mark_fired(f)
+                get_logger().warning("fault: arming %s gradient poison "
+                                     "(rank=%s step=%d)",
+                                     f.params.get("value", "nan"), rank, step)
+            elif f.kind == "corrupt":
+                self._mark_fired(f)
+                self._corrupt(f)
+            elif f.kind == "kill":
+                self._mark_fired(f)
+                signame = f.params.get("signal", "SIGKILL").upper()
+                signum = getattr(_signal, signame)
+                get_logger().warning("fault: killing self with %s "
+                                     "(rank=%s step=%d)", signame, rank, step)
+                os.kill(os.getpid(), signum)
+                # SIGTERM may be handled; give teardown a moment then
+                # stop participating so peers' rescue path still runs.
+                time.sleep(60)
+                os._exit(1)
+            elif f.kind == "hang":
+                self._mark_fired(f)
+                secs = float(f.params.get("seconds", "0") or 0)
+                get_logger().warning("fault: hanging (rank=%s step=%d "
+                                     "seconds=%s)", rank, step,
+                                     secs or "forever")
+                if secs > 0:
+                    time.sleep(secs)
+                else:
+                    threading.Event().wait()   # block this step forever
+
+    def _corrupt(self, f: Fault) -> None:
+        """Truncate the newest regular file under path= (the latest
+        checkpoint/commit) to ``bytes`` bytes (default 17 — enough to
+        destroy any pickle/msgpack header while keeping the file present,
+        the nastiest corruption class: existing-but-unreadable)."""
+        root = f.params["path"]
+        keep = int(f.params.get("bytes", "17"))
+        newest, newest_m = None, -1.0
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                if name.startswith("hvd_fault."):
+                    continue
+                p = os.path.join(dirpath, name)
+                try:
+                    m = os.path.getmtime(p)
+                except OSError:
+                    continue
+                if m > newest_m:
+                    newest, newest_m = p, m
+        if newest is None:
+            get_logger().warning("fault: corrupt found no file under %s",
+                                 root)
+            return
+        with open(newest, "r+b") as fh:
+            fh.truncate(keep)
+        get_logger().warning("fault: truncated %s to %d bytes", newest, keep)
+
+    def maybe_poison(self, tree: Any) -> Any:
+        """If a ``nan`` fault armed this step, splat NaN/Inf into every
+        array leaf of ``tree`` (gradients). Disarms after one use."""
+        with self._lock:
+            f, self._poison_armed = self._poison_armed, None
+        if f is None:
+            return tree
+        import jax
+        import jax.numpy as jnp
+        bad = jnp.inf if f.params.get("value", "nan") == "inf" else jnp.nan
+        return jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, bad), tree)
+
+    # -- engine-round-axis faults ------------------------------------------
+
+    def before_engine_round(self, what: str = "") -> None:
+        """Engine hook (core/engine.py): counts transport rounds and
+        applies delay/drop faults scheduled on the round axis."""
+        with self._lock:
+            rnd = self._round_count
+            self._round_count += 1
+        rank = _env_rank()
+        for f in self.spec.faults:
+            if f.kind not in ("delay", "drop"):
+                continue
+            if not f.matches(rank, rnd, "round") or self._fired(f):
+                continue
+            self._mark_fired(f)
+            if f.kind == "delay":
+                secs = float(f.params.get("seconds", "1.0"))
+                get_logger().warning("fault: delaying engine round %d "
+                                     "(%s) by %.2fs", rnd, what, secs)
+                time.sleep(secs)
+            else:
+                get_logger().warning("fault: dropping engine round %d (%s) "
+                                     "— blocking forever", rnd, what)
+                threading.Event().wait()
+
+
+def _env_rank() -> Optional[int]:
+    for var in ("HOROVOD_RANK", "PMI_RANK", "OMPI_COMM_WORLD_RANK"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return None
+
+
+_harness: Optional[FaultHarness] = None
+_harness_lock = threading.Lock()
+_harness_spec_raw: Optional[str] = None
+
+
+def fault_harness() -> Optional[FaultHarness]:
+    """The process-wide harness, built lazily from ``HOROVOD_FAULT_SPEC``
+    (None when the env is unset — the common case; all hook sites gate on
+    the env before importing this module, so production pays only a
+    ``os.environ.get``)."""
+    global _harness, _harness_spec_raw
+    raw = os.environ.get(FAULT_SPEC_ENV)
+    if not raw:
+        return None
+    with _harness_lock:
+        if _harness is None or _harness_spec_raw != raw:
+            _harness = FaultHarness(FaultSpec.parse(raw))
+            _harness_spec_raw = raw
+        return _harness
+
+
+def on_step(step: int, rank: Optional[int] = None) -> None:
+    """Module-level convenience: fire step-scheduled faults if a spec is
+    armed. Rank defaults to the launcher-provided env rank."""
+    h = fault_harness()
+    if h is not None:
+        h.on_step(step, rank if rank is not None else _env_rank())
+
+
+def will_fire(kind: str, step: int, rank: Optional[int] = None) -> bool:
+    h = fault_harness()
+    if h is None:
+        return False
+    return h.will_fire(kind, rank if rank is not None else _env_rank(), step)
+
+
+def maybe_poison(tree: Any) -> Any:
+    h = fault_harness()
+    return tree if h is None else h.maybe_poison(tree)

@@ -350,10 +350,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._should_synchronize = True
 
     def step(self, closure=None):
-        if self._should_synchronize:
-            self.synchronize()
-        self._synchronized = False
-        return super(self.__class__, self).step(closure)
+        # Heartbeat span (core/watchdog.py): the blocking engine rounds
+        # inside synchronize() get their deadline rescue from the engine's
+        # _bounded; the span keeps the step heartbeat honest and gives the
+        # peer-liveness watcher an in-flight window to poll under.
+        from ..core import watchdog as _watchdog
+        with _watchdog.monitor().step_span("torch_step"):
+            if self._should_synchronize:
+                self.synchronize()
+            self._synchronized = False
+            return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
         if self._handles:

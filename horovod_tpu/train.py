@@ -26,6 +26,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .core import context_api as _ctx
+from .core.watchdog import monitored_step
 from .collectives.ops import effective_axis_size, force_axis_size1
 from .optimizer import broadcast_parameters
 
@@ -178,7 +179,12 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         return out
 
     marked.lower = jitted.lower  # keep AOT introspection available
-    return marked
+    # Jit-step deadline monitor (core/watchdog.py, docs/failure_model.md):
+    # unarmed this is a passthrough; armed, the blocking device fetch runs
+    # on a watcher-visible thread so a step blocked inside an XLA
+    # collective against a dead peer can be abandoned on deadline or
+    # peer-death notification instead of hanging the process forever.
+    return monitored_step(marked, what="train_step")
 
 
 def _autotuned_train_step(model, optimizer, loss_fn, **build_kw):
@@ -397,7 +403,15 @@ def make_gspmd_train_step(model, optimizer, mesh, rules, *,
         with jax.sharding.set_mesh(mesh):
             return jitted(state, tokens)
 
-    return run
+    def lower(state, tokens):
+        # AOT introspection must trace under the SAME mesh the step
+        # executes with (tests/test_bench_parity.py compares the
+        # post-SPMD-partitioning collective HLO of two such lowerings).
+        with jax.sharding.set_mesh(mesh):
+            return jitted.lower(state, tokens)
+
+    run.lower = lower
+    return monitored_step(run, what="gspmd_train_step")
 
 
 def make_gspmd_deferred_train_step(model, pair, mesh, rules, **kw):
@@ -438,4 +452,10 @@ def make_gspmd_deferred_train_step(model, pair, mesh, rules, **kw):
         fn = step_apply if counter["n"] % every == 0 else step_skip
         return fn(state, tokens)
 
+    # AOT introspection per program (the dispatcher itself has no single
+    # lowering): tests/test_bench_parity.py pins that at every=1 the apply
+    # program's collective HLO is byte-identical to the standard step's.
+    # getattr: stubbed step factories (tests) carry no .lower.
+    step.lower_apply = getattr(step_apply, "lower", None)
+    step.lower_skip = getattr(step_skip, "lower", None)
     return step

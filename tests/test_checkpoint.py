@@ -71,6 +71,61 @@ def test_restore_missing_raises(tmp_path):
             mgr.restore()
 
 
+def test_restore_falls_back_loudly_on_corrupt_newest(tmp_path, mesh8):
+    """Newest step unreadable (crash-truncated / the chaos harness's
+    ``corrupt`` fault): restore walks back to the previous readable step
+    — LOUDLY, naming the skipped steps so a rewind is never silent."""
+    import logging as _logging
+    from horovod_tpu.core.logging import get_logger
+
+    state = _sharded_state(mesh8)
+    with CheckpointManager(str(tmp_path / "c")) as mgr:
+        mgr.save(1, state)
+        mgr.save(2, state)
+        mgr.wait_until_finished()
+        real = mgr._mgr.restore
+
+        def flaky(s, args=None):
+            if s == 2:
+                raise OSError("truncated tensorstore chunk")
+            return real(s, args=args)
+
+        mgr._mgr.restore = flaky
+        messages = []
+        handler = _logging.Handler()
+        handler.emit = lambda r: messages.append(r.getMessage())
+        logger = get_logger()
+        logger.addHandler(handler)
+        try:
+            out = mgr.restore()
+        finally:
+            logger.removeHandler(handler)
+        np.testing.assert_allclose(np.asarray(out["params"]["b"]),
+                                   np.ones(4))
+        stale = [m for m in messages if "STALE" in m]
+        assert stale and "[2]" in stale[0], messages
+
+
+def test_restore_reraises_systematic_failure(tmp_path, mesh8):
+    """Every step failing IDENTICALLY is not per-file corruption but a
+    systematic error (e.g. a ``like`` structure/sharding mismatch after a
+    config change): the original error must surface — not be buried under
+    FileNotFoundError, and never silently satisfied by a stale step."""
+    state = _sharded_state(mesh8)
+    with CheckpointManager(str(tmp_path / "c")) as mgr:
+        mgr.save(1, state)
+        mgr.save(2, state)
+        mgr.wait_until_finished()
+
+        def mismatch(s, args=None):
+            raise ValueError(
+                "user-provided restore item and on-disk value differ")
+
+        mgr._mgr.restore = mismatch
+        with pytest.raises(ValueError, match="differ"):
+            mgr.restore()
+
+
 def test_restore_onto_different_sharding(tmp_path, mesh8):
     """Resume onto a different layout — the elastic-reshard property."""
     state = _sharded_state(mesh8)

@@ -86,6 +86,49 @@ def test_fresh_state_does_not_clobber_persisted_commit(tmp_path):
     assert s2.load_latest() and s2.steps == 9
 
 
+def test_load_latest_falls_back_on_corrupt_commit(tmp_path):
+    """Corruption containment (docs/failure_model.md): a truncated newest
+    commit — injected via the fault harness's ``corrupt`` kind, the same
+    path chaos runs use — must not lose the restore point; load_latest
+    adopts the previous committed generation."""
+    from horovod_tpu.testing.faults import FaultHarness, FaultSpec
+    d = str(tmp_path / "commits")
+    s = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.ones(3))
+    s.steps = 4
+    s.commit()                      # seq 1 — rotates to state.prev.pkl
+    s.steps = 8
+    s.w = s.w * 2.0
+    s.commit()                      # seq 2 — state.latest.pkl
+    spec = FaultSpec.parse(f"corrupt:rank=0,step=2,path={d}")
+    h = FaultHarness(spec, marker_dir=str(tmp_path / "markers"))
+    h.on_step(2, rank=0)            # truncates the newest commit file
+    s2 = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.zeros(3))
+    assert s2.load_latest()
+    assert s2.steps == 4 and s2._commit_seq == 1
+    np.testing.assert_allclose(np.asarray(s2.w), np.ones(3))
+
+
+def test_commit_checksum_detects_bitflip(tmp_path):
+    """A bit-flip that keeps the file length (so the trailer magic
+    survives) must fail the blake2b check and fall back — truncation is
+    covered by the corrupt-fault test above."""
+    from horovod_tpu.elastic import state as state_mod
+    d = str(tmp_path / "commits")
+    s = elastic.ObjectState(commit_dir=d, steps=0)
+    s.steps = 4
+    s.commit()
+    s.steps = 8
+    s.commit()
+    latest = os.path.join(d, "state.latest.pkl")
+    with open(latest, "r+b") as fh:
+        blob = fh.read()
+        fh.seek(len(blob) // 2)
+        fh.write(bytes([blob[len(blob) // 2] ^ 0xFF]))
+    assert state_mod._load_verified(latest) is None
+    s2 = elastic.ObjectState(commit_dir=d, steps=0)
+    assert s2.load_latest() and s2.steps == 4
+
+
 def test_sync_single_process_identity():
     s = elastic.ObjectState(x=1)
     s.x = 2
@@ -284,9 +327,22 @@ def test_coordinator_service_versioning_and_hmac():
         assert v == 1
         client = CoordinatorClient(f"127.0.0.1:{svc.port}", key)
         world = client.get_world()
-        assert world == {"version": 1, "hosts": {"a": 4}, "np": 4}
+        assert world == {"version": 1, "hosts": {"a": 4}, "np": 4,
+                         "failures": [], "failure_seq": 0}
         assert client.register(0)
         assert 0 in svc.registered_workers()
+        # Peer-liveness push (r6): failures accumulate with a monotonic
+        # seq; a new generation (update_world) clears the list but never
+        # rewinds the seq, so watchers can't mistake an old failure for a
+        # new one.
+        seq = svc.mark_failure("a", 137)
+        assert seq == 1
+        world = client.get_world()
+        assert world["failures"] == [{"host": "a", "code": 137}]
+        assert world["failure_seq"] == 1
+        svc.update_world({"b": 4}, 4)
+        world = client.get_world()
+        assert world["failures"] == [] and world["failure_seq"] == 1
         # Wrong key -> signature check fails -> treated as unreachable.
         bad = CoordinatorClient(f"127.0.0.1:{svc.port}",
                                 _secret.make_secret_key())

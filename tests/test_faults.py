@@ -1,0 +1,153 @@
+"""Unit tests for the deterministic fault-injection harness
+(horovod_tpu/testing/faults.py). Process-killing faults are exercised
+cross-process in tests/test_integration_run.py; here we cover the
+schedule grammar, one-shot markers, and the in-process fault kinds."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.testing.faults import (FAULT_SPEC_ENV, FaultHarness,
+                                        FaultSpec, fault_harness,
+                                        maybe_poison, will_fire)
+
+
+def _harness(spec: str, tmp_path) -> FaultHarness:
+    return FaultHarness(FaultSpec.parse(spec), marker_dir=str(tmp_path))
+
+
+# -- grammar ----------------------------------------------------------------
+
+def test_parse_full_grammar():
+    spec = FaultSpec.parse(
+        "kill:rank=1,step=3,signal=SIGTERM;"
+        "hang:rank=0,step=2,seconds=0.5;"
+        "delay:rank=0,round=4,seconds=2.5;"
+        "drop:round=7;"
+        "corrupt:rank=0,step=4,path=/tmp/x,bytes=8;"
+        "nan:step=5,value=inf")
+    kinds = [f.kind for f in spec.faults]
+    assert kinds == ["kill", "hang", "delay", "drop", "corrupt", "nan"]
+    kill = spec.faults[0]
+    assert (kill.rank, kill.step, kill.params["signal"]) == (1, 3, "SIGTERM")
+    assert spec.faults[2].round == 4
+    assert spec.faults[3].rank is None          # all ranks
+    assert spec.faults[4].params["path"] == "/tmp/x"
+    assert spec.faults[5].params["value"] == "inf"
+
+
+def test_parse_step_alias_for_round_axis():
+    # delay/drop schedule on engine rounds; step= is accepted as an alias.
+    spec = FaultSpec.parse("delay:rank=0,step=4,seconds=1")
+    assert spec.faults[0].round == 4 and spec.faults[0].step is None
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:step=1",          # unknown kind
+    "kill:rank=1",             # kill without a schedule
+    "delay:seconds=1",         # delay without round
+    "corrupt:step=1",          # corrupt without path
+    "kill:step",               # malformed key=value
+])
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        FaultSpec.parse(bad)
+
+
+def test_env_harness_is_cached_and_gated(monkeypatch):
+    monkeypatch.delenv(FAULT_SPEC_ENV, raising=False)
+    assert fault_harness() is None
+    assert maybe_poison({"a": 1}) == {"a": 1}
+    assert not will_fire("kill", 3)
+
+
+# -- scheduling & one-shot markers ------------------------------------------
+
+def test_fault_fires_once_per_schedule(tmp_path):
+    h = _harness("hang:rank=0,step=3,seconds=0.05", tmp_path)
+    assert h.will_fire("hang", 0, 3)
+    assert not h.will_fire("hang", 1, 3)    # wrong rank
+    assert not h.will_fire("hang", 0, 2)    # wrong step
+    t0 = time.monotonic()
+    h.on_step(3, rank=0)
+    assert time.monotonic() - t0 >= 0.05
+    # one-shot: a relaunched worker replaying step 3 must not re-fire
+    assert not h.will_fire("hang", 0, 3)
+    t0 = time.monotonic()
+    h.on_step(3, rank=0)
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_markers_survive_harness_rebuild(tmp_path):
+    """The marker dir is the cross-process memory: a NEW harness (a
+    relaunched worker) sees the predecessor's firings."""
+    h1 = _harness("hang:rank=1,step=3,seconds=0.05", tmp_path)
+    h1.on_step(3, rank=1)
+    h2 = _harness("hang:rank=1,step=3,seconds=0.05", tmp_path)
+    assert not h2.will_fire("hang", 1, 3)
+
+
+# -- in-process kinds -------------------------------------------------------
+
+def test_nan_poison_arms_and_disarms(tmp_path):
+    import jax.numpy as jnp
+    h = _harness("nan:rank=0,step=5", tmp_path)
+    grads = {"w": jnp.ones((2, 2)), "b": jnp.zeros((3,))}
+    assert h.maybe_poison(grads) is grads      # not armed yet
+    h.on_step(5, rank=0)
+    poisoned = h.maybe_poison(grads)
+    for leaf in (poisoned["w"], poisoned["b"]):
+        assert np.all(np.isnan(np.asarray(leaf)))
+    # disarmed after one use, and one-shot across steps
+    assert h.maybe_poison(grads) is grads
+    h.on_step(5, rank=0)
+    assert h.maybe_poison(grads) is grads
+
+
+def test_inf_poison_value(tmp_path):
+    import jax.numpy as jnp
+    h = _harness("nan:step=2,value=inf", tmp_path)
+    h.on_step(2, rank=0)                        # rank=None matches any
+    out = h.maybe_poison({"w": jnp.ones((2,))})
+    assert np.all(np.isinf(np.asarray(out["w"])))
+
+
+def test_corrupt_truncates_newest_file(tmp_path):
+    target = tmp_path / "commits"
+    target.mkdir()
+    old = target / "state.old.pkl"
+    old.write_bytes(b"x" * 100)
+    os.utime(old, (time.time() - 100, time.time() - 100))
+    new = target / "state.latest.pkl"
+    new.write_bytes(b"y" * 100)
+    h = _harness(f"corrupt:rank=0,step=4,path={target},bytes=8",
+                 tmp_path / "markers")
+    h.on_step(4, rank=0)
+    assert new.stat().st_size == 8              # newest truncated
+    assert old.stat().st_size == 100            # older commit untouched
+
+
+def test_delay_and_drop_on_engine_round_axis(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    h = _harness("delay:rank=0,round=1,seconds=0.1", tmp_path)
+    t0 = time.monotonic()
+    h.before_engine_round("round0")
+    assert time.monotonic() - t0 < 0.1
+    t0 = time.monotonic()
+    h.before_engine_round("round1")
+    assert time.monotonic() - t0 >= 0.1
+    # drop blocks forever — prove it from a side thread with a timeout
+    h2 = _harness("drop:rank=0,round=0", tmp_path / "m2")
+    done = threading.Event()
+
+    def call():
+        h2.before_engine_round("r")
+        done.set()
+
+    threading.Thread(target=call, daemon=True).start()
+    assert not done.wait(0.4)

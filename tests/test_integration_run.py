@@ -382,12 +382,12 @@ def test_watchdog_unblocks_survivor_of_silent_peer(tmp_path):
 CHAOS_WORKER = """
 import json
 import os
-import signal
 from horovod_tpu.platform import honor_jax_platforms_env
 honor_jax_platforms_env()
 import horovod_tpu as hvd
 from horovod_tpu import elastic
 from horovod_tpu.optimizer import allgather_object
+from horovod_tpu.testing import faults
 
 hvd.init()
 state = elastic.ObjectState(step=0, total=0.0)
@@ -396,13 +396,12 @@ state = elastic.ObjectState(step=0, total=0.0)
 def train(state):
     while state.step < 8:
         vals = allgather_object(float(state.step))
-        if (hvd.size() == 2 and hvd.rank() == 1 and state.step == 3
-                and not os.path.exists(os.environ["CHAOS_MARKER"])):
-            with open(os.environ["CHAOS_MARKER"], "w") as f:
-                f.write("killed")
+        if faults.will_fire("kill", state.step, rank=hvd.rank()):
+            # Stage the membership change the kill implies BEFORE dying,
+            # exactly like a real host loss: discovery stops reporting it.
             with open(os.environ["CHAOS_HOSTS_FILE"], "w") as f:
                 f.write("localhost:1\\n")
-            os.kill(os.getpid(), signal.SIGKILL)   # dies MID-step
+        faults.on_step(state.step, rank=hvd.rank())   # dies MID-step
         state.total += float(sum(vals))
         state.step += 1
         state.commit()
@@ -433,9 +432,11 @@ def test_elastic_sigkill_mid_collective_shrinks_and_resumes(tmp_path):
     script.write_text(CHAOS_WORKER)
     r = _run_hvdrun(["-np", "2", "--min-np", "1", "--max-np", "2",
                      "--host-discovery-script", str(disco),
+                     "--fault-spec", "kill:rank=1,step=3",
                      sys.executable, str(script)], timeout=300,
-                    env_extra={"CHAOS_MARKER": str(tmp_path / "killed"),
-                               "CHAOS_HOSTS_FILE": str(hosts_file),
+                    env_extra={"CHAOS_HOSTS_FILE": str(hosts_file),
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
                                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "8",
                                "HOROVOD_LOG_LEVEL": "INFO"})
     assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
@@ -449,6 +450,160 @@ def test_elastic_sigkill_mid_collective_shrinks_and_resumes(tmp_path):
     combined = r.stdout + r.stderr
     assert "(np=2)" in combined      # generation 0 launched at 2
     assert "(np=1)" in combined      # retired and relaunched shrunk
+
+
+JIT_CHAOS_WORKER = """
+import json
+import os
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.core.watchdog import monitored_step
+from horovod_tpu.testing import faults
+from jax.sharding import PartitionSpec as P
+from jax.experimental import multihost_utils
+try:
+    from jax import shard_map
+    _kw = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+    _kw = {"check_rep": False}
+
+hvd.init()
+mesh = hvd.mesh()
+f = jax.jit(shard_map(lambda x: hvd.allreduce(x, hvd.Sum), mesh=mesh,
+                      in_specs=P(hvd.RANK_AXIS), out_specs=P(), **_kw))
+
+def psum_step(v):
+    # The IN-GRAPH data plane: each rank contributes v, the jitted
+    # collective sums across the process mesh. Against a dead/hung peer
+    # this blocks INSIDE the XLA runtime — no Python frame, no signal
+    # handler, nothing the engine stall watchdog can see.
+    x = np.full((hvd.size(), 1), v, np.float32)
+    gx = multihost_utils.host_local_array_to_global_array(
+        x[hvd.rank():hvd.rank() + 1], mesh, P(hvd.RANK_AXIS))
+    return float(np.asarray(multihost_utils.global_array_to_host_local_array(
+        f(gx), mesh, P()))[0])
+
+mstep = monitored_step(psum_step, what="chaos_jit_step")
+state = elastic.ObjectState(step=0, total=0.0)
+
+@elastic.run
+def train(state):
+    # Compile OUTSIDE any deadline: a legitimate first step includes XLA
+    # compilation, which must never count against the step timeout.
+    psum_step(0.0)
+    while state.step < 6:
+        if faults.will_fire("kill", state.step, rank=hvd.rank()):
+            # A killed host also vanishes from discovery, like real life.
+            hosts_file = os.environ.get("CHAOS_HOSTS_FILE")
+            if hosts_file:
+                with open(hosts_file, "w") as fh:
+                    fh.write("localhost:1\\n")
+        faults.on_step(state.step, rank=hvd.rank())
+        state.total += mstep(float(state.step))
+        state.step += 1
+        state.commit()
+    return state.step
+
+train(state)
+print(json.dumps({"final_step": state.step, "size": hvd.size(),
+                  "total": state.total}), flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_fate_sharing_rescues_jit_blocked_survivor(tmp_path):
+    """The STALL=0 rescue (docs/failure_model.md): 2 real workers in a
+    JITTED shard_map collective loop with the engine stall watchdog
+    explicitly DISABLED. Rank 1 is SIGKILLed by the fault harness at step
+    3; rank 0 is blocked inside the compiled collective where no Python
+    exception can reach it. The driver learns of the death first
+    (fate-sharing), publishes it on /world (peer-liveness push) and
+    SIGTERM→SIGKILLs the wedged survivor; whichever rescue lands first
+    retires the generation, and the relaunched np=1 world resumes from the
+    last commit — within a bounded, asserted wall time."""
+    import time
+    hosts_file = tmp_path / "jit_chaos_hosts"
+    hosts_file.write_text("localhost:1\n127.0.0.2:1\n")
+    disco = tmp_path / "discover.sh"
+    disco.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disco.chmod(0o755)
+    script = tmp_path / "jit_chaos_worker.py"
+    script.write_text(JIT_CHAOS_WORKER)
+    t0 = time.monotonic()
+    r = _run_hvdrun(["-np", "2", "--min-np", "1", "--max-np", "2",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "kill:rank=1,step=3",
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"CHAOS_HOSTS_FILE": str(hosts_file),
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "0",
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    combined = r.stdout + r.stderr
+    # STALL=0 really was in force (driver logs the armed window per
+    # generation) — the r5 engine watchdog could NOT have done this rescue.
+    assert "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=0" in combined, combined
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert lines, r.stdout
+    # gen 0 commits steps 0-2 at np=2 (total 0+2+4=6); gen 1 resumes at
+    # step 3 with np=1: 6+3+4+5 = 18. Only reachable via load_latest.
+    assert lines[-1] == {"final_step": 6, "size": 1, "total": 18.0}, lines
+    assert "(np=2)" in combined and "(np=1)" in combined
+    # Bounded: one rescue (seconds) + two generations of tiny steps. The
+    # spec's own number: far under the 300s harness timeout, and far under
+    # the 600s default stall window the test turned off.
+    assert elapsed < 240, f"rescue not bounded: {elapsed:.0f}s"
+
+
+@pytest.mark.integration
+def test_step_monitor_rescues_hung_jit_peer(tmp_path):
+    """The jit-step deadline monitor end to end: rank 1 HANGS (fault
+    harness ``hang`` — alive but never participating, so the driver's
+    fate-sharing sees nothing and there is no death to publish) while rank
+    0 blocks inside the jitted collective. With STALL=0 the only rescue is
+    ``HOROVOD_STEP_TIMEOUT_SECONDS``: rank 0's monitor abandons the step,
+    exits RESTART, the driver tears down the hung peer and relaunches at
+    np=2, and the job resumes from the last commit."""
+    import time
+    disco = tmp_path / "discover.sh"
+    disco.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.2:1\n")
+    disco.chmod(0o755)
+    script = tmp_path / "hang_chaos_worker.py"
+    script.write_text(JIT_CHAOS_WORKER)
+    t0 = time.monotonic()
+    r = _run_hvdrun(["-np", "2", "--min-np", "2", "--max-np", "2",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "hang:rank=1,step=3",
+                     "--step-timeout-seconds", "8",
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "0",
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    combined = r.stdout + r.stderr
+    assert "monitored step abandoned" in combined, combined
+    assert "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS=0" in combined, combined
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    # Both ranks of the FINAL generation reach the print. gen 0 commits
+    # steps 0-2 at np=2 (0+2+4=6); gen 1 replays nothing (fault marker is
+    # one-shot) and finishes steps 3-5 at np=2: 6+6+8+10 = 30.
+    assert len(lines) == 2, (lines, r.stdout)
+    for out in lines:
+        assert out == {"final_step": 6, "size": 2, "total": 30.0}, lines
+    # two generations, both at np=2
+    assert combined.count("(np=2)") >= 2, combined
+    assert elapsed < 240, f"rescue not bounded: {elapsed:.0f}s"
 
 
 GROW_WORKER = """

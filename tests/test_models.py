@@ -120,6 +120,26 @@ def test_llama_scan_remat_variant():
     assert w1.shape[0] == cfg.n_layers
 
 
+def test_scan_layers_auto_resolution():
+    """``scan_layers="auto"`` (the r6 default) unrolls small models and
+    scans deep ones; explicit True/False always wins. The choice is
+    checkpoint-visible (scan stacks params under "layers"), so the
+    threshold is a module constant, not a heuristic."""
+    import dataclasses
+    from horovod_tpu.models.llama import (SCAN_LAYERS_AUTO_THRESHOLD,
+                                          resolve_scan_layers)
+    auto = dataclasses.replace(llama_tiny(), scan_layers="auto")
+    assert not resolve_scan_layers(auto)          # 2 layers -> unrolled
+    deep = dataclasses.replace(auto, n_layers=SCAN_LAYERS_AUTO_THRESHOLD + 1)
+    assert resolve_scan_layers(deep)
+    at = dataclasses.replace(auto, n_layers=SCAN_LAYERS_AUTO_THRESHOLD)
+    assert not resolve_scan_layers(at)            # boundary stays unrolled
+    assert resolve_scan_layers(
+        dataclasses.replace(auto, scan_layers=True))
+    assert not resolve_scan_layers(
+        dataclasses.replace(deep, scan_layers=False))
+
+
 def test_llama_remat_policies_match_full():
     """The named-save policies (r4: "attn"/"dots_attn" keep the flash
     kernel's (o, m, l) residuals so the backward skips the fwd-kernel

@@ -6,8 +6,17 @@ import json
 import os
 import subprocess
 import sys
+import warnings
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Hard rails: a reading outside these is a regression no noise explains.
+HARD_LO, HARD_HI = 0.65, 1.6
+# Nominal band: r2-r5 readings sat ~0.95-1.05 with per-run round spreads
+# up to ~0.1 on the shared-core mesh. Inside the rails but outside nominal
+# -> WARN (movement attributable to stated noise, tracked via the recorded
+# per-arm noise band in scaling_history.jsonl), not a test failure.
+NOMINAL_LO, NOMINAL_HI = 0.85, 1.2
 
 
 def test_scaling_guardrail_emits_sane_efficiency():
@@ -31,8 +40,23 @@ def test_scaling_guardrail_emits_sane_efficiency():
             recs[rec["metric"]] = rec
     assert "dp8_virtual_scaling_efficiency" in recs
     assert "dp8_hierarchical_scaling_efficiency" in recs
-    # Ideal is 1.0 on the shared-core CPU mesh; fail loudly if the
+    # Ideal is 1.0 on the shared-core CPU mesh; fail loudly only if the
     # distributed machinery ever costs >35% of compute at this tiny size
-    # (r2 measured ~1.01 flat, hierarchical similar).
+    # (r2 measured ~1.01 flat, hierarchical similar). Inside the rails
+    # but outside the nominal band -> warn: single-run movement there is
+    # within the stated noise (see the recorded per-arm "noise" field).
     for rec in recs.values():
-        assert 0.65 <= rec["value"] <= 1.6, rec
+        assert HARD_LO <= rec["value"] <= HARD_HI, rec
+        noise = rec.get("noise") or {}
+        assert noise.get("rounds", 0) >= 3, \
+            f"noise band must state its repeats: {rec}"
+        for k in ("ratio_min", "ratio_max", "spread"):
+            assert k in noise, f"noise band incomplete: {rec}"
+        if not (NOMINAL_LO <= rec["value"] <= NOMINAL_HI):
+            warnings.warn(
+                f"{rec['metric']}={rec['value']} outside nominal "
+                f"[{NOMINAL_LO}, {NOMINAL_HI}] but inside hard rails "
+                f"[{HARD_LO}, {HARD_HI}]; round spread "
+                f"{noise.get('spread')} over {noise.get('rounds')} rounds "
+                "— investigate if it persists round-over-round "
+                "(benchmarks/scaling_history.jsonl)")

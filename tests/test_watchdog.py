@@ -1,0 +1,332 @@
+"""Unit tests for the jit-step deadline monitor (core/watchdog.py) and the
+peer-liveness push plumbing (elastic/service.py failure feed).
+
+All deadline scenarios here are DETERMINISTIC in outcome: the blocked
+"step" is an event-wait that can never complete, so the deadlines are the
+only exit path — wall-clock bounds only how fast the rescue lands (each
+asserted to stay well under the test timeout). The cross-process versions
+live in tests/test_integration_run.py.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.core.watchdog import (ACTION_ENV, COMPILE_MULT_ENV,
+                                       PEER_GRACE_ENV, STEP_TIMEOUT_ENV,
+                                       StepMonitor, monitored_step)
+
+
+def _clear_env(monkeypatch):
+    for var in (STEP_TIMEOUT_ENV, PEER_GRACE_ENV, ACTION_ENV,
+                COMPILE_MULT_ENV, "HOROVOD_ELASTIC_COORD_ADDR"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_unarmed_is_direct_call(monkeypatch):
+    _clear_env(monkeypatch)
+    m = StepMonitor()
+    assert not m.armed()
+    out = m.monitored_call(lambda: 41 + 1, what="t")
+    assert out == 42
+    hb = m.heartbeat()
+    assert hb["steps_completed"] == 1
+    assert not hb["in_flight"]
+
+
+def test_step_timeout_rescues_blocked_step(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "0.6")
+    monkeypatch.setenv(COMPILE_MULT_ENV, "1")   # steady-state deadline
+    m = StepMonitor()
+    assert m.armed()
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="STEP_TIMEOUT"):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert time.monotonic() - t0 < 10.0
+    hb = m.heartbeat()
+    assert not hb["in_flight"]
+
+
+def test_monitor_recovers_after_expiry(monkeypatch):
+    """In-process elastic recovery keeps training in THIS process after a
+    deadline expiry: the wedged fetch thread must be orphaned, not block
+    the next monitored step."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "0.5")
+    monkeypatch.setenv(COMPILE_MULT_ENV, "1")
+    m = StepMonitor()
+    with pytest.raises(HorovodInternalError):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert m.monitored_call(lambda: "ok", what="t") == "ok"
+    assert m.heartbeat()["steps_completed"] == 1
+
+
+def test_expiry_marks_registered_engines_transport_lost(monkeypatch):
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "0.5")
+    monkeypatch.setenv(COMPILE_MULT_ENV, "1")
+
+    class FakeEngine:
+        _transport_lost = None
+
+    eng = FakeEngine()
+    m = StepMonitor()
+    m.register_engine(eng)
+    with pytest.raises(HorovodInternalError):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert eng._transport_lost is not None
+    assert "abandoned" in eng._transport_lost
+
+
+def test_peer_failure_arms_grace_deadline(monkeypatch):
+    """A peer-death notification rescues a blocked step with NO step
+    timeout configured — the STALL=0 'blocked forever' scenario."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(PEER_GRACE_ENV, "0.4")
+    m = StepMonitor()
+    # Deterministic ordering: the failure is known BEFORE the step blocks.
+    m.notify_peer_failure("hostX(exit 137)")
+    # peer deadline applies even though no coordinator is configured —
+    # notify_peer_failure is the push's landing point either way.
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="peer died"):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_peer_push_rescues_blocked_step(monkeypatch):
+    """End-to-end push through the real CoordinatorService: driver marks a
+    failure on /world, the monitor's watcher polls it up and abandons the
+    in-flight step within poll interval + grace."""
+    from horovod_tpu.elastic import constants as C
+    from horovod_tpu.elastic.service import CoordinatorService
+    from horovod_tpu.runner import secret as _secret
+
+    _clear_env(monkeypatch)
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        monkeypatch.setenv(C.COORD_ADDR_ENV, svc.addr("127.0.0.1"))
+        monkeypatch.setenv(_secret.ENV_VAR, _secret.encode(key))
+        monkeypatch.setenv(C.POLL_INTERVAL_ENV, "0.1")
+        monkeypatch.setenv(PEER_GRACE_ENV, "0.3")
+        svc.update_world({"localhost": 2}, 2)
+        m = StepMonitor()
+        assert m.peer_watch_available() and m.armed()
+        started = threading.Event()
+
+        def blocked_step():
+            started.set()
+            threading.Event().wait()
+
+        # The driver-side event: a worker process exited non-zero.
+        svc.mark_failure("localhost", 137)
+        t0 = time.monotonic()
+        with pytest.raises(HorovodInternalError, match="peer died"):
+            m.monitored_call(blocked_step, what="t")
+        assert started.is_set()
+        assert time.monotonic() - t0 < 15.0
+    finally:
+        svc.close()
+
+
+def test_relaunched_survivor_ignores_stale_failure_seq(monkeypatch):
+    """The coordinator's failure_seq is monotonic across generations; its
+    failure LIST is generation-scoped. A relaunched survivor whose first
+    poll sees a nonzero seq with an EMPTY list (its predecessor's death,
+    already handled by the relaunch that created it) must NOT arm the
+    grace deadline — arming it would abandon every step longer than the
+    poll tick and restart-loop the job."""
+    from horovod_tpu.elastic import constants as C
+    from horovod_tpu.elastic.service import CoordinatorService
+    from horovod_tpu.runner import secret as _secret
+
+    _clear_env(monkeypatch)
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        monkeypatch.setenv(C.COORD_ADDR_ENV, svc.addr("127.0.0.1"))
+        monkeypatch.setenv(_secret.ENV_VAR, _secret.encode(key))
+        monkeypatch.setenv(C.POLL_INTERVAL_ENV, "0.05")
+        monkeypatch.setenv(PEER_GRACE_ENV, "0.15")
+        # Generation 0 died: a failure was recorded, then the driver
+        # published the relaunched generation's world (clearing the list).
+        svc.update_world({"a": 1, "b": 1}, 2)
+        svc.mark_failure("b", 137)
+        svc.update_world({"a": 1, "c": 1}, 2)
+        # This monitor plays the relaunched survivor: a step far longer
+        # than poll+grace must complete untouched.
+        m = StepMonitor()
+        assert m.peer_watch_available()
+        out = m.monitored_call(lambda: time.sleep(1.0) or "ok", what="t")
+        assert out == "ok"
+        assert m.heartbeat()["peer_failure"] is None
+    finally:
+        svc.close()
+
+
+def test_reset_for_recovery_clears_stale_peer_failure(monkeypatch):
+    """In-process elastic recovery must disarm the old world's
+    peer-failure flag: its grace deadline is long expired, so left set it
+    would instantly abandon every step of the recovered run."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(PEER_GRACE_ENV, "0.1")
+    m = StepMonitor()
+    m.notify_peer_failure("hostX(exit 137)")
+    time.sleep(0.2)   # grace long expired
+    assert m.armed()
+    m.reset_for_recovery()
+    assert m.heartbeat()["peer_failure"] is None
+    assert not m.armed()
+    assert m.monitored_call(lambda: "ok", what="t") == "ok"
+
+
+def test_reinitialize_resets_step_monitor(monkeypatch):
+    """The product wiring for the above: elastic run_fn's in-process
+    re-init path resets the process-wide monitor."""
+    import horovod_tpu as hvd
+    from horovod_tpu.core import watchdog
+    from horovod_tpu.elastic import run_fn
+
+    _clear_env(monkeypatch)
+    monkeypatch.setattr(hvd, "shutdown", lambda: None)
+    monkeypatch.setattr(hvd, "init", lambda: None)
+    m = watchdog.monitor()
+    m.notify_peer_failure("hostX(exit 137)")
+    try:
+        run_fn._reinitialize()
+        assert m.heartbeat()["peer_failure"] is None
+    finally:
+        m.reset_for_recovery()   # leave the global monitor clean
+
+
+def test_late_completing_step_orphans_old_fetch_thread(monkeypatch):
+    """A SPURIOUS expiry (the step completes after the deadline fired)
+    must retire the old fetch thread: it may neither crash on the cleared
+    queue nor keep consuming the replacement queue's items."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "0.4")
+    monkeypatch.setenv(COMPILE_MULT_ENV, "1")
+    m = StepMonitor()
+    release = threading.Event()
+    before = set(threading.enumerate())   # other tests' wedged workers
+    with pytest.raises(HorovodInternalError):
+        m.monitored_call(lambda: release.wait(), what="t")
+    old = [t for t in threading.enumerate()
+           if t.name == "hvd-step-fetch" and t not in before]
+    assert old
+    release.set()   # the "wedged" step now completes late
+    for t in old:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in old)
+    # The replacement worker owns the new queue alone.
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "30")
+    for i in range(3):
+        assert m.monitored_call(lambda i=i: i, what="t") == i
+
+
+def test_first_call_per_signature_gets_compile_allowance(monkeypatch):
+    """The first monitored call of a signature includes XLA compilation:
+    it gets STEP_TIMEOUT x COMPILE_MULTIPLIER, so a steady-state-tuned
+    deadline does not abandon the compile step. Later calls run under the
+    raw deadline."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "0.5")
+    monkeypatch.setenv(COMPILE_MULT_ENV, "10")
+    m = StepMonitor()
+    # 1.2s "compile" step: over the raw 0.5s deadline, well under the 5s
+    # first-call allowance.
+    assert m.monitored_call(lambda: time.sleep(1.2) or "ok",
+                            what="t") == "ok"
+    # Steady state: the raw deadline applies again.
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="STEP_TIMEOUT"):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert time.monotonic() - t0 < 4.0
+    # reset_for_recovery re-grants the allowance (post-resize recompile).
+    m.reset_for_recovery()
+    assert m.monitored_call(lambda: time.sleep(1.2) or "ok",
+                            what="t") == "ok"
+
+
+def test_update_world_clears_failures():
+    """Failures are scoped to one generation: publishing the next world
+    view must clear them, or a relaunched survivor would immediately
+    re-arm on its predecessor's death."""
+    from horovod_tpu.elastic.service import CoordinatorService
+    from horovod_tpu.runner import secret as _secret
+
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"a": 1, "b": 1}, 2)
+        svc.mark_failure("b", 137)
+        from horovod_tpu.elastic.service import CoordinatorClient
+        client = CoordinatorClient(svc.addr("127.0.0.1"), key)
+        world = client.get_world()
+        assert world["failure_seq"] == 1
+        assert world["failures"] == [{"host": "b", "code": 137}]
+        svc.update_world({"a": 1}, 1)
+        world = client.get_world()
+        assert world["failure_seq"] == 1   # monotonic across generations
+        assert world["failures"] == []
+    finally:
+        svc.close()
+
+
+def test_runtime_error_translates_to_internal_error(monkeypatch):
+    """A dead peer that ERRORS the collective (gloo connection reset /
+    XlaRuntimeError) instead of hanging must reach @elastic.run as
+    HorovodInternalError."""
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(STEP_TIMEOUT_ENV, "30")   # armed, far from expiry
+
+    class XlaRuntimeError(Exception):   # matched by name, like jaxlib's
+        pass
+
+    def exploding_step():
+        raise XlaRuntimeError("connection reset by peer")
+
+    m = StepMonitor()
+    with pytest.raises(HorovodInternalError, match="runtime error"):
+        m.monitored_call(exploding_step, what="t")
+    # Non-runtime errors pass through untranslated (user bugs must not be
+    # retried by the elastic loop).
+    with pytest.raises(ValueError):
+        m.monitored_call(lambda: (_ for _ in ()).throw(ValueError("x")),
+                         what="t")
+
+
+def test_monitored_step_preserves_attrs_and_results(monkeypatch):
+    _clear_env(monkeypatch)
+
+    def fn(a, b):
+        return a + b
+    fn.lower = lambda *a: "lowered"
+    wrapped = monitored_step(fn, what="t")
+    assert wrapped(2, 3) == 5
+    assert wrapped.lower() == "lowered"
+
+
+def test_exit_action_hard_exits_with_restart_code(monkeypatch):
+    """HOROVOD_STEP_TIMEOUT_ACTION=exit: the process dies with
+    RESTART_EXIT_CODE so the driver's fate-sharing takes over. Run in a
+    subprocess — os._exit is not mockable meaningfully."""
+    code = (
+        "import os\n"
+        f"os.environ['{ACTION_ENV}'] = 'exit'\n"
+        "from horovod_tpu.core.watchdog import StepMonitor\n"
+        "StepMonitor()._fail('test deadline')\n"
+        "raise SystemExit(99)  # unreachable\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    from horovod_tpu.elastic import constants as C
+    assert proc.returncode == C.RESTART_EXIT_CODE, proc.stderr.decode()
