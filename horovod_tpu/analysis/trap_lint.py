@@ -23,6 +23,13 @@ CLAUDE.md "Environment traps"):
   with ``deferred_pair(..., every=k)`` but passes ``slope_time_paired``
   window lengths that are not multiples of ``k`` — min-over-repeats then
   cherry-picks the cheap phase of the cadence.
+- ``lint-silent-rpc`` (WARNING): an RPC client ``try`` block (one that
+  calls ``urlopen``) whose ``except OSError``-family handler is nothing
+  but ``return None``/``return False`` — the swallow pattern that made a
+  dead coordinator indistinguishable from "no change" and silently
+  disabled every rescue layer built on the control plane.  Retry/escalate
+  (elastic/service.py's retrying client), or mark a deliberate residual
+  with the pragma.
 
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
@@ -41,6 +48,13 @@ SUPPRESS_PRAGMA = "hvd-analyze: ok"
 SAFE_XLA_FLAGS = frozenset({"--xla_force_host_platform_device_count"})
 
 XLA_GUARD_ENV = "HOROVOD_FUSION_APPLY_XLA_FLAGS"
+
+# OSError-family exception names whose silent-return handlers around an
+# RPC call hide control-plane loss (lint-silent-rpc).
+RPC_SWALLOW_EXCEPTIONS = frozenset({
+    "OSError", "IOError", "ConnectionError", "TimeoutError",
+    "URLError", "HTTPError",
+})
 
 # Directory names never linted (fixture corpora are known-bad on purpose).
 EXCLUDED_DIR_NAMES = frozenset({
@@ -209,6 +223,42 @@ class _Lint(ast.NodeVisitor):
             if windows:
                 self.slope_windows.append((node, windows))
 
+        self.generic_visit(node)
+
+    def visit_Try(self, node):
+        # lint-silent-rpc: a try block that performs an RPC (urlopen)
+        # whose OSError-family handler just returns None/False — the
+        # "dead coordinator == no change" swallow pattern.
+        calls_rpc = any(
+            isinstance(sub, ast.Call)
+            and _dotted(sub.func).split(".")[-1] == "urlopen"
+            for stmt in node.body for sub in ast.walk(stmt))
+        if calls_rpc:
+            for handler in node.handlers:
+                names = []
+                if handler.type is not None:
+                    elts = (handler.type.elts
+                            if isinstance(handler.type, ast.Tuple)
+                            else [handler.type])
+                    names = [_dotted(e).split(".")[-1] for e in elts]
+                if not any(n in RPC_SWALLOW_EXCEPTIONS for n in names):
+                    continue
+                if len(handler.body) == 1 \
+                        and isinstance(handler.body[0], ast.Return):
+                    val = handler.body[0].value
+                    silent = val is None or (
+                        isinstance(val, ast.Constant)
+                        and val.value in (None, False))
+                    if silent:
+                        self._add(
+                            "lint-silent-rpc", Severity.WARNING, handler,
+                            f"except {'/'.join(names)}: return "
+                            "None/False swallows an RPC failure — a dead "
+                            "peer becomes indistinguishable from 'no "
+                            "change' and every layer built on this call "
+                            "is silently disabled; retry with backoff "
+                            "and escalate on persistent loss instead "
+                            "(see elastic/service.py CoordinatorClient)")
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):

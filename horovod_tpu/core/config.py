@@ -109,6 +109,11 @@ class Config:
     mismatch_check: bool = False
     # Elastic.
     elastic_timeout_sec: float = 600.0
+    # Control plane (elastic/service.py retrying client; the same envs are
+    # read there directly so workers without a Config object agree).
+    coordinator_rpc_retries: int = 3
+    coordinator_rpc_timeout_sec: float = 5.0
+    coordinator_lost_timeout_sec: float = 120.0
     # Log level handled by core/logging.py directly.
 
     @classmethod
@@ -145,6 +150,12 @@ class Config:
             adasum_accumulate_dtype=adasum_dtype,
             mismatch_check=_env_bool("HOROVOD_MISMATCH_CHECK", False),
             elastic_timeout_sec=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
+            coordinator_rpc_retries=_env_int(
+                "HOROVOD_COORDINATOR_RPC_RETRIES", 3),
+            coordinator_rpc_timeout_sec=_env_float(
+                "HOROVOD_COORDINATOR_RPC_TIMEOUT_SECONDS", 5.0),
+            coordinator_lost_timeout_sec=_env_float(
+                "HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS", 120.0),
         )
 
     def xla_combiner_flags(self) -> list[str]:

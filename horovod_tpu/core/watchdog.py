@@ -122,6 +122,12 @@ class StepMonitor:
         self._inflight_what: Optional[str] = None
         # Peer death: (monotonic time observed, description).
         self._peer_failure: Optional[tuple] = None
+        # Control-plane loss: the coordinator has been continuously
+        # unreachable past HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS
+        # (CoordinatorLostError from the retrying client). Unlike a peer
+        # failure there is no grace: by construction the loss window
+        # already elapsed before this is set.
+        self._control_plane_lost: Optional[str] = None
         # Last coordinator failure_seq observed. The seq is monotonic
         # across generations, so a relaunched survivor's first poll can
         # see a nonzero count inherited from its predecessors' deaths —
@@ -196,6 +202,7 @@ class StepMonitor:
                                       else 0.0),
                 "peer_failure": (self._peer_failure[1]
                                  if self._peer_failure else None),
+                "control_plane_lost": self._control_plane_lost,
             }
 
     # -- peer liveness ------------------------------------------------------
@@ -210,6 +217,23 @@ class StepMonitor:
             "peer failure notified: %s — arming %.1fs grace deadline on "
             "the in-flight step (%s)", info, self.peer_grace_s,
             PEER_GRACE_ENV)
+
+    def notify_control_plane_lost(self, info: str) -> None:
+        """Mark the control plane lost (called when the retrying client
+        raises CoordinatorLostError — the continuous-failure window has
+        already elapsed, so any in-flight step is abandoned on the next
+        deadline tick: with the driver gone, nobody will relaunch a
+        generation that wedges later, and nobody is publishing peer
+        deaths anymore — the push layer is blind)."""
+        first = False
+        with self._lock:
+            if self._control_plane_lost is None:
+                self._control_plane_lost = info
+                first = True
+        if first:
+            get_logger().error("control plane lost: %s — escalating "
+                               "instead of polling a dead coordinator "
+                               "forever", info)
 
     def clear_peer_failure(self) -> None:
         with self._lock:
@@ -226,6 +250,7 @@ class StepMonitor:
         fresh monitor.)"""
         with self._lock:
             self._peer_failure = None
+            self._control_plane_lost = None
             self._completed_by_what = {}
             # Re-resolve the coordinator on next use: the recovery may
             # have come with a new driver/address in the environment.
@@ -292,7 +317,14 @@ class StepMonitor:
             client = self._coordinator_client()
             if client is None:
                 continue
-            world = client.get_world()
+            from ..elastic.service import CoordinatorLostError
+            try:
+                world = client.get_world()
+            except CoordinatorLostError as e:
+                # Escalate via the deadline machinery: the in-flight
+                # step/round is abandoned on its next tick.
+                self.notify_control_plane_lost(str(e))
+                continue
             if not world:
                 continue
             seq = int(world.get("failure_seq", 0))
@@ -336,10 +368,15 @@ class StepMonitor:
                     f"{self.step_timeout_s:.0f}s{scaled}")
         with self._lock:
             pf = self._peer_failure
+            cpl = self._control_plane_lost
         if pf is not None and now - pf[0] >= self.peer_grace_s:
             return (f"peer died ({pf[1]}); in-flight collective cannot "
                     f"complete ({PEER_GRACE_ENV}={self.peer_grace_s:.0f}s "
                     "elapsed)")
+        if cpl is not None:
+            # No grace on top: the continuous-failure window already
+            # elapsed inside the client before this flag was set.
+            return f"control plane lost ({cpl})"
         return None
 
     def armed(self) -> bool:
@@ -347,6 +384,8 @@ class StepMonitor:
             return True
         with self._lock:
             if self._peer_failure is not None and self.peer_grace_s > 0:
+                return True
+            if self._control_plane_lost is not None:
                 return True
         return self.peer_watch_available()
 

@@ -40,6 +40,44 @@ DEFAULT_POLL_INTERVAL_S = 0.2
 #: discovers can miss a membership bump entirely on short generations.
 POLL_INTERVAL_ENV = "HOROVOD_ELASTIC_POLL_INTERVAL"
 
+#: env: path of the driver's coordinator *address file*. The driver writes
+#: the service's current host:port here and rewrites it after a
+#: crash-restart (the rebuilt service binds a fresh ephemeral port);
+#: workers re-read it when a connect fails so they follow the coordinator
+#: across restarts. Only usable where the file is visible (same host or a
+#: shared filesystem) — remote workers without one fall back to the
+#: launch-time COORD_ADDR_ENV address.
+COORD_ADDR_FILE_ENV = "HOROVOD_ELASTIC_COORD_ADDR_FILE"
+
+#: env: seconds of CONTINUOUS coordinator-RPC failure after which a worker
+#: escalates (log → mark control-plane-lost on the step monitor →
+#: HorovodInternalError/exit) instead of polling a dead driver forever.
+#: 0 disables escalation (the pre-hardening behavior: every failure is
+#: treated as "no change").
+COORD_LOST_TIMEOUT_ENV = "HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS"
+
+#: Default continuous-failure window before control-plane-lost escalation.
+#: Sized well above the retry envelope of a single call (attempts x
+#: backoff cap) and above any single driver crash-restart, but far below
+#: the stall-shutdown ceiling so a dead driver does not leave workers
+#: polling for the rest of the stall window.
+DEFAULT_COORD_LOST_TIMEOUT_S = 120.0
+
+#: env: RPC attempts per logical coordinator call (>=1; 1 = no retry).
+RPC_RETRIES_ENV = "HOROVOD_COORDINATOR_RPC_RETRIES"
+DEFAULT_RPC_RETRIES = 3
+
+#: env: per-attempt deadline of one coordinator HTTP request, seconds.
+RPC_TIMEOUT_ENV = "HOROVOD_COORDINATOR_RPC_TIMEOUT_SECONDS"
+DEFAULT_RPC_TIMEOUT_S = 5.0
+
+#: env: base (minimum) backoff sleep between RPC retries, seconds. The
+#: schedule is exponential with decorrelated jitter, capped at
+#: RPC_BACKOFF_CAP_S.
+RPC_BACKOFF_BASE_ENV = "HOROVOD_COORDINATOR_RPC_BACKOFF_BASE_SECONDS"
+DEFAULT_RPC_BACKOFF_BASE_S = 0.05
+DEFAULT_RPC_BACKOFF_CAP_S = 2.0
+
 #: driver: how many failures (within the cooldown window) blacklist a host.
 BLACKLIST_STRIKES = 2
 

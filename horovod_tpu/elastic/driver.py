@@ -91,7 +91,17 @@ class ElasticDriver:
                 {h.hostname: h.slots for h in settings.hosts})
         self._blacklist = Blacklist(cooldown_s=settings.blacklist_cooldown_s)
         self._key = _secret.make_secret_key()
-        self._service = CoordinatorService(self._key)
+        # Control-plane durability (docs/failure_model.md): the service
+        # journals every mutation so a crashed service is rebuilt with its
+        # monotonic counters intact, and the address file lets workers
+        # follow it to the rebuilt (fresh-port) instance.
+        self._coord_dir = tempfile.mkdtemp(prefix="hvd_coord_")
+        self._journal_path = os.path.join(self._coord_dir,
+                                          "coordinator.journal")
+        self._addr_file = os.path.join(self._coord_dir, "coordinator.addr")
+        self._service = CoordinatorService(self._key,
+                                           journal_path=self._journal_path)
+        self._service_lock = threading.Lock()
         self._resets = 0
 
     # -- membership ----------------------------------------------------------
@@ -131,6 +141,57 @@ class ElasticDriver:
         remotes = [h for h in hosts if not is_local(h)]
         return routable_local_addr(remotes[0]) if remotes else "127.0.0.1"
 
+    # -- coordinator-service durability --------------------------------------
+
+    def _publish_addr(self, hosts: Dict[str, int]) -> None:
+        """(Re)write the address file atomically — workers re-read it on
+        connect failure to follow the coordinator across restarts."""
+        addr = self._service.addr(self._advertise_host(hosts))
+        tmp = self._addr_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(addr + "\n")
+        os.replace(tmp, self._addr_file)
+
+    def _ensure_service(self, hosts: Dict[str, int]) -> bool:
+        """Detect a dead coordinator service and rebuild it from the
+        journal (version and failure_seq preserved — survivors' watchers
+        baseline those counters; see elastic/journal.py). Returns True
+        when a restart happened."""
+        with self._service_lock:
+            if self._service.alive():
+                return False
+            get_logger().error(
+                "coordinator service died — rebuilding from journal %s",
+                self._journal_path)
+            self._service = CoordinatorService(
+                self._key, journal_path=self._journal_path, restore=True)
+            self._publish_addr(hosts)
+            get_logger().info(
+                "coordinator service restarted on port %d (version=%d "
+                "failure_seq=%d); address republished to %s",
+                self._service.port, self._service.version,
+                self._service.failure_seq, self._addr_file)
+            return True
+
+    def _log_unregistered(self, assignments, version: int) -> None:
+        """Start-timeout observability: after the launch window, name the
+        workers that never registered with the coordinator — a registration
+        that silently never arrives otherwise looks identical to a worker
+        that launched fine (satellite of the control-plane hardening)."""
+        expected = {a.process_id: a.hostname for a in assignments}
+        registered = set(self._service.registered_workers())
+        missing = {pid: host for pid, host in expected.items()
+                   if pid not in registered}
+        if missing:
+            get_logger().warning(
+                "generation %d: %d/%d workers never registered with the "
+                "coordinator within the start timeout (%.0fs): %s — their "
+                "registration RPCs failed or the workers never came up",
+                version, len(missing), len(expected),
+                self._settings.start_timeout_s or 0,
+                ", ".join(f"pid {p} on {h}"
+                          for p, h in sorted(missing.items())))
+
     def _launch_generation(self, hosts: Dict[str, int], version: int,
                            commit_dir: str,
                            stop: threading.Event) -> Dict[str, int]:
@@ -148,9 +209,14 @@ class ElasticDriver:
         # fetches the results blob from there after the job succeeds.
         self.last_first_host = assignments[0].hostname
         coord = default_coordinator_addr(assignments, self._settings)
+        self._publish_addr(hosts)
         extra = {
             C.COORD_ADDR_ENV: self._service.addr(
                 self._advertise_host(hosts)),
+            # Crash-restarted coordinators serve on a fresh port; workers
+            # that can see this file (same host / shared fs) re-resolve on
+            # connect failure instead of retrying a dead address.
+            C.COORD_ADDR_FILE_ENV: self._addr_file,
             C.WORLD_VERSION_ENV: str(version),
             C.COMMIT_DIR_ENV: commit_dir,
             C.RESET_LIMIT_ENV: str(self._settings.reset_limit or 0),
@@ -186,6 +252,14 @@ class ElasticDriver:
                                    f"generation.{version}")
         codes: Dict[str, int] = {}
         lock = threading.Lock()
+
+        if self._settings.start_timeout_s:
+            def _registration_watch():
+                # stop.wait → True means the generation already retired
+                # (finished or failed) before the window closed.
+                if not stop.wait(self._settings.start_timeout_s):
+                    self._log_unregistered(assignments, version)
+            threading.Thread(target=_registration_watch, daemon=True).start()
 
         def run_one(a):
             code = run_host_process(a, self._command, self._settings, coord,
@@ -228,6 +302,7 @@ class ElasticDriver:
                 except TimeoutError as e:
                     get_logger().error("%s", e)
                     return 1
+                self._ensure_service(hosts)
                 version = self._service.update_world(
                     hosts, self._target_np(hosts))
                 get_logger().info(
@@ -261,6 +336,7 @@ class ElasticDriver:
             # per-worker scratch.)
             import shutil
             shutil.rmtree(commit_dir, ignore_errors=True)
+            shutil.rmtree(self._coord_dir, ignore_errors=True)
 
     def _watch_membership(self, hosts: Dict[str, int], version: int,
                           stop: threading.Event) -> None:
@@ -275,6 +351,11 @@ class ElasticDriver:
             time.sleep(self._settings.discovery_interval_s)
             if stop.is_set():
                 break
+            # Control-plane self-healing rides the same cadence: a dead
+            # coordinator service is rebuilt from its journal before the
+            # next discovery decision (counters preserved, new port
+            # republished via the address file).
+            self._ensure_service(running)
             now = self.effective_hosts()
             # Compare slots too, not just names: a shrunk host lost
             # capacity the generation is using (hard stop); a grown one is

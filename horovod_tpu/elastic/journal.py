@@ -1,0 +1,109 @@
+"""Write-ahead journal for the coordinator service's world state.
+
+Reference parity: the role the reference's rendezvous KV store plays for
+driver restarts (``horovod/runner/elastic/rendezvous.py``, SURVEY.md §2.5)
+— membership state that outlives the process serving it. Here the state is
+tiny (version, hosts, np, failures, failure_seq, registrations), so a
+JSON-lines append log in the driver's temp dir is enough: every mutation
+appends one self-contained record, and a crashed ``CoordinatorService`` is
+rebuilt by replaying the log.
+
+Why both monotonic counters must survive a restart: survivors' step
+watchers baseline ``failure_seq`` and arm only when it MOVES UP alongside
+a non-empty failure list (core/watchdog.py). A restarted coordinator that
+reset the seq to 0 would publish the next death at a sequence the watcher
+has already seen — the rescue would silently never fire (the exact
+mis-baselining bug class REVIEW r6 caught in the relaunch path).
+
+Torn tail: a crash mid-append leaves a partial final line. Replay ignores
+any undecodable line (and logs it once), so the rebuilt state is simply
+"as of the last durable record" — the same contract as elastic/state.py's
+checksummed commits, without needing a checksum because records are
+line-framed and individually self-contained.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, TextIO
+
+from ..core.logging import get_logger
+
+
+class CoordinatorJournal:
+    """Append-only JSON-lines log of coordinator state mutations."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[TextIO] = None
+
+    def _file(self) -> TextIO:
+        if self._fh is None or self._fh.closed:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """Durably append one mutation record. Flush + fsync per record:
+        the journal only matters when the process serving the state dies,
+        so buffered-but-unwritten records would defeat its purpose. The
+        write rate is human-scale (membership changes and worker deaths),
+        not per-step."""
+        fh = self._file()
+        fh.write(json.dumps(record, sort_keys=True) + "\n")
+        fh.flush()
+        try:
+            os.fsync(fh.fileno())
+        except OSError:
+            pass
+        except ValueError:  # closed underneath us during teardown
+            pass
+
+    def close(self) -> None:
+        if self._fh is not None and not self._fh.closed:
+            self._fh.close()
+
+
+def replay(path: str) -> Optional[Dict[str, Any]]:
+    """Rebuild the coordinator state from the journal, or None when the
+    journal is missing/empty. A torn final record (crash mid-append) is
+    tolerated: undecodable lines are skipped."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return None
+    state: Dict[str, Any] = {
+        "version": 0, "hosts": {}, "np": 0,
+        "failures": [], "failure_seq": 0, "registrations": {},
+    }
+    seen = 0
+    for lineno, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            op = rec["op"]
+        except (ValueError, KeyError, TypeError):
+            get_logger().warning(
+                "coordinator journal %s: skipping undecodable record at "
+                "line %d (torn tail from a crash mid-append)", path, lineno)
+            continue
+        seen += 1
+        if op == "world":
+            state["version"] = int(rec["version"])
+            state["hosts"] = dict(rec["hosts"])
+            state["np"] = int(rec["np"])
+            state["failures"] = []   # per-generation, cleared by update
+        elif op == "failure":
+            state["failure_seq"] = int(rec["seq"])
+            state["failures"].append(
+                {"host": rec["host"], "code": int(rec["code"])})
+        elif op == "register":
+            state["registrations"][str(rec["process_id"])] = float(rec["ts"])
+        else:
+            get_logger().warning(
+                "coordinator journal %s: unknown op %r at line %d — "
+                "skipped", path, op, lineno)
+    return state if seen else None

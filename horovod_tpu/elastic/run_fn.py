@@ -91,8 +91,19 @@ def run(func: Callable) -> Callable:
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
-                get_logger().warning("collective failure: rolling back to "
-                                     "last commit")
+                from ..core.watchdog import monitor
+                if monitor().heartbeat().get("control_plane_lost"):
+                    # Not a data-plane failure: the coordinator stayed
+                    # unreachable past HOROVOD_COORDINATOR_LOST_TIMEOUT_
+                    # SECONDS. Exit/reset anyway — if the driver crash-
+                    # restarted its service the relaunch reconnects us; if
+                    # the driver is truly gone, exiting beats polling it
+                    # forever.
+                    get_logger().error("control plane lost: escalating via "
+                                       "the elastic reset path")
+                else:
+                    get_logger().warning("collective failure: rolling back "
+                                         "to last commit")
                 if _mode() == "restart":
                     # State was persisted at the last commit; ask the driver
                     # for a relaunch with whatever membership is now alive.

@@ -21,25 +21,128 @@ commit/check points).
 
 Wire format: JSON body + ``X-HVD-Sig`` HMAC (runner/secret.py) over the
 body, both directions. Replay within a job is harmless (monotonic version).
+
+Control-plane hardening (docs/failure_model.md "control plane" rows):
+
+- **Retrying client**: every logical call makes up to
+  ``HOROVOD_COORDINATOR_RPC_RETRIES`` attempts under exponential backoff
+  with decorrelated jitter (:class:`RetryPolicy`), each attempt bounded by
+  ``HOROVOD_COORDINATOR_RPC_TIMEOUT_SECONDS``. Transient unreachability is
+  therefore absorbed; *persistent* loss — continuous failure for
+  ``HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS`` — raises
+  :class:`CoordinatorLostError` so callers escalate instead of treating a
+  dead driver as "no change" forever. HMAC-signature failures are counted
+  (``sig_failures``) and logged distinctly from ``OSError`` — a tampered
+  response is not a network blip.
+- **Crash-restart**: the service journals every state mutation
+  (elastic/journal.py); the driver rebuilds a dead service from the journal
+  with both monotonic counters intact and republishes the new port via the
+  address file (``HOROVOD_ELASTIC_COORD_ADDR_FILE``), which the client
+  re-reads on connect failure.
+- **Fault seam**: when ``HOROVOD_FAULT_SPEC`` is armed, each client attempt
+  consults testing/faults.py for call-count-scheduled ``rpc_*`` faults
+  (drop/delay/refuse/garble/badsig) — chaos tests inject control-plane
+  failures deterministically at this one seam.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
 import threading
+import time
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
-from urllib import request as _urlreq
+from typing import Callable, Dict, Iterator, Optional
 
+from ..core.logging import get_logger
 from ..runner import secret as _secret
+from . import constants as C
+from .journal import CoordinatorJournal, replay as _journal_replay
 
 SIG_HEADER = "X-HVD-Sig"
 
 
-class CoordinatorService:
-    """Launcher-side service holding the current membership view."""
+class CoordinatorLostError(RuntimeError):
+    """The coordinator has been continuously unreachable past
+    ``HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS`` — the control plane is
+    considered lost and the worker must escalate (not an ``OSError``
+    subclass on purpose: callers that absorb transient ``OSError`` must
+    not absorb this)."""
 
-    def __init__(self, secret_key: bytes, bind_host: str = "0.0.0.0"):
+
+class _SignatureError(Exception):
+    """A response failed HMAC verification (tampered/corrupt — tracked
+    separately from transport errors)."""
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded-retry schedule: exponential backoff with decorrelated
+    jitter (each sleep is uniform over [base, 3×previous], capped), the
+    schedule that avoids retry synchronization across a fleet of workers
+    all hammering a recovering coordinator at once."""
+
+    attempts: int = C.DEFAULT_RPC_RETRIES
+    timeout_s: float = C.DEFAULT_RPC_TIMEOUT_S      # per-attempt deadline
+    backoff_base_s: float = C.DEFAULT_RPC_BACKOFF_BASE_S
+    backoff_cap_s: float = C.DEFAULT_RPC_BACKOFF_CAP_S
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(
+            attempts=max(1, _env_int(C.RPC_RETRIES_ENV,
+                                     C.DEFAULT_RPC_RETRIES)),
+            timeout_s=_env_float(C.RPC_TIMEOUT_ENV, C.DEFAULT_RPC_TIMEOUT_S),
+            backoff_base_s=_env_float(C.RPC_BACKOFF_BASE_ENV,
+                                      C.DEFAULT_RPC_BACKOFF_BASE_S),
+        )
+
+    def delays(self, rng: Optional[random.Random] = None) -> Iterator[float]:
+        """The ``attempts - 1`` sleeps between attempts. Deterministic
+        under an injected seeded ``rng`` (the fake-clock unit tests);
+        process-global randomness otherwise."""
+        uniform = (rng or random).uniform
+        prev = self.backoff_base_s
+        for _ in range(max(self.attempts - 1, 0)):
+            prev = min(self.backoff_cap_s,
+                       uniform(self.backoff_base_s, prev * 3))
+            yield prev
+
+
+class CoordinatorService:
+    """Launcher-side service holding the current membership view.
+
+    With ``journal_path`` set, every mutation is appended to the
+    write-ahead journal; ``restore=True`` replays it first so a rebuilt
+    service resumes with the SAME monotonic ``version`` and
+    ``failure_seq`` its predecessor published (survivors' watchers
+    baseline those counters — see elastic/journal.py for why a reset
+    would silently disable the peer-liveness rescue)."""
+
+    def __init__(self, secret_key: bytes, bind_host: str = "0.0.0.0",
+                 journal_path: Optional[str] = None, restore: bool = False):
         self._key = secret_key
         self._lock = threading.Lock()
         self._version = 0
@@ -54,12 +157,34 @@ class CoordinatorService:
         # survivor does not re-arm on its predecessor's death.
         self._failures: list = []
         self._failure_seq = 0
+        self._journal = CoordinatorJournal(journal_path) if journal_path \
+            else None
+        if restore and journal_path:
+            state = _journal_replay(journal_path)
+            if state is not None:
+                self._version = state["version"]
+                self._hosts = state["hosts"]
+                self._np = state["np"]
+                self._failures = state["failures"]
+                self._failure_seq = state["failure_seq"]
+                self._started = {int(k): v for k, v
+                                 in state["registrations"].items()}
+                get_logger().info(
+                    "coordinator state restored from journal %s "
+                    "(version=%d failure_seq=%d hosts=%s)", journal_path,
+                    self._version, self._failure_seq, self._hosts)
 
         svc = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def _peer(self) -> str:
+                try:
+                    return f"{self.client_address[0]}:{self.client_address[1]}"
+                except (TypeError, IndexError):
+                    return "?"
 
             def _reply(self, obj, code=200):
                 body = json.dumps(obj).encode()
@@ -78,6 +203,9 @@ class CoordinatorService:
                                      "failures": list(svc._failures),
                                      "failure_seq": svc._failure_seq})
                 else:
+                    get_logger().debug(
+                        "coordinator: unknown GET path %s from %s",
+                        self.path, self._peer())
                     self._reply({"error": "not found"}, 404)
 
             def do_POST(self):
@@ -85,15 +213,20 @@ class CoordinatorService:
                 body = self.rfile.read(n)
                 sig = self.headers.get(SIG_HEADER, "")
                 if not _secret.check(svc._key, body, sig):
+                    get_logger().debug(
+                        "coordinator: bad request signature on %s from %s",
+                        self.path, self._peer())
                     self._reply({"error": "bad signature"}, 403)
                     return
                 msg = json.loads(body or b"{}")
                 if self.path == "/register":
-                    import time
-                    with svc._lock:
-                        svc._started[int(msg["process_id"])] = time.monotonic()
+                    svc._record_register(int(msg["process_id"]),
+                                         time.monotonic())
                     self._reply({"ok": True})
                 else:
+                    get_logger().debug(
+                        "coordinator: unknown POST path %s from %s",
+                        self.path, self._peer())
                     self._reply({"error": "not found"}, 404)
 
         self._server = ThreadingHTTPServer((bind_host, 0), Handler)
@@ -108,6 +241,19 @@ class CoordinatorService:
     def addr(self, advertise_host: str) -> str:
         return f"{advertise_host}:{self.port}"
 
+    def alive(self) -> bool:
+        """The serve thread is still running. Any death of that thread
+        (unhandled exception in serve_forever, torn socket) ends it — the
+        driver polls this and rebuilds from the journal."""
+        return self._thread.is_alive()
+
+    def _record_register(self, process_id: int, ts: float) -> None:
+        with self._lock:
+            self._started[process_id] = ts
+            if self._journal:
+                self._journal.append({"op": "register",
+                                      "process_id": process_id, "ts": ts})
+
     def update_world(self, hosts: Dict[str, int], np_: int) -> int:
         """Publish a new membership view; returns the new version."""
         with self._lock:
@@ -115,6 +261,10 @@ class CoordinatorService:
             self._hosts = dict(hosts)
             self._np = np_
             self._failures = []   # failures are per-generation; seq stays
+            if self._journal:
+                self._journal.append({"op": "world",
+                                      "version": self._version,
+                                      "hosts": self._hosts, "np": np_})
             return self._version
 
     def mark_failure(self, host: str, code: int) -> int:
@@ -126,12 +276,21 @@ class CoordinatorService:
         with self._lock:
             self._failure_seq += 1
             self._failures.append({"host": host, "code": int(code)})
+            if self._journal:
+                self._journal.append({"op": "failure", "host": host,
+                                      "code": int(code),
+                                      "seq": self._failure_seq})
             return self._failure_seq
 
     @property
     def version(self) -> int:
         with self._lock:
             return self._version
+
+    @property
+    def failure_seq(self) -> int:
+        with self._lock:
+            return self._failure_seq
 
     def registered_workers(self) -> Dict[int, float]:
         with self._lock:
@@ -140,39 +299,200 @@ class CoordinatorService:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._journal:
+            self._journal.close()
+
+    def simulate_crash(self) -> None:
+        """Chaos-test hook: die the way a real service death looks from
+        the driver's side — the socket is torn down and the serve thread
+        exits WITHOUT journal finalization or any orderly handoff."""
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
 
 
 class CoordinatorClient:
-    """Worker-side client (used by the commit-time membership watcher)."""
+    """Worker-side client (used by the commit-time membership watcher and
+    the step monitor's failure-feed poll).
 
-    def __init__(self, addr: str, secret_key: bytes, timeout_s: float = 5.0):
+    Each logical call (:meth:`get_world`, :meth:`register`) retries under
+    :class:`RetryPolicy`; on connect failure the coordinator address is
+    re-resolved from the address file (a driver that crash-restarted its
+    service republishes the new port there). ``sleep``/``clock`` are
+    injectable so retry/escalation tests run on a fake clock — no real
+    sleeps in tier-1."""
+
+    def __init__(self, addr: str, secret_key: bytes,
+                 timeout_s: Optional[float] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None):
         self._base = f"http://{addr}"
         self._key = secret_key
-        self._timeout_s = timeout_s
+        self._policy = policy or RetryPolicy.from_env()
+        if timeout_s is not None:
+            self._policy.timeout_s = timeout_s
+        self._sleep = sleep
+        self._clock = clock
+        self._rng = rng
+        #: HMAC verification failures observed (tampered/corrupt replies),
+        #: counted separately from transport errors.
+        self.sig_failures = 0
+        #: HTTP attempts made (the rpc fault schedule's call-count axis).
+        self.calls = 0
+        self._failing_since: Optional[float] = None
+        self._lock = threading.Lock()
 
-    def get_world(self) -> Optional[dict]:
-        """Current membership view, or None if the driver is unreachable
-        (workers treat that as 'no change' — the driver's process death
-        tears workers down anyway via the launch job)."""
-        try:
-            with _urlreq.urlopen(f"{self._base}/world",
-                                 timeout=self._timeout_s) as r:
-                body = r.read()
-                sig = r.headers.get(SIG_HEADER, "")
-            if not _secret.check(self._key, body, sig):
-                return None
-            return json.loads(body)
-        except OSError:
-            return None
+    # -- persistent-loss bookkeeping ----------------------------------------
 
-    def register(self, process_id: int) -> bool:
-        body = json.dumps({"process_id": process_id}).encode()
-        req = _urlreq.Request(
-            f"{self._base}/register", data=body,
-            headers={"Content-Type": "application/json",
-                     SIG_HEADER: _secret.sign(self._key, body)})
+    def _lost_timeout_s(self) -> float:
+        return _env_float(C.COORD_LOST_TIMEOUT_ENV,
+                          C.DEFAULT_COORD_LOST_TIMEOUT_S)
+
+    def _note_success(self) -> None:
+        with self._lock:
+            self._failing_since = None
+
+    def _note_failure(self) -> None:
+        """Track continuous failure; raise CoordinatorLostError once it
+        exceeds the lost-timeout window (0 disables)."""
+        timeout = self._lost_timeout_s()
+        now = self._clock()
+        with self._lock:
+            if self._failing_since is None:
+                self._failing_since = now
+            elapsed = now - self._failing_since
+        if timeout > 0 and elapsed >= timeout:
+            raise CoordinatorLostError(
+                f"coordinator {self._base} unreachable for {elapsed:.0f}s "
+                f"(>= {C.COORD_LOST_TIMEOUT_ENV}={timeout:.0f}s of "
+                "continuous failure) — control plane lost")
+
+    # -- address re-resolution ----------------------------------------------
+
+    def _refresh_addr(self) -> bool:
+        """Re-read the driver's address file (if visible): a crash-
+        restarted coordinator serves on a fresh port. True if the base
+        URL changed."""
+        path = os.environ.get(C.COORD_ADDR_FILE_ENV)
+        if not path:
+            return False
         try:
-            with _urlreq.urlopen(req, timeout=self._timeout_s) as r:
-                return r.status == 200
+            with open(path, "r", encoding="utf-8") as fh:
+                addr = fh.read().strip()
         except OSError:
             return False
+        if not addr or f"http://{addr}" == self._base:
+            return False
+        get_logger().info(
+            "coordinator address changed %s -> http://%s (re-resolved "
+            "from %s)", self._base, addr, path)
+        self._base = f"http://{addr}"
+        return True
+
+    # -- fault seam (testing/faults.py rpc_* kinds) -------------------------
+
+    def _next_call_fault(self):
+        with self._lock:
+            call = self.calls
+            self.calls += 1
+        if not os.environ.get("HOROVOD_FAULT_SPEC"):
+            return None
+        from ..testing import faults
+        return faults.on_rpc_call(call)
+
+    def _apply_pre_fault(self, fault) -> None:
+        if fault is None:
+            return
+        if fault.kind == "rpc_drop":
+            raise TimeoutError("fault rpc_drop: request dropped")
+        if fault.kind == "rpc_refuse":
+            raise ConnectionRefusedError("fault rpc_refuse: "
+                                         "connection refused")
+        if fault.kind == "rpc_delay":
+            self._sleep(float(fault.params.get("seconds", "0.5")))
+
+    # -- one attempt ---------------------------------------------------------
+
+    def _request(self, path: str, data: Optional[bytes], fault) -> dict:
+        """One HTTP attempt. Raises OSError on transport failure and
+        _SignatureError on HMAC mismatch (counted + logged distinctly)."""
+        from urllib import request as _urlreq
+        self._apply_pre_fault(fault)
+        url = f"{self._base}{path}"
+        if data is None:
+            req = _urlreq.Request(url)
+        else:
+            req = _urlreq.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json",
+                         SIG_HEADER: _secret.sign(self._key, data)})
+        with _urlreq.urlopen(req, timeout=self._policy.timeout_s) as r:
+            body = r.read()
+            sig = r.headers.get(SIG_HEADER, "")
+        if fault is not None and fault.kind == "rpc_garble":
+            body = b"\x00GARBLED\x00" + body
+        if fault is not None and fault.kind == "rpc_badsig":
+            sig = "0" * 64
+        if not _secret.check(self._key, body, sig):
+            with self._lock:
+                self.sig_failures += 1
+                count = self.sig_failures
+            get_logger().warning(
+                "coordinator response failed HMAC verification "
+                "(signature failure #%d on %s — tampered or corrupt "
+                "control-plane reply, NOT a network error)", count, url)
+            raise _SignatureError(url)
+        return json.loads(body)
+
+    # -- the retrying logical call ------------------------------------------
+
+    def _call(self, path: str, data: Optional[bytes] = None
+              ) -> Optional[dict]:
+        """Retry ``_request`` under the policy. Returns the decoded reply,
+        or None when every attempt failed (transient failure — callers
+        treat it as 'no change'). Raises CoordinatorLostError once the
+        continuous-failure window exceeds the lost timeout."""
+        delays = self._policy.delays(self._rng)
+        last: Optional[BaseException] = None
+        for attempt in range(self._policy.attempts):
+            fault = self._next_call_fault()
+            try:
+                reply = self._request(path, data, fault)
+                self._note_success()
+                return reply
+            except _SignatureError:
+                last = None  # already counted + logged distinctly
+            except OSError as e:
+                last = e
+                # A refused connect is what a crash-restarted coordinator
+                # looks like until the new port is published: re-resolve
+                # from the address file before backing off.
+                if self._refresh_addr():
+                    continue
+            delay = next(delays, None)
+            if delay is not None:
+                self._sleep(delay)
+        if last is not None:
+            get_logger().debug(
+                "coordinator call %s failed after %d attempts: %s",
+                path, self._policy.attempts, last)
+        self._note_failure()
+        return None
+
+    def get_world(self) -> Optional[dict]:
+        """Current membership view, or None while the driver is merely
+        *transiently* unreachable (callers treat that as 'no change').
+        Persistent loss raises CoordinatorLostError instead — a dead
+        driver must not look like a quiet network forever."""
+        return self._call("/world")
+
+    def register(self, process_id: int) -> bool:
+        """Announce this worker; retried under the same policy. Returns
+        False on (transient) failure — the driver logs never-registered
+        workers when its start-timeout trips, so a dropped registration
+        is visible on the driver side too."""
+        body = json.dumps({"process_id": process_id}).encode()
+        reply = self._call("/register", data=body)
+        return bool(reply and reply.get("ok"))

@@ -99,7 +99,22 @@ class WorkerNotificationManager:
             if now - self._last_poll < self._poll_interval_s:
                 return
             self._last_poll = now
-            world = self._client.get_world()
+            from ..core.exceptions import HorovodInternalError
+            from .service import CoordinatorLostError
+            try:
+                world = self._client.get_world()
+            except CoordinatorLostError as e:
+                # Persistent control-plane loss (the retrying client's
+                # continuous-failure window elapsed): escalate instead of
+                # treating a dead driver as "no change" forever. The step
+                # monitor is marked first so heartbeats/observers see WHY,
+                # then HorovodInternalError unwinds to @elastic.run —
+                # restart-exit under a (possibly restarted) driver, or an
+                # in-process reset attempt standalone.
+                get_logger().error("%s", e)
+                from ..core.watchdog import monitor
+                monitor().notify_control_plane_lost(str(e))
+                raise HorovodInternalError(str(e)) from e
             if world is not None and world["version"] > self._launch_version:
                 get_logger().info(
                     "membership version %d > launch version %d: hosts updated",
@@ -114,16 +129,26 @@ class WorkerNotificationManager:
         with self._lock:
             self._pending = True
 
-    def register(self) -> None:
+    def register(self) -> bool:
         """Announce this worker to the driver (reference:
         registration.py last-seen bookkeeping; feeds the driver's
-        ``registered_workers`` observability view)."""
+        ``registered_workers`` observability view). The client retries
+        under the RPC backoff policy; a False return is logged here AND
+        surfaces driver-side when the start-timeout trips (the driver
+        names workers that never registered)."""
         with self._lock:
             if self._client is None:
-                return
+                return True
             pid = os.environ.get("HOROVOD_PROCESS_ID")
-            if pid is not None:
-                self._client.register(int(pid))
+            if pid is None:
+                return True
+            ok = self._client.register(int(pid))
+        if not ok:
+            get_logger().warning(
+                "worker registration with the coordinator failed after "
+                "retries (process_id=%s) — the driver will log this "
+                "worker as never-registered at its start-timeout", pid)
+        return ok
 
 
 notification_manager = WorkerNotificationManager()

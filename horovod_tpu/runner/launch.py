@@ -96,7 +96,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="deterministic fault-injection schedule for chaos "
                         "runs (HOROVOD_FAULT_SPEC; see "
                         "horovod_tpu/testing/faults.py for the grammar, "
-                        "e.g. 'kill:rank=1,step=3')")
+                        "e.g. 'kill:rank=1,step=3'; control-plane kinds "
+                        "rpc_drop/rpc_delay/rpc_refuse/rpc_garble/"
+                        "rpc_badsig schedule on the coordinator RPC "
+                        "attempt counter, e.g. 'rpc_refuse:rank=0,call=2')")
+    p.add_argument("--coordinator-lost-timeout-seconds", type=float,
+                   dest="coordinator_lost_timeout_seconds",
+                   help="seconds of continuous coordinator-RPC failure "
+                        "before a worker escalates instead of polling a "
+                        "dead driver forever "
+                        "(HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS; "
+                        "0 disables)")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    dest="stall_check_warning_time_seconds")
     p.add_argument("--stall-check-shutdown-time-seconds", type=float,
@@ -265,6 +275,9 @@ def _tuning_env(args) -> dict:
             args.stall_check_shutdown_time_seconds)
     if args.step_timeout_seconds is not None:
         env["HOROVOD_STEP_TIMEOUT_SECONDS"] = str(args.step_timeout_seconds)
+    if args.coordinator_lost_timeout_seconds is not None:
+        env["HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS"] = str(
+            args.coordinator_lost_timeout_seconds)
     if args.fault_spec:
         # Validate on the LAUNCHER so a typo'd chaos schedule fails the run
         # up front instead of silently testing nothing on the workers.

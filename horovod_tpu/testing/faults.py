@@ -26,6 +26,15 @@ Spec grammar (``HOROVOD_FAULT_SPEC``)::
                corrupt path=<dir> [bytes=<int>]  truncate newest commit file
                nan    [value=nan|inf]            poison gradients via
                                                  maybe_poison()
+    rpc kinds (control plane; schedule on call=<int>, the coordinator
+    client's HTTP-attempt counter — elastic/service.py applies them):
+               rpc_drop    call=<int>            attempt times out (OSError)
+               rpc_delay   call=<int> [seconds=<float>]  slow one attempt
+               rpc_refuse  call=<int>            connection refused
+               rpc_garble  call=<int>            response body corrupted
+                                                 (fails HMAC verification)
+               rpc_badsig  call=<int>            response signature replaced
+                                                 (body intact, HMAC fails)
 
 Examples::
 
@@ -34,6 +43,8 @@ Examples::
     kill:rank=1,step=3,signal=SIGTERM;nan:rank=0,step=5
     delay:rank=0,round=4,seconds=2.5        # slow one engine round
     corrupt:rank=0,step=4,path=/tmp/commits # truncate newest commit
+    rpc_refuse:rank=0,call=2                # 3rd coordinator RPC refused
+    rpc_badsig:call=0                       # first reply arrives tampered
 
 One-shot semantics: each fault fires at most once per PROCESS LIFETIME
 GENERATION — a marker file in ``HOROVOD_FAULT_MARKER_DIR`` (default: the
@@ -68,7 +79,13 @@ from ..core.logging import get_logger
 FAULT_SPEC_ENV = "HOROVOD_FAULT_SPEC"
 FAULT_MARKER_DIR_ENV = "HOROVOD_FAULT_MARKER_DIR"
 
-_KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan")
+#: rpc_* kinds fire at the coordinator-client seam (elastic/service.py),
+#: scheduled on the client's HTTP-attempt counter (``call=``) — the
+#: control-plane analog of the engine-round axis.
+_RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_refuse", "rpc_garble",
+              "rpc_badsig")
+
+_KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan") + _RPC_KINDS
 
 
 @dataclass
@@ -77,6 +94,7 @@ class Fault:
     rank: Optional[int] = None
     step: Optional[int] = None
     round: Optional[int] = None
+    call: Optional[int] = None
     params: Dict[str, str] = field(default_factory=dict)
     index: int = 0
 
@@ -84,19 +102,27 @@ class Fault:
                 counter: str) -> bool:
         """Does this fault fire for (rank, count)? ``counter`` selects
         which schedule axis applies: "step" faults only match on_step
-        calls; "round" faults only match engine rounds."""
+        calls; "round" faults only match engine rounds; "call" faults
+        only match coordinator RPC attempts."""
         if self.rank is not None and rank is not None and self.rank != rank:
             return False
-        want = self.step if counter == "step" else self.round
+        want = {"step": self.step, "round": self.round,
+                "call": self.call}[counter]
         if want is None:
             # A kind with no schedule on this axis never fires on it.
             return False
         return count == want
 
+    def _sched(self) -> "int | None":
+        for v in (self.step, self.round, self.call):
+            if v is not None:
+                return v
+        return None
+
     def marker_name(self) -> str:
         return (f"hvd_fault.{self.index}.{self.kind}"
                 f".r{'any' if self.rank is None else self.rank}"
-                f".s{self.step if self.step is not None else self.round}"
+                f".s{self._sched()}"
                 ".done")
 
 
@@ -130,6 +156,8 @@ class FaultSpec:
                     f.step = int(v)
                 elif k == "round":
                     f.round = int(v)
+                elif k == "call":
+                    f.call = int(v)
                 else:
                     f.params[k] = v
             if kind in ("delay", "drop") and f.round is None and \
@@ -137,10 +165,16 @@ class FaultSpec:
                 # delay/drop schedule on the engine-round axis; accept
                 # step= as an alias for convenience.
                 f.round, f.step = f.step, None
-            if kind not in ("delay", "drop") and f.step is None:
+            if kind in _RPC_KINDS:
+                if f.call is None:
+                    raise ValueError(f"fault {part!r} needs call=<int> "
+                                     "(rpc faults schedule on the "
+                                     "coordinator-RPC attempt counter)")
+            elif kind in ("delay", "drop"):
+                if f.round is None:
+                    raise ValueError(f"fault {part!r} needs round=<int>")
+            elif f.step is None:
                 raise ValueError(f"fault {part!r} needs step=<int>")
-            if kind in ("delay", "drop") and f.round is None:
-                raise ValueError(f"fault {part!r} needs round=<int>")
             if kind == "corrupt" and "path" not in f.params:
                 raise ValueError("corrupt fault needs path=<dir>")
             spec.faults.append(f)
@@ -191,7 +225,12 @@ class FaultHarness:
         (rank, step)? Lets chaos workers stage side effects (e.g. rewrite
         the discovery hostfile just before their own kill) without
         wall-clock coordination."""
-        counter = "round" if kind in ("delay", "drop") else "step"
+        if kind in _RPC_KINDS:
+            counter = "call"
+        elif kind in ("delay", "drop"):
+            counter = "round"
+        else:
+            counter = "step"
         return any(f.kind == kind and f.matches(rank, step, counter)
                    and not self._fired(f) for f in self.spec.faults)
 
@@ -275,6 +314,27 @@ class FaultHarness:
         return jax.tree_util.tree_map(
             lambda x: jnp.full_like(x, bad), tree)
 
+    # -- rpc-call-axis faults (control plane) ------------------------------
+
+    def on_rpc_call(self, call: int,
+                    rank: Optional[int] = None) -> Optional[Fault]:
+        """Coordinator-client hook (elastic/service.py): returns the armed
+        rpc_* fault for this (rank, HTTP-attempt) — marking it fired — or
+        None. The CLIENT applies the action (raise/delay/mangle) so its
+        injected sleep/clock stay in charge; this harness only owns the
+        schedule and the one-shot markers."""
+        rank = rank if rank is not None else _env_rank()
+        for f in self.spec.faults:
+            if f.kind not in _RPC_KINDS:
+                continue
+            if not f.matches(rank, call, "call") or self._fired(f):
+                continue
+            self._mark_fired(f)
+            get_logger().warning("fault: %s on coordinator rpc call %d "
+                                 "(rank=%s)", f.kind, call, rank)
+            return f
+        return None
+
     # -- engine-round-axis faults ------------------------------------------
 
     def before_engine_round(self, what: str = "") -> None:
@@ -351,3 +411,9 @@ def will_fire(kind: str, step: int, rank: Optional[int] = None) -> bool:
 def maybe_poison(tree: Any) -> Any:
     h = fault_harness()
     return tree if h is None else h.maybe_poison(tree)
+
+
+def on_rpc_call(call: int, rank: Optional[int] = None) -> Optional[Fault]:
+    """Module-level convenience for the coordinator-client fault seam."""
+    h = fault_harness()
+    return None if h is None else h.on_rpc_call(call, rank)

@@ -1,0 +1,31 @@
+"""lint-silent-rpc fixture: an RPC client swallowing OSError into a bare
+``return None`` — a dead coordinator becomes indistinguishable from "no
+change". Exactly ONE finding: the suppressed handler and the non-RPC
+try/except below must stay clean."""
+from urllib import request
+
+
+def get_world(base, timeout):
+    try:
+        with request.urlopen(f"{base}/world", timeout=timeout) as r:
+            return r.read()
+    except OSError:  # <- lint-silent-rpc
+        return None
+
+
+def get_world_deliberate(base, timeout):
+    try:
+        with request.urlopen(f"{base}/world", timeout=timeout) as r:
+            return r.read()
+    except OSError:  # hvd-analyze: ok — probe helper, caller handles None
+        return None
+
+
+def read_file(path):
+    # Not an RPC: no urlopen in the try body, so the same handler shape
+    # is fine here.
+    try:
+        with open(path) as fh:
+            return fh.read()
+    except OSError:
+        return None

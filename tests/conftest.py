@@ -32,6 +32,10 @@ import horovod_tpu as hvd  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "integration: spawns real worker subprocesses")
+    config.addinivalue_line(
+        "markers", "slow: multi-process chaos cases excluded from tier-1 "
+        "(-m 'not slow') to protect its timeout budget; run with the full "
+        "suite or -m slow")
 
 
 @pytest.fixture(autouse=True)

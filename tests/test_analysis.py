@@ -205,6 +205,7 @@ LINT_CASES = [
     ("bad_torch_seed.py", "lint-torch-seed", "warning"),
     ("bad_platform_pin.py", "lint-late-platform-pin", "warning"),
     ("bad_slope_cadence.py", "lint-slope-cadence", "warning"),
+    ("bad_silent_rpc.py", "lint-silent-rpc", "warning"),
 ]
 
 

@@ -1,0 +1,382 @@
+"""Control-plane hardening unit tests (tier-1, no real sleeps).
+
+Covers the retrying RPC client (backoff + decorrelated jitter, distinct
+HMAC-failure accounting, persistent-loss escalation on a fake clock), the
+coordinator world-state journal (round-trip, torn tail, counters that
+survive a crash-restart), the address-file re-resolution, and the rpc_*
+fault kinds at the client seam. The multi-process chaos companions live in
+tests/test_integration_run.py (marked slow).
+"""
+
+import json
+import logging
+import random
+import socket
+
+import pytest
+
+from horovod_tpu.core import watchdog as wd
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.elastic import constants as C
+from horovod_tpu.elastic import journal as journal_mod
+from horovod_tpu.elastic import state as state_mod
+from horovod_tpu.elastic.service import (CoordinatorClient,
+                                         CoordinatorLostError,
+                                         CoordinatorService, RetryPolicy)
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.testing import faults
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for var in (C.COORD_LOST_TIMEOUT_ENV, C.RPC_RETRIES_ENV,
+                C.RPC_TIMEOUT_ENV, C.RPC_BACKOFF_BASE_ENV,
+                C.COORD_ADDR_FILE_ENV, faults.FAULT_SPEC_ENV,
+                faults.FAULT_MARKER_DIR_ENV):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+@pytest.fixture
+def service():
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    yield svc, key
+    svc.close()
+
+
+@pytest.fixture
+def arm_faults(clean_env, tmp_path):
+    """Arm HOROVOD_FAULT_SPEC with a fresh marker dir and a reset
+    process-wide harness; un-arms on teardown."""
+    def arm(spec):
+        clean_env.setenv(faults.FAULT_SPEC_ENV, spec)
+        clean_env.setenv(faults.FAULT_MARKER_DIR_ENV,
+                         str(tmp_path / "markers"))
+        faults._harness = None
+        faults._harness_spec_raw = None
+    yield arm
+    faults._harness = None
+    faults._harness_spec_raw = None
+
+
+def _client(addr, key, **kw):
+    """Client whose sleeps are recorded, never slept."""
+    sleeps = []
+    c = CoordinatorClient(addr, key, sleep=sleeps.append, **kw)
+    return c, sleeps
+
+
+def _dead_addr():
+    """An address nothing listens on."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+# -- RetryPolicy ------------------------------------------------------------
+
+
+def test_backoff_schedule_decorrelated_jitter_bounds():
+    pol = RetryPolicy(attempts=6, backoff_base_s=0.1, backoff_cap_s=2.0)
+    delays = list(pol.delays(random.Random(7)))
+    assert len(delays) == 5                      # attempts - 1 sleeps
+    assert all(0.1 <= d <= 2.0 for d in delays)  # base <= d <= cap
+    # Deterministic under a seeded rng (what makes the schedule testable),
+    # jittered across seeds (what prevents fleet-wide retry sync).
+    assert delays == list(pol.delays(random.Random(7)))
+    assert delays != list(pol.delays(random.Random(8)))
+
+
+def test_retry_policy_from_env(clean_env):
+    clean_env.setenv(C.RPC_RETRIES_ENV, "5")
+    clean_env.setenv(C.RPC_TIMEOUT_ENV, "1.25")
+    clean_env.setenv(C.RPC_BACKOFF_BASE_ENV, "0.2")
+    pol = RetryPolicy.from_env()
+    assert (pol.attempts, pol.timeout_s, pol.backoff_base_s) == (5, 1.25, 0.2)
+    clean_env.setenv(C.RPC_RETRIES_ENV, "0")     # clamped to >= 1
+    assert RetryPolicy.from_env().attempts == 1
+
+
+# -- retrying client vs rpc_* faults ----------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["rpc_drop", "rpc_refuse"])
+def test_client_retries_through_transport_faults(service, arm_faults, kind):
+    svc, key = service
+    arm_faults(f"{kind}:call=0")
+    c, sleeps = _client(f"127.0.0.1:{svc.port}", key)
+    world = c.get_world()
+    assert world is not None and world["version"] == 0
+    assert c.calls == 2          # faulted attempt + successful retry
+    assert len(sleeps) == 1      # one backoff between them
+    assert c.sig_failures == 0   # transport errors are NOT sig failures
+
+
+def test_client_rpc_delay_uses_injected_sleep(service, arm_faults):
+    svc, key = service
+    arm_faults("rpc_delay:call=0,seconds=1.5")
+    c, sleeps = _client(f"127.0.0.1:{svc.port}", key)
+    assert c.get_world() is not None
+    assert 1.5 in sleeps         # the delay went through the seam
+    assert c.calls == 1          # delayed, not failed: no retry
+
+
+@pytest.mark.parametrize("kind", ["rpc_garble", "rpc_badsig"])
+def test_signature_failures_counted_and_logged_distinctly(
+        service, arm_faults, caplog, kind):
+    svc, key = service
+    arm_faults(f"{kind}:call=0")
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    logger = logging.getLogger("horovod_tpu")
+    old_propagate = logger.propagate
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            world = c.get_world()
+    finally:
+        logger.propagate = old_propagate
+    assert world is not None          # retry recovered the call
+    assert c.sig_failures == 1        # ...but the tampering was counted
+    assert any("signature failure #1" in r.message for r in caplog.records)
+
+
+def test_rpc_faults_are_one_shot(service, arm_faults):
+    svc, key = service
+    arm_faults("rpc_refuse:call=0")
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    assert c.get_world() is not None and c.calls == 2
+    # A second client re-counts attempts from 0; the marker file keeps the
+    # fault from re-firing (the relaunched-worker semantics).
+    c2, _ = _client(f"127.0.0.1:{svc.port}", key)
+    assert c2.get_world() is not None and c2.calls == 1
+
+
+def test_register_retried_under_backoff(service, arm_faults):
+    svc, key = service
+    arm_faults("rpc_refuse:call=0")
+    c, sleeps = _client(f"127.0.0.1:{svc.port}", key)
+    assert c.register(3) is True
+    assert c.calls == 2 and len(sleeps) == 1
+    assert 3 in svc.registered_workers()
+
+
+def test_register_returns_false_after_exhausted_retries(clean_env):
+    c, _ = _client(_dead_addr(), _secret.make_secret_key())
+    assert c.register(0) is False
+
+
+# -- persistent-loss escalation ---------------------------------------------
+
+
+def test_persistent_loss_escalates_on_fake_clock(clean_env):
+    clean_env.setenv(C.COORD_LOST_TIMEOUT_ENV, "10")
+    t = [0.0]
+    c, _ = _client(_dead_addr(), _secret.make_secret_key(),
+                   clock=lambda: t[0])
+    assert c.get_world() is None          # transient: within the window
+    t[0] += 11.0
+    with pytest.raises(CoordinatorLostError) as e:
+        c.get_world()
+    assert C.COORD_LOST_TIMEOUT_ENV in str(e.value)
+
+
+def test_success_resets_the_loss_window(service, clean_env, arm_faults):
+    svc, key = service
+    clean_env.setenv(C.COORD_LOST_TIMEOUT_ENV, "10")
+    clean_env.setenv(C.RPC_RETRIES_ENV, "1")
+    arm_faults("rpc_refuse:call=1")
+    t = [0.0]
+    c, _ = _client(f"127.0.0.1:{svc.port}", key, clock=lambda: t[0])
+    assert c.get_world() is not None      # call 0 ok
+    t[0] += 100.0
+    assert c.get_world() is None          # call 1 refused: FIRST failure —
+    t[0] += 5.0                           # window starts here, not at t=0
+    assert c.get_world() is not None      # recovered; window cleared again
+
+
+def test_lost_timeout_zero_disables_escalation(clean_env):
+    clean_env.setenv(C.COORD_LOST_TIMEOUT_ENV, "0")
+    t = [0.0]
+    c, _ = _client(_dead_addr(), _secret.make_secret_key(),
+                   clock=lambda: t[0])
+    for _ in range(3):
+        t[0] += 1000.0
+        assert c.get_world() is None      # forever "transient", by request
+
+
+def test_notification_manager_escalates_and_marks_monitor(service,
+                                                          clean_env):
+    svc, key = service
+    svc.close()                           # the driver is gone
+    clean_env.setenv(C.COORD_LOST_TIMEOUT_ENV, "10")
+    t = [0.0]
+    m = state_mod.WorkerNotificationManager()
+    m._client, _ = _client(f"127.0.0.1:{svc.port}", key,
+                           clock=lambda: t[0])
+    m._launch_version = 1
+    m._poll_interval_s = 0.0
+    try:
+        m.check()                         # first failure: "no change"
+        t[0] += 11.0
+        with pytest.raises(HorovodInternalError):
+            m.check()
+        hb = wd.monitor().heartbeat()
+        assert hb["control_plane_lost"] and \
+            "control plane lost" in hb["control_plane_lost"]
+    finally:
+        wd.monitor().reset_for_recovery()
+
+
+def test_monitor_control_plane_lost_abandons_inflight(clean_env):
+    m = wd.StepMonitor()
+    started = 0.0
+    assert m.deadline_reason(started) is None
+    m.notify_control_plane_lost("coordinator x unreachable")
+    reason = m.deadline_reason(started)
+    assert reason is not None and "control plane lost" in reason
+    assert m.armed()
+    assert m.heartbeat()["control_plane_lost"] == "coordinator x unreachable"
+    m.reset_for_recovery()
+    assert m.deadline_reason(started) is None
+    assert m.heartbeat()["control_plane_lost"] is None
+
+
+# -- address-file re-resolution ---------------------------------------------
+
+
+def test_client_follows_address_file_after_restart(clean_env, tmp_path):
+    key = _secret.make_secret_key()
+    old = CoordinatorService(key, bind_host="127.0.0.1")
+    old.update_world({"a": 1}, 1)
+    addr_file = tmp_path / "coordinator.addr"
+    clean_env.setenv(C.COORD_ADDR_FILE_ENV, str(addr_file))
+    c, _ = _client(f"127.0.0.1:{old.port}", key)
+    assert c.get_world()["version"] == 1
+    old.simulate_crash()                  # old port now refuses
+    new = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        new.update_world({"a": 1}, 1)
+        addr_file.write_text(f"127.0.0.1:{new.port}\n")
+        world = c.get_world()             # connect fails → re-resolve
+        assert world is not None and world["version"] == 1
+        assert str(new.port) in c._base
+    finally:
+        new.close()
+
+
+# -- journal ----------------------------------------------------------------
+
+
+def _world_payload(svc, key):
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    w = c.get_world()
+    assert w is not None
+    return w
+
+
+def test_journal_roundtrip_preserves_world_payload(tmp_path):
+    """Property test: any mutation sequence → crash → rebuild yields an
+    identical /world payload, including BOTH monotonic counters."""
+    key = _secret.make_secret_key()
+    jp = str(tmp_path / "coordinator.journal")
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=jp)
+    rng = random.Random(42)
+    hosts_pool = ["a", "b", "c"]
+    for _ in range(30):
+        op = rng.random()
+        if op < 0.4:
+            hosts = {h: rng.randint(1, 4)
+                     for h in rng.sample(hosts_pool, rng.randint(1, 3))}
+            svc.update_world(hosts, sum(hosts.values()))
+        elif op < 0.8:
+            svc.mark_failure(rng.choice(hosts_pool), rng.choice([1, 9, 137]))
+        else:
+            svc._record_register(rng.randint(0, 7), rng.random())
+    before = _world_payload(svc, key)
+    regs = svc.registered_workers()
+    svc.simulate_crash()
+    rebuilt = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=jp, restore=True)
+    try:
+        assert _world_payload(rebuilt, key) == before
+        assert rebuilt.registered_workers() == regs
+    finally:
+        rebuilt.close()
+
+
+def test_journal_counters_stay_monotonic_after_restart(tmp_path):
+    """The REVIEW-r6 bug class the journal exists to prevent: a restarted
+    coordinator must continue version/failure_seq where its predecessor
+    stopped, or survivors' watchers mis-baseline and never arm."""
+    key = _secret.make_secret_key()
+    jp = str(tmp_path / "coordinator.journal")
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=jp)
+    svc.update_world({"a": 2}, 2)
+    svc.mark_failure("a", 137)
+    svc.update_world({"a": 2}, 2)         # version=2, seq=1, failures=[]
+    svc.simulate_crash()
+    rebuilt = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=jp, restore=True)
+    try:
+        assert rebuilt.version == 2 and rebuilt.failure_seq == 1
+        assert rebuilt.update_world({"a": 2, "b": 1}, 3) == 3
+        assert rebuilt.mark_failure("b", 9) == 2
+        w = _world_payload(rebuilt, key)
+        assert (w["version"], w["failure_seq"]) == (3, 2)
+    finally:
+        rebuilt.close()
+
+
+def test_journal_tolerates_torn_final_record(tmp_path):
+    key = _secret.make_secret_key()
+    jp = str(tmp_path / "coordinator.journal")
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=jp)
+    svc.update_world({"a": 1}, 1)
+    svc.mark_failure("a", 137)
+    before = _world_payload(svc, key)
+    svc.simulate_crash()
+    with open(jp, "a", encoding="utf-8") as fh:
+        fh.write('{"op": "failure", "host": "a", "co')   # crash mid-append
+    rebuilt = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=jp, restore=True)
+    try:
+        assert _world_payload(rebuilt, key) == before
+    finally:
+        rebuilt.close()
+
+
+def test_journal_replay_missing_and_empty(tmp_path):
+    assert journal_mod.replay(str(tmp_path / "nope.journal")) is None
+    empty = tmp_path / "empty.journal"
+    empty.write_text("")
+    assert journal_mod.replay(str(empty)) is None
+
+
+# -- fault grammar ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["rpc_drop", "rpc_delay", "rpc_refuse",
+                                  "rpc_garble", "rpc_badsig"])
+def test_rpc_kinds_require_call_schedule(kind):
+    with pytest.raises(ValueError, match="call"):
+        faults.FaultSpec.parse(f"{kind}:rank=0")
+    f = faults.FaultSpec.parse(f"{kind}:rank=0,call=2").faults[0]
+    assert (f.kind, f.rank, f.call) == (kind, 0, 2)
+    assert f.matches(0, 2, "call")
+    assert not f.matches(0, 2, "step")    # call-scheduled only
+    assert not f.matches(1, 2, "call")    # other rank
+    assert "s2" in f.marker_name()
+
+
+def test_will_fire_uses_call_axis_for_rpc_kinds(arm_faults):
+    arm_faults("rpc_badsig:call=4")
+    h = faults.fault_harness()
+    assert h.will_fire("rpc_badsig", None, 4)
+    assert not h.will_fire("rpc_badsig", None, 3)
+    assert h.on_rpc_call(3) is None
+    fired = h.on_rpc_call(4)
+    assert fired is not None and fired.kind == "rpc_badsig"
+    assert h.on_rpc_call(4) is None       # one-shot

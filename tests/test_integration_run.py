@@ -776,3 +776,342 @@ def test_hvdrun_tensorflow_binding(tmp_path):
     # both ranks converge to IDENTICAL weights (broadcast + allreduce)
     assert lines[0]["fit_w"] == lines[1]["fit_w"], lines
     assert lines[0]["bpps_w"] == lines[1]["bpps_w"], lines
+
+
+# --- control-plane chaos tier (ISSUE 4) --------------------------------------
+# Multi-process coordinator crash-restart / flaky-control-plane scenarios.
+# Marked slow: each one runs multiple real worker generations; tier-1
+# (-m 'not slow') keeps its timeout budget without them.
+
+COORD_CHAOS_WORKER = """
+import json
+import os
+import signal
+import time
+# The survivor must be rescued by the PEER-LIVENESS PUSH, nothing else:
+# ignore SIGTERM (a rank wedged inside the compiled runtime cannot run a
+# Python signal handler either), leaving only the push and the driver's
+# 5s SIGKILL escalation — and the push wins by seconds.
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.core.watchdog import monitored_step
+from horovod_tpu.testing import faults
+from jax.sharding import PartitionSpec as P
+from jax.experimental import multihost_utils
+try:
+    from jax import shard_map
+    _kw = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+    _kw = {"check_rep": False}
+
+hvd.init()
+mesh = hvd.mesh()
+f = jax.jit(shard_map(lambda x: hvd.allreduce(x, hvd.Sum), mesh=mesh,
+                      in_specs=P(hvd.RANK_AXIS), out_specs=P(), **_kw))
+
+def psum_step(v):
+    x = np.full((hvd.size(), 1), v, np.float32)
+    gx = multihost_utils.host_local_array_to_global_array(
+        x[hvd.rank():hvd.rank() + 1], mesh, P(hvd.RANK_AXIS))
+    return float(np.asarray(multihost_utils.global_array_to_host_local_array(
+        f(gx), mesh, P())).ravel()[0])
+
+def chaos_step(v):
+    # Step 9 of generation v2 is where the peer is killed. On gloo a dead
+    # peer RESETS the survivor's collective (an error, not a hang), so to
+    # exercise the rescue a real TPU pod needs — a survivor wedged in the
+    # runtime with NO transport signal — this step blocks in-place on the
+    # surviving rank; only the coordinator's failure push (through the
+    # RESTARTED service) can abandon it.
+    if v == 9.0 and os.environ.get("HOROVOD_ELASTIC_WORLD_VERSION") == "2":
+        time.sleep(120)
+    return psum_step(v)
+
+mstep = monitored_step(chaos_step, what="coord_chaos_step")
+state = elastic.ObjectState(step=0, total=0.0)
+
+@elastic.run
+def train(state):
+    psum_step(0.0)   # compile outside any deadline
+    while state.step < 12:
+        faults.on_step(state.step, rank=hvd.rank())   # dies AT step top
+        state.total += mstep(float(state.step))
+        state.step += 1
+        state.commit()
+        time.sleep(0.25)
+    return state.step
+
+train(state)
+print(json.dumps({"final_step": state.step, "size": hvd.size(),
+                  "total": state.total}), flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_coordinator_crash_restart_preserves_counters(tmp_path):
+    """The control-plane tentpole end to end: generation 1 loses a worker
+    (failure_seq -> 1), generation 2 has its COORDINATOR SERVICE crash
+    mid-run; the driver rebuilds it from the journal on a fresh port with
+    both monotonic counters intact, and a SECOND worker kill after the
+    restart still reaches the survivor via the peer-liveness push — which
+    only works if the restored failure_seq continued from 1, not 0. The
+    final totals are only reachable if no generation was spuriously reset
+    by the restart (version preserved) and every resume came from the
+    newest commit."""
+    import threading
+    import time as _time
+    from horovod_tpu import elastic
+    from horovod_tpu.runner.settings import Settings
+
+    script = tmp_path / "coord_chaos_worker.py"
+    script.write_text(COORD_CHAOS_WORKER)
+    logs = tmp_path / "logs"
+    s = Settings(elastic=True, min_np=2, max_np=2,
+                 hosts=[], host_discovery_script=None,
+                 discovery_interval_s=0.25, start_timeout_s=60,
+                 output_filename=str(logs),
+                 env={"PYTHONPATH": REPO + os.pathsep +
+                      os.environ.get("PYTHONPATH", ""),
+                      "JAX_PLATFORMS": "cpu",
+                      # the test process's 8-virtual-device XLA_FLAGS must
+                      # not leak into workers: 1 device/proc => size == np
+                      "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                      "HOROVOD_FAULT_SPEC":
+                          "kill:rank=1,step=2;kill:rank=1,step=9",
+                      "HOROVOD_FAULT_MARKER_DIR": str(tmp_path / "markers"),
+                      # Peer push must be the rescue, not the stall window.
+                      "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "0",
+                      "HOROVOD_PEER_FAILURE_GRACE_SECONDS": "1",
+                      "HOROVOD_LOG_LEVEL": "INFO"})
+    d = elastic.ElasticDriver(
+        s, [sys.executable, str(script)],
+        discovery=elastic.FixedHostDiscovery({"localhost": 1,
+                                              "127.0.0.2": 1}))
+
+    obs = {}
+
+    def _wait(pred, timeout_s=120.0):
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(0.05)
+        return False
+
+    def chaos():
+        # 1. first kill journaled (generation 1 retires, seq -> 1)
+        obs["kill1_seen"] = _wait(lambda: d._service.failure_seq >= 1)
+        # 2. generation 2 (v2) running with both workers registered
+        obs["gen2_up"] = _wait(
+            lambda: d._service.version >= 2
+            and len(d._service.registered_workers()) >= 2)
+        old = d._service
+        obs["old_port"] = old.port
+        # 3. crash the coordinator service mid-generation
+        old.simulate_crash()
+        # 4. the driver's membership watch rebuilds it from the journal
+        obs["rebuilt"] = _wait(
+            lambda: d._service is not old and d._service.alive(), 30.0)
+        obs["new_port"] = d._service.port
+        obs["version_after_restart"] = d._service.version
+        obs["seq_after_restart"] = d._service.failure_seq
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    code = d.run()
+    t.join(timeout=10)
+
+    assert code == 0
+    assert obs.get("kill1_seen") and obs.get("gen2_up"), obs
+    assert obs.get("rebuilt"), obs
+    # Counters survived the crash: the rebuilt service continued from the
+    # journal, it did not restart from zero.
+    assert obs["new_port"] != obs["old_port"], obs
+    assert obs["version_after_restart"] == 2, obs
+    assert obs["seq_after_restart"] == 1, obs
+    # np=2 throughout: gen v1 commits steps 0-1 (0+2=2); gen v2 resumes at
+    # 2 and commits through step 8 (2 + 2*(2+..+8) = 72); gen v3 resumes
+    # at 9 and finishes 9-11 (72+18+20+22 = 132) — i.e. every step ran
+    # exactly once at world size 2, across two kills and one coordinator
+    # restart, via three clean resumes from the newest commit.
+    finals = []
+    for f in sorted(logs.rglob("rank.*.stdout")):
+        for line in f.read_text().splitlines():
+            if line.startswith("{"):
+                finals.append(json.loads(line))
+    assert finals, list(logs.rglob("*"))
+    done = [x for x in finals if x["final_step"] == 12]
+    assert len(done) == 2, finals
+    for x in done:
+        assert x == {"final_step": 12, "size": 2, "total": 132.0}, finals
+    # The second kill's rescue was the peer push THROUGH the restarted
+    # coordinator (seq 2 > restored baseline 1) — logged by the survivor
+    # of generation v2 before it took the RESTART exit.
+    gen2_err = "".join(f.read_text()
+                       for f in logs.rglob("generation.2/rank.*.stderr"))
+    assert "peer failure notified" in gen2_err, gen2_err[-3000:]
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_flaky_control_plane_during_elastic_resize(tmp_path):
+    """Transient control-plane flakiness (a refused connect and a dropped
+    reply, injected on exact RPC attempts) during a real elastic grow
+    1 -> 2: the retrying client absorbs both faults and the resize
+    completes — before the hardening, either fault read as 'no change'
+    or a failed registration."""
+    hosts_file = tmp_path / "grow_hosts"
+    hosts_file.write_text("localhost:1\n")
+    disco = tmp_path / "discover.sh"
+    disco.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disco.chmod(0o755)
+    script = tmp_path / "grow_worker.py"
+    script.write_text(GROW_WORKER)
+    r = _run_hvdrun(["-np", "1", "--min-np", "1", "--max-np", "2",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "rpc_refuse:call=1;rpc_drop:call=3",
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"GROW_MARKER": str(tmp_path / "grown"),
+                               "GROW_HOSTS_FILE": str(hosts_file),
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    combined = r.stdout + r.stderr
+    # Both faults actually fired at the client seam...
+    assert "fault: rpc_refuse on coordinator rpc call 1" in combined
+    assert "fault: rpc_drop on coordinator rpc call 3" in combined
+    # ...and the resize still went through.
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 2, (lines, r.stdout)
+    assert all(l["size"] == 2 and l["final_step"] == 12 for l in lines), lines
+    assert "hosts gained" in combined
+
+
+BADSIG_WORKER = """
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(step=0)
+
+@elastic.run
+def train(state):
+    while state.step < 6:
+        time.sleep(0.3)
+        state.step += 1
+        state.commit()    # polls the coordinator -> exercises the client
+    return state.step
+
+train(state)
+print("BADSIG-DONE", state.step, flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_tampered_coordinator_reply_detected_in_real_run(tmp_path):
+    """A tampered /world reply (valid transport, wrong HMAC) in a live
+    elastic run is DETECTED and counted as a signature failure — distinct
+    from a network error — and the retry recovers the poll, so the job
+    still completes."""
+    disco = tmp_path / "discover.sh"
+    disco.write_text("#!/bin/sh\necho localhost:1\n")
+    disco.chmod(0o755)
+    script = tmp_path / "badsig_worker.py"
+    script.write_text(BADSIG_WORKER)
+    r = _run_hvdrun(["-np", "1", "--min-np", "1", "--max-np", "1",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "rpc_badsig:call=1",
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    combined = r.stdout + r.stderr
+    assert "BADSIG-DONE 6" in r.stdout
+    assert "fault: rpc_badsig on coordinator rpc call 1" in combined
+    # The distinct signature-failure accounting (NOT the OSError path).
+    assert "signature failure #1" in combined, combined[-3000:]
+    assert "tampered or corrupt control-plane reply" in combined
+
+
+LOST_WORKER = """
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+state = elastic.ObjectState(step=0)
+
+@elastic.run
+def train(state):
+    while True:
+        time.sleep(0.2)
+        state.step += 1
+        state.commit()
+
+train(state)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_persistent_coordinator_loss_escalates_worker(tmp_path):
+    """A worker whose coordinator address points at nothing (the driver
+    host died and never came back) escalates within
+    HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS: control-plane-lost is
+    logged and the process takes the RESTART exit instead of polling a
+    dead driver forever."""
+    import socket
+    import subprocess
+    import time as _time
+    from horovod_tpu.elastic import constants as C
+    from horovod_tpu.runner import secret as _secret
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_addr = f"127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+
+    script = tmp_path / "lost_worker.py"
+    script.write_text(LOST_WORKER)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        C.COORD_ADDR_ENV: dead_addr,
+        C.WORLD_VERSION_ENV: "1",
+        "HOROVOD_PROCESS_ID": "0",
+        _secret.ENV_VAR: _secret.encode(_secret.make_secret_key()),
+        C.COORD_LOST_TIMEOUT_ENV: "4",
+        C.RPC_RETRIES_ENV: "1",
+        C.RPC_TIMEOUT_ENV: "1",
+    })
+    t0 = _time.monotonic()
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, timeout=180, env=env)
+    elapsed = _time.monotonic() - t0
+    # RESTART exit: under a driver this requests a relaunch; standalone it
+    # at least terminates the process instead of a silent poll-forever.
+    assert r.returncode == C.RESTART_EXIT_CODE, \
+        f"rc={r.returncode}\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    assert "control plane lost" in r.stderr, r.stderr[-3000:]
+    assert C.COORD_LOST_TIMEOUT_ENV in r.stderr, r.stderr[-3000:]
+    # Bounded: the 4s window plus init/poll overhead, nowhere near the
+    # 180s harness ceiling.
+    assert elapsed < 120, f"escalation not bounded: {elapsed:.0f}s"
