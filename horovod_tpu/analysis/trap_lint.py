@@ -30,6 +30,15 @@ CLAUDE.md "Environment traps"):
   disabled every rescue layer built on the control plane.  Retry/escalate
   (elastic/service.py's retrying client), or mark a deliberate residual
   with the pragma.
+- ``jax-unguarded-apply`` (WARNING): a train-step function that both
+  computes gradients (``value_and_grad``/``grad``) and applies them
+  (``optax.apply_updates``) with no finiteness guard in sight (no
+  ``isfinite`` / ``grads_finite`` / ``health_vector`` / sentinel
+  reference).  One NaN micro-batch then poisons the parameters forever —
+  and under data parallelism the allreduce spreads it to EVERY replica
+  in one step.  Guard with ``core/sentinel.py``'s health vector (or an
+  explicit ``jnp.isfinite`` check), or pragma deliberate throwaway
+  loops.
 
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
@@ -55,6 +64,19 @@ RPC_SWALLOW_EXCEPTIONS = frozenset({
     "OSError", "IOError", "ConnectionError", "TimeoutError",
     "URLError", "HTTPError",
 })
+
+# jax-unguarded-apply vocabulary: gradient producers, update appliers,
+# and the tokens whose presence counts as a finiteness guard.
+GRAD_CALL_NAMES = frozenset({"value_and_grad", "grad"})
+APPLY_CALL_NAMES = frozenset({"apply_updates"})
+GUARD_TOKENS = frozenset({
+    "isfinite", "grads_finite", "health_vector", "all_finite",
+})
+
+
+def _is_guard_token(tok: str) -> bool:
+    return tok in GUARD_TOKENS or "sentinel" in tok.lower()
+
 
 # Directory names never linted (fixture corpora are known-bad on purpose).
 EXCLUDED_DIR_NAMES = frozenset({
@@ -95,6 +117,10 @@ class _Lint(ast.NodeVisitor):
         self.findings: List[Finding] = []
         self._func_depth = 0
         self._xla_guard_depth = 0
+        # jax-unguarded-apply: apply_updates call nodes already attributed
+        # to an inner (gradient-computing) function — enclosing functions
+        # must not re-flag them.
+        self._apply_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -265,8 +291,42 @@ class _Lint(ast.NodeVisitor):
         self._func_depth += 1
         self.generic_visit(node)
         self._func_depth -= 1
+        # Runs innermost-first (generic_visit above recursed already), so
+        # an apply site is attributed to the SMALLEST enclosing function
+        # that also computes gradients — the actual train-step body.
+        self._check_unguarded_apply(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_unguarded_apply(self, node):
+        """jax-unguarded-apply: gradients computed AND applied in this
+        function with no finiteness-guard token anywhere in it."""
+        apply_calls, has_grad, has_guard = [], False, False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                last = _dotted(sub.func).split(".")[-1]
+                if last in APPLY_CALL_NAMES \
+                        and id(sub) not in self._apply_handled:
+                    apply_calls.append(sub)
+                elif last in GRAD_CALL_NAMES:
+                    has_grad = True
+            tok = sub.attr if isinstance(sub, ast.Attribute) else (
+                sub.id if isinstance(sub, ast.Name) else None)
+            if tok is not None and _is_guard_token(tok):
+                has_guard = True
+        if not apply_calls or not has_grad:
+            return  # grads-only or apply-only: judged by enclosing scope
+        for call in apply_calls:
+            self._apply_handled.add(id(call))
+            if not has_guard:
+                self._add(
+                    "jax-unguarded-apply", Severity.WARNING, call,
+                    "optimizer update applied with no finiteness guard in "
+                    "a gradient-computing step: one NaN micro-batch "
+                    "poisons the parameters forever (and data-parallel "
+                    "allreduce spreads it to every replica); guard with "
+                    "core/sentinel.py's health_vector or jnp.isfinite, "
+                    "or pragma a deliberate throwaway loop")
 
     # -- file-level checks ---------------------------------------------
 

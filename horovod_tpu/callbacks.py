@@ -103,17 +103,30 @@ class CallbackLoop:
 
     def batch_end(self, batch: int, logs: Optional[Dict[str, Any]] = None):
         logs = logs if logs is not None else {}
+        _merge_sentinel_counters(logs)
         for c in self.callbacks:
             c.on_batch_end(batch, self, logs)
 
     def epoch_end(self, epoch: int, logs: Optional[Dict[str, Any]] = None):
         logs = logs if logs is not None else {}
+        _merge_sentinel_counters(logs)
         for c in self.callbacks:
             c.on_epoch_end(epoch, self, logs)
 
     def train_end(self):
         for c in self.callbacks:
             c.on_train_end(self)
+
+
+def _merge_sentinel_counters(logs: Dict[str, Any]) -> None:
+    """Fold the numeric-integrity sentinel's containment counters
+    (core/sentinel.py) into a logs dict as ``sentinel/<counter>`` keys —
+    only when a sentinel is active, so plain loops see no new keys."""
+    from .core import sentinel as _sentinel
+    if _sentinel.active() is None:
+        return
+    for k, v in _sentinel.counters().items():
+        logs.setdefault(f"sentinel/{k}", v)
 
 
 class BroadcastGlobalVariablesCallback(Callback):

@@ -107,6 +107,11 @@ class Config:
     adasum_accumulate_dtype: str = "float32"
     # Debug-mode collective-signature mismatch detector (TPU-new; SURVEY §5.2).
     mismatch_check: bool = False
+    # Numeric-integrity sentinel (core/sentinel.py; docs/numeric_integrity.md):
+    # in-step SDC detection with the skip → rollback → evict ladder.
+    sentinel: bool = False
+    sentinel_max_skips: int = 3
+    sentinel_max_rollbacks: int = 1
     # Elastic.
     elastic_timeout_sec: float = 600.0
     # Control plane (elastic/service.py retrying client; the same envs are
@@ -149,6 +154,10 @@ class Config:
                 "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
             adasum_accumulate_dtype=adasum_dtype,
             mismatch_check=_env_bool("HOROVOD_MISMATCH_CHECK", False),
+            sentinel=_env_bool("HOROVOD_SENTINEL", False),
+            sentinel_max_skips=_env_int("HOROVOD_SENTINEL_MAX_SKIPS", 3),
+            sentinel_max_rollbacks=_env_int(
+                "HOROVOD_SENTINEL_MAX_ROLLBACKS", 1),
             elastic_timeout_sec=_env_float("HOROVOD_ELASTIC_TIMEOUT", 600.0),
             coordinator_rpc_retries=_env_int(
                 "HOROVOD_COORDINATOR_RPC_RETRIES", 3),

@@ -1,0 +1,422 @@
+"""Numeric-integrity sentinel: in-step SDC detection + containment ladder.
+
+Reference parity: upstream Horovod's coordinator cross-checks every
+submitted tensor's dtype/shape/reduction op across ranks before a
+collective runs (``horovod/common/controller.cc`` ComputeResponseList —
+inconsistent submissions produce an error response instead of a corrupt
+allreduce). That catches *structural* divergence only; nothing upstream
+catches a rank whose tensor *values* are corrupt (NaN/Inf gradients, a
+bit-flipped parameter replica) — the poison all-reduces into every peer.
+This module closes that gap for the TPU rebuild with an in-graph health
+probe plus a host-side containment policy:
+
+- :func:`health_vector` — computed INSIDE the jitted train step (zero
+  host round-trips): a per-rank ``[grads_finite, grad_sqnorm,
+  param_digest]`` float32 triple, fused into ONE small ``all_gather``
+  over the rank axis. The digest is a folded-XOR of the parameters' f32
+  bit patterns, bitcast into the f32 lane (collectives move bytes, never
+  arithmetic on them), so cross-replica desync shows as a fingerprint
+  minority.
+- :func:`decode_health` — host-side view of the gathered ``[n, 3]``
+  vector: global finiteness, global grad norm, per-rank fingerprints.
+- :class:`Sentinel` — the policy ladder consuming one
+  :class:`Health` per step and escalating **skip** (update not applied —
+  in-graph ``where`` guard this step, the two-program probe dispatch on
+  consecutive bad steps; bounded by ``HOROVOD_SENTINEL_MAX_SKIPS``) →
+  **rollback** (restore the last blake2b-verified commit,
+  ``elastic/state.py``; bounded by ``HOROVOD_SENTINEL_MAX_ROLLBACKS``) →
+  **evict** (the fingerprint-minority / non-finite-minority rank exits
+  ``EVICT_EXIT_CODE`` so ``elastic/driver.py`` bans its host and
+  relaunches the world without it).
+
+Env knobs: ``HOROVOD_SENTINEL`` (off by default),
+``HOROVOD_SENTINEL_MAX_SKIPS`` (3), ``HOROVOD_SENTINEL_MAX_ROLLBACKS``
+(1). See docs/numeric_integrity.md for the full ladder semantics and
+measured overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from .exceptions import HorovodInternalError
+from .logging import get_logger
+
+SENTINEL_ENV = "HOROVOD_SENTINEL"
+MAX_SKIPS_ENV = "HOROVOD_SENTINEL_MAX_SKIPS"
+MAX_ROLLBACKS_ENV = "HOROVOD_SENTINEL_MAX_ROLLBACKS"
+
+#: Health-vector lanes: [grads_finite, grad_sqnorm, param_digest].
+HEALTH_WIDTH = 3
+
+COUNTER_KEYS = ("steps_skipped", "rollbacks", "evictions",
+                "last_fingerprint_mismatch_step")
+
+
+# ---------------------------------------------------------------------------
+# In-graph helpers (traced into the jitted step; jax imported lazily so
+# importing the policy engine alone stays framework-free for the torch/TF
+# host-side paths).
+# ---------------------------------------------------------------------------
+
+def _float_leaves(tree) -> List[Any]:
+    import jax
+    import jax.numpy as jnp
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.inexact)]
+
+
+def grads_finite(tree):
+    """Scalar bool: every float leaf of ``tree`` is fully finite."""
+    import jax.numpy as jnp
+    ok = jnp.ones((), jnp.bool_)
+    for leaf in _float_leaves(tree):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def grad_sqnorm(tree):
+    """Scalar f32: sum of squared float-leaf entries (local shard)."""
+    import jax.numpy as jnp
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in _float_leaves(tree):
+        acc = acc + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return acc
+
+
+def _xor_fold(bits):
+    """XOR-reduce a uint32 vector by halving (log2(n) vectorized XORs).
+    ``lax.reduce`` with a custom XOR computation lowers to a scalar loop
+    on CPU (measured ~5x slower per element than these fused elementwise
+    passes); XOR is associative/commutative so the fold order is free."""
+    import jax.numpy as jnp
+    n = int(bits.shape[0])
+    if n == 0:
+        return jnp.zeros((), jnp.uint32)
+    p = 1 << max(0, (n - 1).bit_length())
+    if p != n:
+        bits = jnp.concatenate([bits, jnp.zeros(p - n, jnp.uint32)])
+    while p > 1:
+        p //= 2
+        bits = jnp.bitwise_xor(bits[:p], bits[p:2 * p])
+    return bits[0]
+
+
+def param_digest(tree):
+    """Folded-XOR fingerprint (scalar uint32) of the float leaves' f32
+    bit patterns. Bit-exact replicas fold to the same word; a single
+    flipped mantissa bit on one replica changes it. XOR is order- and
+    arithmetic-free, so NaN payload bits survive intact."""
+    import jax
+    import jax.numpy as jnp
+    acc = jnp.zeros((), jnp.uint32)
+    for leaf in _float_leaves(tree):
+        bits = jax.lax.bitcast_convert_type(
+            leaf.astype(jnp.float32), jnp.uint32).ravel()
+        acc = jnp.bitwise_xor(acc, _xor_fold(bits))
+    return acc
+
+
+def health_vector(grads, params, axis=None):
+    """The fused in-step health probe: a ``[n, HEALTH_WIDTH]`` f32 array,
+    one row per rank along ``axis`` (``[1, 3]`` when ``axis`` is None —
+    the GSPMD / single-participant form). Exactly ONE small collective
+    (the all_gather of a 3-float vector); the digest rides the f32 lane
+    by bitcast, untouched by arithmetic."""
+    import jax
+    import jax.numpy as jnp
+    vec = jnp.stack([
+        grads_finite(grads).astype(jnp.float32),
+        grad_sqnorm(grads),
+        jax.lax.bitcast_convert_type(param_digest(params), jnp.float32),
+    ])
+    if axis is not None:
+        return jax.lax.all_gather(vec, axis).reshape(-1, HEALTH_WIDTH)
+    return vec[None, :]
+
+
+class Health(NamedTuple):
+    """Host-side decode of one step's gathered health vector."""
+    finite: bool                 # all ranks' grads fully finite
+    finite_by_rank: np.ndarray   # bool [n]
+    grad_norm: float             # global L2 norm (NaN when non-finite)
+    fingerprints: np.ndarray     # uint32 [n] param digests
+
+
+def decode_health(raw) -> Health:
+    """Decode the ``[n, HEALTH_WIDTH]`` device output on the host.
+    Fingerprints are compared as BIT PATTERNS (uint32 view), never as
+    floats — a digest whose bits happen to spell NaN must still compare
+    equal to itself."""
+    a = np.ascontiguousarray(np.asarray(raw, np.float32)
+                             ).reshape(-1, HEALTH_WIDTH)
+    finite_by_rank = a[:, 0] >= 1.0
+    sq = float(a[:, 1].astype(np.float64).sum())
+    return Health(
+        finite=bool(finite_by_rank.all()),
+        finite_by_rank=finite_by_rank,
+        grad_norm=float(np.sqrt(sq)) if sq >= 0.0 else float("nan"),
+        fingerprints=np.ascontiguousarray(a[:, 2]).view(np.uint32).copy(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Policy engine
+# ---------------------------------------------------------------------------
+
+class SentinelAction(NamedTuple):
+    kind: str                    # ok | skip | rollback | evict | abort
+    rank: Optional[int] = None   # evict target (health-row == rank index)
+    reason: str = ""
+
+
+def _minority_ranks(values: np.ndarray) -> Optional[np.ndarray]:
+    """Indices holding a STRICT minority value (fewer than half). None
+    when no strict minority exists (ties — e.g. 1v1 — are unattributable
+    and must not evict an innocent rank)."""
+    vals, inverse, counts = np.unique(values, return_inverse=True,
+                                      return_counts=True)
+    if len(vals) < 2:
+        return None
+    minority = counts < (len(values) / 2.0)
+    if not minority.any():
+        return None
+    return np.nonzero(minority[inverse])[0]
+
+
+class Sentinel:
+    """The skip → rollback → evict containment ladder.
+
+    Pure host-side state machine: :meth:`observe` consumes one decoded
+    :class:`Health` per step and returns the action the caller applies.
+    The train-step wrapper (``train.py``) acts on it in-loop; the torch
+    frontend feeds :meth:`observe_finite`. ``clock`` is injectable so
+    the ladder is provable with a fake clock and zero sleeps
+    (tests/test_sentinel.py); it only timestamps the escalation history —
+    every decision is step-counted, never wall-clocked.
+
+    Hooks: ``rollback_fn(state) -> state`` restores the last verified
+    commit in-process (when None, rollback raises
+    ``HorovodInternalError`` so ``@elastic.run`` performs its own
+    blake2b-verified ``load_latest`` restore); ``evict_fn(action)``
+    carries out an eviction (default: :func:`default_evict`).
+    """
+
+    def __init__(self, max_skips: Optional[int] = None,
+                 max_rollbacks: Optional[int] = None, *,
+                 rank: Optional[int] = None,
+                 rollback_fn: Optional[Callable[[Any], Any]] = None,
+                 evict_fn: Optional[Callable[[SentinelAction], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from .config import _env_int
+        self.max_skips = (_env_int(MAX_SKIPS_ENV, 3)
+                          if max_skips is None else int(max_skips))
+        self.max_rollbacks = (_env_int(MAX_ROLLBACKS_ENV, 1)
+                              if max_rollbacks is None
+                              else int(max_rollbacks))
+        self.rank = rank
+        self.rollback_fn = rollback_fn
+        self.evict_fn = evict_fn
+        self.clock = clock
+        self.steps_skipped = 0
+        self.rollbacks = 0
+        self.evictions = 0
+        self.last_fingerprint_mismatch_step = -1
+        #: True while the step dispatcher should run the no-update probe
+        #: program (consecutive bad steps; cleared on the first healthy
+        #: step).
+        self.in_containment = False
+        self._consecutive_bad = 0
+        self.history: List[tuple] = []   # (t, kind, step, reason)
+
+    @classmethod
+    def from_env(cls, **kw) -> "Sentinel":
+        return cls(**kw)
+
+    def counters(self) -> Dict[str, int]:
+        return {k: getattr(self, k) for k in COUNTER_KEYS}
+
+    def _note(self, action: SentinelAction, step: int) -> SentinelAction:
+        if action.kind != "ok":
+            self.history.append((self.clock(), action.kind, step,
+                                 action.reason))
+            get_logger().warning("sentinel: %s at step %d (%s)",
+                                 action.kind, step, action.reason)
+        return action
+
+    # -- the ladder ----------------------------------------------------------
+
+    def observe(self, health: Health, step: int) -> SentinelAction:
+        """One step's verdict. Every rank holds the SAME replicated
+        health vector, so every rank computes the SAME action — the
+        eviction vote needs no extra agreement round."""
+        n = len(health.finite_by_rank)
+        if n > 1 and len(np.unique(health.fingerprints)) > 1:
+            # Desync cannot be skipped away: the corrupt replica stays
+            # corrupt. Identify and evict the minority immediately.
+            self.last_fingerprint_mismatch_step = step
+            minority = _minority_ranks(health.fingerprints)
+            if minority is None:
+                return self._note(SentinelAction(
+                    "abort", None,
+                    "parameter fingerprints diverged with no strict "
+                    "minority — unattributable desync"), step)
+            self.evictions += 1
+            return self._note(SentinelAction(
+                "evict", int(minority[0]),
+                f"parameter fingerprint minority (ranks {minority.tolist()}"
+                f" of {n})"), step)
+
+        if health.finite:
+            self._consecutive_bad = 0
+            self.in_containment = False
+            return SentinelAction("ok")
+
+        self._consecutive_bad += 1
+        if self._consecutive_bad <= self.max_skips:
+            self.steps_skipped += 1
+            self.in_containment = True
+            return self._note(SentinelAction(
+                "skip", None,
+                f"non-finite gradients ({self._consecutive_bad}/"
+                f"{self.max_skips} consecutive skips)"), step)
+
+        if self.rollbacks < self.max_rollbacks:
+            self.rollbacks += 1
+            self._consecutive_bad = 0
+            self.in_containment = True
+            return self._note(SentinelAction(
+                "rollback", None,
+                "skip budget exhausted — restoring last verified commit"),
+                step)
+
+        bad = np.nonzero(~health.finite_by_rank)[0]
+        if n > 1 and 0 < len(bad) < n / 2.0:
+            self.evictions += 1
+            return self._note(SentinelAction(
+                "evict", int(bad[0]),
+                f"persistent non-finite gradients from minority ranks "
+                f"{bad.tolist()} after rollback"), step)
+        return self._note(SentinelAction(
+            "abort", None,
+            "persistent non-finite gradients with no attributable "
+            "minority rank"), step)
+
+    def observe_finite(self, finite: bool, step: int) -> SentinelAction:
+        """Host-side frontends (torch ``DistributedOptimizer``) that see
+        only a local finiteness bit: feed it as a 1-rank health vector."""
+        return self.observe(Health(
+            finite=bool(finite),
+            finite_by_rank=np.asarray([bool(finite)]),
+            grad_norm=float("nan"),
+            fingerprints=np.zeros(1, np.uint32)), step)
+
+    # -- action execution (called by the step wrapper) -----------------------
+
+    def do_rollback(self, state):
+        """Apply a rollback action: in-process restore via the hook, or
+        escalate to the elastic recovery path (whose ``load_latest`` only
+        ever restores a blake2b-verified commit)."""
+        if self.rollback_fn is not None:
+            return self.rollback_fn(state)
+        raise HorovodInternalError(
+            "sentinel rollback: no in-process rollback hook — escalating "
+            "to the elastic restore path (last verified commit)")
+
+    def do_evict(self, action: SentinelAction) -> None:
+        if self.evict_fn is not None:
+            self.evict_fn(action)
+            return
+        default_evict(action)
+
+
+def default_evict(action: SentinelAction) -> None:
+    """Carry out an eviction vote. Under the elastic driver the voted
+    rank hard-exits ``EVICT_EXIT_CODE`` (the driver bans its host and
+    relaunches without it; survivors' ``HorovodInternalError`` rides the
+    normal restart path). Outside a driver there is nobody to shrink the
+    world, so everyone escalates to the elastic/in-process recovery
+    path. ``abort`` actions always escalate."""
+    from ..elastic import constants as C
+    under_driver = bool(os.environ.get(C.COORD_ADDR_ENV)
+                        or os.environ.get(C.WORLD_VERSION_ENV))
+    my_rank: Optional[int] = None
+    try:
+        import jax
+        if jax.process_count() > 1:
+            my_rank = jax.process_index()
+    except Exception:  # pragma: no cover - jax-free host frontends
+        my_rank = None
+    if (action.kind == "evict" and under_driver and my_rank is not None
+            and my_rank == action.rank):
+        get_logger().error(
+            "sentinel: this rank (%d) was voted corrupt — exiting with "
+            "EVICT_EXIT_CODE=%d (%s)", my_rank, C.EVICT_EXIT_CODE,
+            action.reason)
+        # Hard exit (no atexit): mirrors run_fn's restart exit — a rank
+        # voted corrupt must not run teardown collectives against peers.
+        os._exit(C.EVICT_EXIT_CODE)
+    raise HorovodInternalError(
+        f"sentinel {action.kind}: rank {action.rank} voted corrupt "
+        f"({action.reason}) — recovering from last verified commit")
+
+
+# ---------------------------------------------------------------------------
+# Process-wide registry (mirrors core/watchdog.py's monitor() singleton):
+# callbacks/metrics read the active sentinel's counters without plumbing.
+# ---------------------------------------------------------------------------
+
+_active: Optional[Sentinel] = None
+_active_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """Is the sentinel requested via env/config? (Step factories also
+    accept an explicit instance, which wins.)"""
+    from .config import _env_bool
+    return _env_bool(SENTINEL_ENV, False)
+
+
+def install(s: Sentinel) -> Sentinel:
+    """Register ``s`` as the process-wide sentinel (latest wins — one
+    sentinel per train loop is the expected shape)."""
+    global _active
+    with _active_lock:
+        _active = s
+    return s
+
+
+def active() -> Optional[Sentinel]:
+    return _active
+
+
+def resolve(spec) -> Optional[Sentinel]:
+    """Normalize a step factory's ``sentinel=`` argument: None/False →
+    config/env default; True → a fresh env-configured instance; an
+    instance passes through. Any resulting instance is installed."""
+    if isinstance(spec, Sentinel):
+        return install(spec)
+    if spec is None:
+        from . import context_api as _ctx
+        if _ctx.is_initialized():
+            spec = _ctx.context().config.sentinel
+        else:
+            spec = enabled()
+    if not spec:
+        return None
+    return install(Sentinel.from_env())
+
+
+def counters() -> Dict[str, int]:
+    """The active sentinel's counters (zeros / -1 when none is active) —
+    the metrics-dict surface for callbacks and heartbeats."""
+    s = active()
+    if s is not None:
+        return s.counters()
+    return {k: (-1 if k == "last_fingerprint_mismatch_step" else 0)
+            for k in COUNTER_KEYS}

@@ -191,6 +191,7 @@ class StepMonitor:
 
     def heartbeat(self) -> Dict[str, Any]:
         """Watcher-visible step progress snapshot."""
+        from . import sentinel as _sentinel
         with self._lock:
             now = time.monotonic()
             return {
@@ -203,6 +204,9 @@ class StepMonitor:
                 "peer_failure": (self._peer_failure[1]
                                  if self._peer_failure else None),
                 "control_plane_lost": self._control_plane_lost,
+                # Numeric-integrity counters (core/sentinel.py): zeros
+                # when no sentinel is active this process.
+                "sentinel": _sentinel.counters(),
             }
 
     # -- peer liveness ------------------------------------------------------
@@ -545,7 +549,7 @@ def monitored_step(fn: Callable, what: str = "train_step") -> Callable:
     def wrapped(*args, **kwargs):
         return monitor().monitored_call(lambda: fn(*args, **kwargs),
                                         what=what)
-    for attr in ("lower", "chosen"):
+    for attr in ("lower", "chosen", "lower_probe", "sentinel"):
         if hasattr(fn, attr):
             setattr(wrapped, attr, getattr(fn, attr))
     return wrapped

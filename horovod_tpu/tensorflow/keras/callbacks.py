@@ -83,6 +83,33 @@ class MetricAverageCallback(keras.callbacks.Callback):
                 logs[k] = float(avg[0])
 
 
+class SentinelCounterCallback(keras.callbacks.Callback):
+    """Surface the numeric-integrity sentinel's containment counters
+    (``horovod_tpu.core.sentinel`` — steps_skipped / rollbacks /
+    evictions / last_fingerprint_mismatch_step) in the keras logs dict as
+    ``sentinel/<counter>`` keys, per batch and per epoch. No-op when no
+    sentinel is active, so it is safe to install unconditionally.
+
+    TPU-new (no reference analog as a callback): the reference surfaces
+    its tensor-consistency state only in C++ logs
+    (``horovod/common/controller.cc``); here the same signals ride the
+    metrics stream so CSVLogger/TensorBoard pick them up for free."""
+
+    @staticmethod
+    def _merge(logs) -> None:
+        from ...core import sentinel as _sentinel
+        if logs is None or _sentinel.active() is None:
+            return
+        for k, v in _sentinel.counters().items():
+            logs.setdefault(f"sentinel/{k}", v)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._merge(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._merge(logs)
+
+
 _warned_momentum = False
 
 

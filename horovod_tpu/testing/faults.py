@@ -26,6 +26,11 @@ Spec grammar (``HOROVOD_FAULT_SPEC``)::
                corrupt path=<dir> [bytes=<int>]  truncate newest commit file
                nan    [value=nan|inf]            poison gradients via
                                                  maybe_poison()
+               desync [eps=<float>]              perturb ONE rank's params
+                                                 by eps via maybe_desync()
+                                                 (silent replica divergence —
+                                                 the SDC class the sentinel's
+                                                 fingerprint lane detects)
     rpc kinds (control plane; schedule on call=<int>, the coordinator
     client's HTTP-attempt counter — elastic/service.py applies them):
                rpc_drop    call=<int>            attempt times out (OSError)
@@ -61,6 +66,10 @@ Hook points:
   transport round when the spec env is set (delay/drop).
 - ``maybe_poison(tree)`` — returns ``tree`` with NaN/Inf splatted into
   every leaf when a ``nan`` fault is armed for this step.
+- ``maybe_desync(tree)`` — returns ``tree`` with every float leaf shifted
+  by ``eps`` when a ``desync`` fault is armed for this step. Applied to
+  ONE rank's host-local params it manufactures exactly the silent
+  cross-replica divergence the sentinel fingerprint lane exists to catch.
 """
 
 from __future__ import annotations
@@ -85,7 +94,8 @@ FAULT_MARKER_DIR_ENV = "HOROVOD_FAULT_MARKER_DIR"
 _RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_refuse", "rpc_garble",
               "rpc_badsig")
 
-_KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan") + _RPC_KINDS
+_KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan",
+          "desync") + _RPC_KINDS
 
 
 @dataclass
@@ -195,6 +205,7 @@ class FaultHarness:
         self._lock = threading.Lock()
         self._round_count = 0
         self._poison_armed: Optional[Fault] = None
+        self._desync_armed: Optional[Fault] = None
         if marker_dir is None:
             marker_dir = os.environ.get(FAULT_MARKER_DIR_ENV)
         if marker_dir is None:
@@ -249,6 +260,13 @@ class FaultHarness:
                 get_logger().warning("fault: arming %s gradient poison "
                                      "(rank=%s step=%d)",
                                      f.params.get("value", "nan"), rank, step)
+            elif f.kind == "desync":
+                with self._lock:
+                    self._desync_armed = f
+                self._mark_fired(f)
+                get_logger().warning("fault: arming eps=%s param desync "
+                                     "(rank=%s step=%d)",
+                                     f.params.get("eps", "1e-3"), rank, step)
             elif f.kind == "corrupt":
                 self._mark_fired(f)
                 self._corrupt(f)
@@ -313,6 +331,22 @@ class FaultHarness:
         bad = jnp.inf if f.params.get("value", "nan") == "inf" else jnp.nan
         return jax.tree_util.tree_map(
             lambda x: jnp.full_like(x, bad), tree)
+
+    def maybe_desync(self, tree: Any) -> Any:
+        """If a ``desync`` fault armed this step, shift every float leaf
+        of ``tree`` (params) by ``eps`` (default 1e-3). Disarms after one
+        use. The shift is finite and tiny — invisible to any isfinite or
+        norm check, detectable only by cross-replica comparison."""
+        with self._lock:
+            f, self._desync_armed = self._desync_armed, None
+        if f is None:
+            return tree
+        import jax
+        import jax.numpy as jnp
+        eps = float(f.params.get("eps", "1e-3"))
+        return jax.tree_util.tree_map(
+            lambda x: x + eps if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.inexact) else x, tree)
 
     # -- rpc-call-axis faults (control plane) ------------------------------
 
@@ -411,6 +445,12 @@ def will_fire(kind: str, step: int, rank: Optional[int] = None) -> bool:
 def maybe_poison(tree: Any) -> Any:
     h = fault_harness()
     return tree if h is None else h.maybe_poison(tree)
+
+
+def maybe_desync(tree: Any) -> Any:
+    """Module-level convenience for the param-desync fault seam."""
+    h = fault_harness()
+    return tree if h is None else h.maybe_desync(tree)
 
 
 def on_rpc_call(call: int, rank: Optional[int] = None) -> Optional[Fault]:

@@ -65,6 +65,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._passes = {}
         self._sparse_params = {}  # param -> sparse_dim of its grads
         self._sync_count = 0      # distinguishes per-step meta-round names
+        self._sentinel_steps = 0  # numeric-integrity sentinel step counter
         self._should_synchronize = True
         self._synchronized = False
         if self._nparticipants > 1:
@@ -349,6 +350,32 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         finally:
             self._should_synchronize = True
 
+    def _sentinel_skip(self) -> bool:
+        """Numeric-integrity gate (core/sentinel.py), run AFTER
+        ``synchronize()``: the reduced gradients are bitwise identical on
+        every rank, so the local isfinite verdict — and therefore the
+        skip/escalate decision — is rank-uniform with NO extra collective.
+        Returns True when this step's update must not be applied."""
+        from ..core import sentinel as _sentinel
+        s = _sentinel.active()
+        if s is None:
+            return False
+        finite = all(
+            bool(torch.isfinite(p.grad).all())
+            for group in self.param_groups for p in group["params"]
+            if p.grad is not None and not p.grad.is_sparse)
+        self._sentinel_steps += 1
+        action = s.observe_finite(finite, self._sentinel_steps)
+        if action.kind == "skip":
+            return True
+        if action.kind == "rollback":
+            # torch state lives in mutable tensors; restoration is the
+            # elastic wrapper's job (verified-commit reload on relaunch).
+            s.do_rollback(None)
+        elif action.kind in ("evict", "abort"):
+            s.do_evict(action)
+        return False
+
     def step(self, closure=None):
         # Heartbeat span (core/watchdog.py): the blocking engine rounds
         # inside synchronize() get their deadline rescue from the engine's
@@ -359,6 +386,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if self._should_synchronize:
                 self.synchronize()
             self._synchronized = False
+            if self._sentinel_skip():
+                return None     # update withheld: params stay at last good
             return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):

@@ -26,6 +26,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .core import context_api as _ctx
+from .core import sentinel as _sentinel
 from .core.watchdog import monitored_step
 from .collectives.ops import effective_axis_size, force_axis_size1
 from .optimizer import broadcast_parameters
@@ -60,7 +61,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     mesh=None,
                     donate: bool = True,
                     scan_steps: Optional[int] = None,
-                    autotune: Optional[bool] = None):
+                    autotune: Optional[bool] = None,
+                    sentinel=None):
     """Build the jitted DP train step: ``step(state, batch, labels) ->
     (state, loss)``. ``batch``/``labels`` are sharded over the rank axis,
     state is replicated; the gradient allreduce happens inside ``optimizer``
@@ -77,13 +79,29 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
     gradient-fusion bucket size (``HOROVOD_FUSION_THRESHOLD``) against live
     throughput while training, logging trials to ``HOROVOD_AUTOTUNE_LOG``
     and locking in the best knobs after convergence. Same call contract;
-    the chosen knobs are readable as ``step.chosen``."""
+    the chosen knobs are readable as ``step.chosen``.
+
+    ``sentinel``: a :class:`~horovod_tpu.core.sentinel.Sentinel`, True, or
+    (default) the ``HOROVOD_SENTINEL`` env/config switch. When engaged the
+    step ALSO computes the fused in-graph health vector (one extra small
+    all_gather, docs/numeric_integrity.md) and a where-guard that keeps
+    params/opt_state untouched on a globally non-finite step, plus a
+    second no-update probe program for consecutive bad steps (donated
+    state aliases through, the update work is DCE'd — the deferred-pair
+    two-program trick). The call contract is unchanged; the policy
+    object is readable as ``step.sentinel``."""
+    sentinel = _sentinel.resolve(sentinel)
+    if sentinel is not None and scan_steps is not None:
+        raise ValueError(
+            "sentinel and scan_steps are mutually exclusive: the health "
+            "vector must reach the host policy engine every step, but "
+            "scan_steps folds k steps into one dispatch")
     if autotune is None:
         autotune = _ctx.is_initialized() and _ctx.context().config.autotune
     if autotune:
         return _autotuned_train_step(
             model, optimizer, loss_fn, axis_name=axis_name, mesh=mesh,
-            donate=donate, scan_steps=scan_steps)
+            donate=donate, scan_steps=scan_steps, sentinel=sentinel)
     mesh = mesh if mesh is not None else _ctx.mesh()
     if axis_name is not None:
         axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
@@ -97,70 +115,115 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         axis = mesh.axis_names[0] if len(mesh.axis_names) == 1 \
             else tuple(mesh.axis_names)
 
-    def sharded_step(state: TrainState, batch, labels):
-        def loss_of(params):
-            variables = {"params": params}
-            stats = state.batch_stats
-            use_stats = len(jax.tree_util.tree_leaves(stats)) > 0
-            if use_stats:
-                variables["batch_stats"] = stats
-                out, mutated = model.apply(variables, batch, train=True,
-                                           mutable=["batch_stats"])
-                new_stats = mutated["batch_stats"]
+    def make_sharded_step(apply_update: bool):
+        # Two bodies, one source of truth: the probe variant
+        # (apply_update=False) never traces optimizer.update, so the
+        # donated params/opt_state alias straight through and the dW
+        # work whose only consumer was the update is DCE'd — the same
+        # two-program trick as make_gspmd_deferred_train_step (a
+        # lax.cond would copy the pass-through state instead).
+        def sharded_step(state: TrainState, batch, labels):
+            def loss_of(params):
+                variables = {"params": params}
+                stats = state.batch_stats
+                use_stats = len(jax.tree_util.tree_leaves(stats)) > 0
+                if use_stats:
+                    variables["batch_stats"] = stats
+                    out, mutated = model.apply(variables, batch, train=True,
+                                               mutable=["batch_stats"])
+                    new_stats = mutated["batch_stats"]
+                else:
+                    out = model.apply(variables, batch, train=True)
+                    new_stats = stats
+                return loss_fn(out, labels), new_stats
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state.params)
+            multi = effective_axis_size(axis) != 1  # known at trace time
+            health = None
+            if sentinel is not None:
+                health = _sentinel.health_vector(
+                    grads, state.params, axis=axis if multi else None)
+            if multi:
+                loss = jax.lax.pmean(loss, axis)
+            if apply_update:
+                updates, opt_state = optimizer.update(grads, state.opt_state,
+                                                      state.params)
+                params = optax.apply_updates(state.params, updates)
+                if multi:
+                    # TrainState is declared replicated (out_specs P()); if
+                    # the model's BatchNorm does not itself sync
+                    # (axis_name=None), per-device stats would silently
+                    # diverge — pmean makes them truly replicated (a no-op
+                    # when the model already synced them). Skipped on a
+                    # 1-member axis: XLA does not reliably elide
+                    # single-participant all-reduces.
+                    new_stats = jax.tree_util.tree_map(
+                        lambda s: jax.lax.pmean(s, axis), new_stats)
+                if sentinel is not None:
+                    # In-graph skip guard: a globally non-finite step must
+                    # not touch params/opt_state/stats on ANY rank. The
+                    # global verdict comes from the already-gathered health
+                    # vector (no second collective); jnp.where is an
+                    # elementwise select, free of the lax.cond copy trap.
+                    ok = health[:, 0].min() >= 1.0
+
+                    def guard(new, old):
+                        return jnp.where(ok, new, old)
+                    params = jax.tree_util.tree_map(guard, params,
+                                                    state.params)
+                    opt_state = jax.tree_util.tree_map(guard, opt_state,
+                                                       state.opt_state)
+                    new_stats = jax.tree_util.tree_map(guard, new_stats,
+                                                       state.batch_stats)
             else:
-                out = model.apply(variables, batch, train=True)
-                new_stats = stats
-            return loss_fn(out, labels), new_stats
+                params, opt_state, new_stats = (
+                    state.params, state.opt_state, state.batch_stats)
+            out_state = TrainState(state.step + 1, params, opt_state,
+                                   new_stats)
+            if sentinel is not None:
+                return out_state, loss, health
+            return out_state, loss
 
-        (loss, new_stats), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state.params)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = optax.apply_updates(state.params, updates)
-        if effective_axis_size(axis) != 1:  # size known at trace time
-            loss = jax.lax.pmean(loss, axis)
-            # TrainState is declared replicated (out_specs P()); if the
-            # model's BatchNorm does not itself sync (axis_name=None),
-            # per-device stats would silently diverge — pmean makes them
-            # truly replicated (a no-op when the model already synced
-            # them). Skipped on a 1-member axis: XLA does not reliably
-            # elide single-participant all-reduces.
-            new_stats = jax.tree_util.tree_map(
-                lambda s: jax.lax.pmean(s, axis), new_stats)
-        return TrainState(state.step + 1, params, opt_state,
-                          new_stats), loss
+        if scan_steps is not None:
+            inner = sharded_step
 
-    if scan_steps is not None:
-        inner = sharded_step
+            def sharded_step(state, batch, labels):  # noqa: F811
+                def body(st, _):
+                    st, loss = inner(st, batch, labels)
+                    return st, loss
+                state, losses = jax.lax.scan(body, state, None,
+                                             length=scan_steps)
+                return state, losses[-1]
 
-        def sharded_step(state, batch, labels):  # noqa: F811
-            def body(st, _):
-                st, loss = inner(st, batch, labels)
-                return st, loss
-            state, losses = jax.lax.scan(body, state, None,
-                                         length=scan_steps)
-            return state, losses[-1]
+        if mesh.devices.size == 1:
+            # 1-device world: no shard_map. The SPMD partitioner costs real
+            # layout copies on TPU even with one participant (measured ~10%
+            # on ResNet-50); under force_axis_size1 the collectives inside
+            # (optimizer allreduce, pmean, BN stat sync) collapse to
+            # identity, so the compiled program is bit-identical to plain
+            # single-device training — the reference's 1-process behavior.
+            inner_step = sharded_step
 
-    if mesh.devices.size == 1:
-        # 1-device world: no shard_map. The SPMD partitioner costs real
-        # layout copies on TPU even with one participant (measured ~10% on
-        # ResNet-50); under force_axis_size1 the collectives inside
-        # (optimizer allreduce, pmean, BN stat sync) collapse to identity,
-        # so the compiled program is bit-identical to plain single-device
-        # training — the reference's 1-process behavior.
-        inner_step = sharded_step
+            def step(state, batch, labels):
+                axes = axis if isinstance(axis, tuple) else (axis,)
+                with force_axis_size1(*axes):
+                    return inner_step(state, batch, labels)
+        else:
+            step = _shard_map(
+                sharded_step, mesh=mesh,
+                in_specs=(P(), P(axis), P(axis)),
+                out_specs=(P(), P(), P()) if sentinel is not None
+                else (P(), P()),
+                check_vma=False)
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-        def step(state, batch, labels):
-            axes = axis if isinstance(axis, tuple) else (axis,)
-            with force_axis_size1(*axes):
-                return inner_step(state, batch, labels)
+    jitted = make_sharded_step(apply_update=True)
+    if sentinel is None:
+        dispatch = jitted
     else:
-        step = _shard_map(
-            sharded_step, mesh=mesh,
-            in_specs=(P(), P(axis), P(axis)),
-            out_specs=(P(), P()),
-            check_vma=False)
-    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+        probe = make_sharded_step(apply_update=False)
+        dispatch = _sentinel_dispatch(sentinel, jitted, probe)
 
     def marked(*args, **kwargs):
         # Per-step host-side timeline record (the reference's MARK_CYCLES):
@@ -171,20 +234,61 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         # building the step, and a closed timeline is never written to.
         tl = _ctx.context().timeline if _ctx.is_initialized() else None
         if tl is None or getattr(tl, "_closed", False):
-            return jitted(*args, **kwargs)
+            return dispatch(*args, **kwargs)
         tl.activity_start("TRAIN_STEP", "DISPATCH")
-        out = jitted(*args, **kwargs)
+        out = dispatch(*args, **kwargs)
         tl.activity_end("TRAIN_STEP", "DISPATCH")
         tl.mark_cycle()
         return out
 
     marked.lower = jitted.lower  # keep AOT introspection available
+    if sentinel is not None:
+        marked.lower_probe = probe.lower
+        marked.sentinel = sentinel
     # Jit-step deadline monitor (core/watchdog.py, docs/failure_model.md):
     # unarmed this is a passthrough; armed, the blocking device fetch runs
     # on a watcher-visible thread so a step blocked inside an XLA
     # collective against a dead peer can be abandoned on deadline or
     # peer-death notification instead of hanging the process forever.
     return monitored_step(marked, what="train_step")
+
+
+def _sentinel_dispatch(sentinel, step_apply, step_skip):
+    """Host-side sentinel wrapper shared by the DP and GSPMD step
+    factories: picks the apply program (in-graph where-guard) or, while
+    in containment, the no-update probe program; decodes the health
+    vector the jitted step already produced; and applies the policy
+    ladder's verdict. Preserves the public ``(state, loss)`` contract.
+
+    The step number is a host counter seeded from ``state.step`` on the
+    first call (the deferred-pair phase-seed pattern) — no device fetch
+    beyond the health read the policy needs anyway."""
+    counter = {"n": None}
+
+    def dispatch(state, *rest):
+        if counter["n"] is None:
+            try:
+                counter["n"] = int(state.step)
+            except jax.errors.ConcretizationTypeError:
+                # Abstract tracing (hvd-analyze / make_jaxpr): no policy
+                # decisions are made on tracers — fall back to 0.
+                counter["n"] = 0
+        counter["n"] += 1
+        fn = step_skip if sentinel.in_containment else step_apply
+        new_state, loss, health = fn(state, *rest)
+        if isinstance(health, jax.core.Tracer):
+            # Abstract trace: the health vector has no concrete value and
+            # the ladder must not run.
+            return new_state, loss
+        action = sentinel.observe(_sentinel.decode_health(health),
+                                  counter["n"])
+        if action.kind == "rollback":
+            new_state = sentinel.do_rollback(new_state)
+        elif action.kind in ("evict", "abort"):
+            sentinel.do_evict(action)
+        return new_state, loss
+
+    return dispatch
 
 
 def _autotuned_train_step(model, optimizer, loss_fn, **build_kw):
@@ -366,42 +470,81 @@ def create_gspmd_train_state(model, optimizer, rng, sample_tokens, mesh,
 def make_gspmd_train_step(model, optimizer, mesh, rules, *,
                           loss_fn: Callable = None,
                           data_axes=("dp", "fsdp"), seq_axis: str = "sp",
-                          donate: bool = True, aux_weight: float = 0.0):
+                          donate: bool = True, aux_weight: float = 0.0,
+                          sentinel=None):
     """Jitted LM train step: ``step(state, tokens) -> (state, loss)``.
     ``tokens`` [B, T] is sharded batch-over-data-axes, seq-over-sp; all
     tp/sp/ep/fsdp collectives AND the dp grad psum are inserted by XLA from
-    the sharding annotations."""
+    the sharding annotations.
+
+    ``sentinel`` engages the numeric-integrity ladder exactly as in
+    :func:`make_train_step`. GSPMD has no named rank axis, so the health
+    vector is the ``[1, 3]`` global form (global finiteness/norm/digest
+    via XLA's implicit reductions): skip and rollback work; per-rank
+    fingerprint eviction needs the shard_map DP step."""
+    sentinel = _sentinel.resolve(sentinel)
     loss_fn = loss_fn or next_token_loss
     rules = rules_for_mesh(mesh, rules)
     present = [a for a in data_axes if a in mesh.axis_names]
     seq = seq_axis if seq_axis in mesh.axis_names else None
     token_sharding = NamedSharding(mesh, P(tuple(present) or None, seq))
 
-    def step(state: GSPMDTrainState, tokens):
-        tokens = jax.lax.with_sharding_constraint(tokens, token_sharding)
+    def make_step(apply_update: bool):
+        # Probe variant (apply_update=False): optimizer.update is never
+        # traced, donated state aliases through, update work is DCE'd —
+        # see make_gspmd_deferred_train_step for the two-program rationale.
+        def step(state: GSPMDTrainState, tokens):
+            tokens = jax.lax.with_sharding_constraint(tokens,
+                                                      token_sharding)
 
-        def loss_of(params):
-            with nn_partitioning.axis_rules(rules):
-                logits, mods = model.apply({"params": params}, tokens,
-                                           mutable=["losses"])
-            loss = loss_fn(logits, tokens)
-            if aux_weight and "losses" in mods:
-                aux = sum(jnp.sum(v) for v in
-                          jax.tree_util.tree_leaves(mods["losses"]))
-                loss = loss + aux_weight * aux
-            return loss
+            def loss_of(params):
+                with nn_partitioning.axis_rules(rules):
+                    logits, mods = model.apply({"params": params}, tokens,
+                                               mutable=["losses"])
+                loss = loss_fn(logits, tokens)
+                if aux_weight and "losses" in mods:
+                    aux = sum(jnp.sum(v) for v in
+                              jax.tree_util.tree_leaves(mods["losses"]))
+                    loss = loss + aux_weight * aux
+                return loss
 
-        loss, grads = jax.value_and_grad(loss_of)(state.params)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
-        params = optax.apply_updates(state.params, updates)
-        return GSPMDTrainState(state.step + 1, params, opt_state), loss
+            loss, grads = jax.value_and_grad(loss_of)(state.params)
+            health = None
+            if sentinel is not None:
+                health = _sentinel.health_vector(grads, state.params)
+            if apply_update:
+                updates, opt_state = optimizer.update(grads,
+                                                      state.opt_state,
+                                                      state.params)
+                params = optax.apply_updates(state.params, updates)
+                if sentinel is not None:
+                    ok = health[:, 0].min() >= 1.0
 
-    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+                    def guard(new, old):
+                        return jnp.where(ok, new, old)
+                    params = jax.tree_util.tree_map(guard, params,
+                                                    state.params)
+                    opt_state = jax.tree_util.tree_map(guard, opt_state,
+                                                       state.opt_state)
+            else:
+                params, opt_state = state.params, state.opt_state
+            out_state = GSPMDTrainState(state.step + 1, params, opt_state)
+            if sentinel is not None:
+                return out_state, loss, health
+            return out_state, loss
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    jitted = make_step(apply_update=True)
+    if sentinel is None:
+        inner = jitted
+    else:
+        probe = make_step(apply_update=False)
+        inner = _sentinel_dispatch(sentinel, jitted, probe)
 
     def run(state, tokens):
         with jax.sharding.set_mesh(mesh):
-            return jitted(state, tokens)
+            return inner(state, tokens)
 
     def lower(state, tokens):
         # AOT introspection must trace under the SAME mesh the step
@@ -411,6 +554,12 @@ def make_gspmd_train_step(model, optimizer, mesh, rules, *,
             return jitted.lower(state, tokens)
 
     run.lower = lower
+    if sentinel is not None:
+        def lower_probe(state, tokens):
+            with jax.sharding.set_mesh(mesh):
+                return probe.lower(state, tokens)
+        run.lower_probe = lower_probe
+        run.sentinel = sentinel
     return monitored_step(run, what="gspmd_train_step")
 
 
@@ -429,6 +578,13 @@ def make_gspmd_deferred_train_step(model, pair, mesh, rules, **kw):
     docs/benchmarks.md r5). Both optimizers share a state structure;
     init with ``pair.apply``. Requires ``donate=True`` (the default)
     for the aliasing to exist."""
+    # Resolve the sentinel ONCE so both programs share a single policy
+    # object — two ladders independently counting the same bad steps must
+    # not happen. Env-default engagement (HOROVOD_SENTINEL=1 with no
+    # explicit kwarg) is pinned here for the same reason.
+    resolved = _sentinel.resolve(kw.get("sentinel"))
+    if resolved is not None:
+        kw["sentinel"] = resolved
     step_apply = make_gspmd_train_step(model, pair.apply, mesh, rules, **kw)
     step_skip = make_gspmd_train_step(model, pair.skip, mesh, rules, **kw)
     every = int(pair.every)

@@ -1,0 +1,40 @@
+"""Fixture: jax-unguarded-apply (exactly ONE finding).
+
+A train step that computes gradients and applies them with no
+finiteness guard anywhere — one NaN micro-batch poisons the params
+forever. Plus a suppressed twin and two clean look-alikes.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def bad_train_step(params, opt_state, batch, tx):
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)  # <- jax-unguarded-apply
+    return params, opt_state, loss
+
+
+def suppressed_train_step(params, opt_state, batch, tx):
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)  # hvd-analyze: ok
+    return params, opt_state, loss
+
+
+def guarded_train_step(params, opt_state, batch, tx):
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    ok = jnp.all(jnp.isfinite(jnp.asarray(loss)))
+    updates, opt_state = tx.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    params = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(ok, new, old), new_params, params)
+    return params, opt_state, loss
+
+
+def not_a_train_step(params, updates):
+    # Applies updates but computes no gradients — a manual SGD helper
+    # whose caller owns the guard; judged at the caller's scope.
+    return optax.apply_updates(params, updates)

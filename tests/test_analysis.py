@@ -206,6 +206,7 @@ LINT_CASES = [
     ("bad_platform_pin.py", "lint-late-platform-pin", "warning"),
     ("bad_slope_cadence.py", "lint-slope-cadence", "warning"),
     ("bad_silent_rpc.py", "lint-silent-rpc", "warning"),
+    ("bad_unguarded_apply.py", "jax-unguarded-apply", "warning"),
 ]
 
 

@@ -14,7 +14,8 @@ import pytest
 
 from horovod_tpu.testing.faults import (FAULT_SPEC_ENV, FaultHarness,
                                         FaultSpec, fault_harness,
-                                        maybe_poison, will_fire)
+                                        maybe_desync, maybe_poison,
+                                        will_fire)
 
 
 def _harness(spec: str, tmp_path) -> FaultHarness:
@@ -53,6 +54,7 @@ def test_parse_step_alias_for_round_axis():
     "delay:seconds=1",         # delay without round
     "corrupt:step=1",          # corrupt without path
     "kill:step",               # malformed key=value
+    "desync:rank=1",           # desync without a step schedule
 ])
 def test_parse_rejects_malformed_specs(bad):
     with pytest.raises(ValueError):
@@ -63,6 +65,7 @@ def test_env_harness_is_cached_and_gated(monkeypatch):
     monkeypatch.delenv(FAULT_SPEC_ENV, raising=False)
     assert fault_harness() is None
     assert maybe_poison({"a": 1}) == {"a": 1}
+    assert maybe_desync({"a": 1}) == {"a": 1}
     assert not will_fire("kill", 3)
 
 
@@ -110,11 +113,50 @@ def test_nan_poison_arms_and_disarms(tmp_path):
 
 
 def test_inf_poison_value(tmp_path):
+    """``value=inf`` splats Inf (NOT NaN) into every leaf, one-shot."""
     import jax.numpy as jnp
     h = _harness("nan:step=2,value=inf", tmp_path)
+    grads = {"w": jnp.ones((2,)), "b": jnp.zeros((3,))}
+    assert h.maybe_poison(grads) is grads       # not armed yet
     h.on_step(2, rank=0)                        # rank=None matches any
-    out = h.maybe_poison({"w": jnp.ones((2,))})
-    assert np.all(np.isinf(np.asarray(out["w"])))
+    out = h.maybe_poison(grads)
+    for leaf in (out["w"], out["b"]):
+        a = np.asarray(leaf)
+        assert np.all(np.isinf(a))
+        assert not np.any(np.isnan(a))          # inf, not nan
+    # disarmed after one use, and the marker blocks a replayed step 2
+    assert h.maybe_poison(grads) is grads
+    h.on_step(2, rank=0)
+    assert h.maybe_poison(grads) is grads
+
+
+def test_desync_perturbs_float_leaves_once(tmp_path):
+    """``desync`` shifts float leaves by eps on the scheduled rank/step —
+    finite and tiny (invisible to isfinite/norm checks), one-shot."""
+    import jax.numpy as jnp
+    h = _harness("desync:rank=1,step=4,eps=0.5", tmp_path)
+    params = {"w": jnp.ones((2, 2)), "n": jnp.arange(3)}  # n: int leaf
+    assert h.maybe_desync(params) is params     # not armed yet
+    h.on_step(4, rank=0)                        # wrong rank: stays unarmed
+    assert h.maybe_desync(params) is params
+    h.on_step(4, rank=1)
+    out = h.maybe_desync(params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.5)
+    assert np.all(np.isfinite(np.asarray(out["w"])))
+    np.testing.assert_array_equal(np.asarray(out["n"]),
+                                  np.arange(3))  # int leaves untouched
+    # disarmed after one use, and one-shot across replayed steps
+    assert h.maybe_desync(params) is params
+    h.on_step(4, rank=1)
+    assert h.maybe_desync(params) is params
+
+
+def test_desync_default_eps(tmp_path):
+    import jax.numpy as jnp
+    h = _harness("desync:step=1", tmp_path)
+    h.on_step(1, rank=0)
+    out = h.maybe_desync({"w": jnp.zeros((2,))})
+    np.testing.assert_allclose(np.asarray(out["w"]), 1e-3)
 
 
 def test_corrupt_truncates_newest_file(tmp_path):

@@ -1115,3 +1115,255 @@ def test_persistent_coordinator_loss_escalates_worker(tmp_path):
     # Bounded: the 4s window plus init/poll overhead, nowhere near the
     # 180s harness ceiling.
     assert elapsed < 120, f"escalation not bounded: {elapsed:.0f}s"
+
+
+SENTINEL_NAN_WORKER = """
+import json
+import numpy as np
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import flax.linen as nn
+import optax
+import horovod_tpu as hvd
+from horovod_tpu.optimizer import distributed
+from horovod_tpu.testing import faults
+from horovod_tpu.train import create_train_state, make_train_step
+from jax.sharding import PartitionSpec as P
+from jax.experimental import multihost_utils
+
+hvd.init()
+mesh = hvd.mesh()
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(10)(nn.relu(nn.Dense(16)(x)))
+
+
+def xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+rng = np.random.RandomState(0)
+xs = rng.randn(hvd.size() * 2, 4, 4, 1).astype(np.float32)
+ys = rng.randint(0, 10, size=(hvd.size() * 2,))
+lo = hvd.rank() * 2
+
+model = MLP()
+dopt = distributed(optax.sgd(0.05))
+state = create_train_state(model, jax.random.PRNGKey(0), xs[:1], dopt)
+# process-local init arrays -> host numpy, so the first global-mesh step
+# call auto-replicates them (committed local buffers would be rejected)
+state = jax.tree_util.tree_map(
+    lambda a: np.asarray(jax.device_get(a)), state)
+step = make_train_step(model, dopt, xent)      # HOROVOD_SENTINEL=1 engages
+assert step.sentinel is not None
+
+losses = []
+for i in range(6):
+    faults.on_step(i, rank=hvd.rank())
+    # the nan fault splats NaN into THIS rank's host-local batch shard
+    # before it is stitched into the global array: one corrupt rank
+    local = faults.maybe_poison({"x": xs[lo:lo + 2]})["x"]
+    gx = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P(hvd.RANK_AXIS))
+    gy = multihost_utils.host_local_array_to_global_array(
+        ys[lo:lo + 2], mesh, P(hvd.RANK_AXIS))
+    state, loss = step(state, gx, gy)
+    losses.append(float(np.asarray(jax.device_get(loss))))
+
+print(json.dumps({
+    "rank": hvd.rank(), "size": hvd.size(),
+    "final_loss": losses[-1],
+    "final_finite": bool(np.isfinite(losses[-1])),
+    "nan_steps": int(sum(0 if np.isfinite(l) else 1 for l in losses)),
+    "counters": step.sentinel.counters(),
+}), flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_sentinel_skips_nan_step_on_all_ranks(tmp_path):
+    """Chaos ladder rung 1 end to end: 2 REAL processes, rank 0's batch
+    shard is NaN-poisoned at step 3 (``nan`` fault). The in-graph health
+    all_gather makes the verdict global, so BOTH ranks withhold the
+    update (steps_skipped=1 everywhere — no desync between the corrupt
+    rank and the clean one), training continues, and the final loss is
+    finite."""
+    import json as _json
+    script = tmp_path / "sentinel_nan_worker.py"
+    script.write_text(SENTINEL_NAN_WORKER)
+    r = _run_hvdrun(["-np", "2", "-H", "localhost:1,127.0.0.1:1",
+                     "--fault-spec", "nan:rank=0,step=3",
+                     sys.executable, str(script)], timeout=360,
+                    env_extra={"HOROVOD_SENTINEL": "1",
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers")})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [_json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 2, r.stdout
+    for out in lines:
+        assert out["size"] == 2
+        assert out["final_finite"], out
+        # the poisoned step itself reports a NaN loss (the forward ran);
+        # every later step is finite because the update was withheld
+        assert out["nan_steps"] == 1, out
+        assert out["counters"]["steps_skipped"] == 1, out
+        assert out["counters"]["rollbacks"] == 0, out
+        assert out["counters"]["evictions"] == 0, out
+    combined = r.stdout + r.stderr
+    assert "sentinel: skip" in combined
+
+
+SENTINEL_DESYNC_WORKER = """
+import json
+import os
+import numpy as np
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.optimizer import distributed
+from horovod_tpu.testing import faults
+from horovod_tpu.train import TrainState, create_train_state, make_train_step
+from jax.sharding import PartitionSpec as P
+from jax.experimental import multihost_utils
+
+hvd.init()
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(8)(x)
+
+
+def xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def to_host(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), tree)
+
+
+state = elastic.ObjectState(step=0, params=None, opt_state=None)
+
+
+@elastic.run
+def train(state):
+    print("GEN-ENTRY step=%d size=%d version=%s" % (
+        state.step, hvd.size(),
+        os.environ.get("HOROVOD_ELASTIC_WORLD_VERSION")), flush=True)
+    mesh = hvd.mesh()
+    model = MLP()
+    dopt = distributed(optax.sgd(0.05))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(hvd.size(), 4, 4, 1).astype(np.float32)
+    ys = rng.randint(0, 8, size=(hvd.size(),))
+    init = create_train_state(model, jax.random.PRNGKey(0), xs[:1], dopt)
+    params = state.params if state.params is not None \\
+        else to_host(init.params)
+    opt_state = state.opt_state if state.opt_state is not None \\
+        else to_host(init.opt_state)
+    # donate=False: state round-trips through host numpy every step so the
+    # desync fault has a host-side replica to perturb
+    step_fn = make_train_step(model, dopt, xent, donate=False)
+    assert step_fn.sentinel is not None
+    loss = float("nan")
+    while state.step < 6:
+        faults.on_step(state.step, rank=hvd.rank())
+        # SDC injection: a finite eps shift on THIS rank's param replica
+        # only -- invisible to isfinite/norm, caught only by the
+        # cross-replica fingerprint lane
+        params = faults.maybe_desync(params)
+        ts = TrainState(jnp.int32(state.step), params, opt_state,
+                        to_host(init.batch_stats))
+        gx = multihost_utils.host_local_array_to_global_array(
+            xs[hvd.rank():hvd.rank() + 1], mesh, P(hvd.RANK_AXIS))
+        gy = multihost_utils.host_local_array_to_global_array(
+            ys[hvd.rank():hvd.rank() + 1], mesh, P(hvd.RANK_AXIS))
+        ts, loss = step_fn(ts, gx, gy)
+        params, opt_state = to_host(ts.params), to_host(ts.opt_state)
+        state.step += 1
+        state.params, state.opt_state = params, opt_state
+        state.commit()
+    return float(np.asarray(jax.device_get(loss)))
+
+
+final_loss = train(state)
+print(json.dumps({
+    "final_step": state.step, "size": hvd.size(),
+    "final_loss": final_loss,
+    "final_finite": bool(np.isfinite(final_loss)),
+    "version": os.environ.get("HOROVOD_ELASTIC_WORLD_VERSION"),
+}), flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_sentinel_desync_evicts_minority_and_world_resumes(tmp_path):
+    """Chaos ladder rung 3 end to end: 3 REAL elastic workers; at step 2
+    the ``desync`` fault shifts rank 2's parameter replica by a finite
+    eps (a silent-data-corruption stand-in — isfinite and grad-norm see
+    nothing). The per-rank fingerprint lane exposes the divergence, every
+    rank votes the strict minority (rank 2) corrupt, rank 2 exits
+    EVICT_EXIT_CODE, the driver bans its host and relaunches the
+    generation at np=2, and the survivors resume from the last
+    blake2b-verified commit with the world version advanced."""
+    import json as _json
+    from horovod_tpu.elastic import constants as C
+    disco = tmp_path / "discover.sh"
+    disco.write_text(
+        "#!/bin/sh\necho localhost:1\necho 127.0.0.2:1\necho 127.0.0.3:1\n")
+    disco.chmod(0o755)
+    script = tmp_path / "sentinel_desync_worker.py"
+    script.write_text(SENTINEL_DESYNC_WORKER)
+    r = _run_hvdrun(["-np", "3", "--min-np", "2", "--max-np", "3",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "desync:rank=2,step=2",
+                     sys.executable, str(script)], timeout=420,
+                    env_extra={"HOROVOD_SENTINEL": "1",
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [_json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    # only the surviving generation's 2 ranks reach the final print
+    assert len(lines) == 2, r.stdout
+    for out in lines:
+        assert (out["final_step"], out["size"]) == (6, 2), out
+        assert out["final_finite"], out
+    combined = r.stdout + r.stderr
+    # eviction observed: the minority vote fired and the driver banned the
+    # evicted rank's host (immediate ban, not strike accrual)
+    assert "sentinel: evict" in combined
+    assert "sentinel evict" in combined           # Blacklist.ban reason
+    assert "(np=3)" in combined                   # generation 0
+    assert "(np=2)" in combined                   # relaunched without rank 2
+    # survivors resumed from a commit, not from scratch: generation 1
+    # entered with committed progress (step >= 1)
+    entries = [l for l in combined.splitlines() if l.startswith("GEN-ENTRY")]
+    assert any("step=0 size=3" in e for e in entries), entries
+    resumed = [e for e in entries if "size=2" in e]
+    assert resumed and all("step=0" not in e for e in resumed), entries
+    # the relaunched world carries an ADVANCED version: every size=2 entry
+    # reports a strictly higher generation than every size=3 entry
+    def _ver(e):
+        return int(e.split("version=")[1])
+    assert min(_ver(e) for e in resumed) > max(
+        _ver(e) for e in entries if "size=3" in e), entries

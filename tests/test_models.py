@@ -236,7 +236,8 @@ def test_dlrm_trains_dp_ep():
                 return bce_loss(logits, labels)
             loss, grads = jax.value_and_grad(loss_of)(params)
             updates, opt_state2 = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state2, loss
+            return optax.apply_updates(  # hvd-analyze: ok — test loop
+                params, updates), opt_state2, loss
 
         losses = []
         for _ in range(5):
@@ -279,7 +280,7 @@ def test_dlrm_sparse_step_matches_dense_adagrad():
                             labels)
         loss, g = jax.value_and_grad(loss_of)(p)
         up, st2 = opt.update(g, st, p)
-        return optax.apply_updates(p, up), st2, loss
+        return optax.apply_updates(p, up), st2, loss  # hvd-analyze: ok
 
     # sparse path: tables split out, FLAT [T*R, D] (see
     # sparse_adagrad_update's layout rationale)

@@ -63,7 +63,7 @@ def test_distributed_matches_single_process_large_batch():
     for _ in range(5):
         g = jax.grad(loss_fn)(w_ref, jnp.asarray(X), jnp.asarray(y))
         up, st_ref = opt_ref.update(g, st_ref, w_ref)
-        w_ref = optax.apply_updates(w_ref, up)
+        w_ref = optax.apply_updates(w_ref, up)  # hvd-analyze: ok
 
     # distributed: each rank sees its shard; mean-of-shard-means == full mean
     opt = distributed(optax.sgd(0.05))
@@ -74,7 +74,7 @@ def test_distributed_matches_single_process_large_batch():
         for _ in range(5):
             g = jax.grad(loss_fn)(w, xs, ys)
             up, st = opt.update(g, st, w)
-            w = optax.apply_updates(w, up)
+            w = optax.apply_updates(w, up)  # hvd-analyze: ok
         return w
 
     w_dp = np.asarray(run_sharded(train, jnp.asarray(X), jnp.asarray(y)))

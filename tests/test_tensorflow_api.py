@@ -818,3 +818,34 @@ def test_op_dtype_dim_matrix():
         return True
 
     assert all(run_parallel(n, fn))
+
+
+def test_sentinel_counter_callback_surfaces_counters(monkeypatch):
+    """SentinelCounterCallback merges the numeric-integrity counters
+    (core/sentinel.py) into the keras logs stream as ``sentinel/<k>``
+    keys — and is a no-op when no sentinel is active, so installing it
+    unconditionally is safe."""
+    from horovod_tpu.core import sentinel as sentinel_mod
+    from horovod_tpu.tensorflow.keras import SentinelCounterCallback
+
+    cb = SentinelCounterCallback()
+    monkeypatch.setattr(sentinel_mod, "_active", None)
+    logs = {"loss": 1.0}
+    cb.on_train_batch_end(0, logs)
+    assert logs == {"loss": 1.0}                 # inactive: untouched
+    cb.on_train_batch_end(0, None)               # None logs: no crash
+
+    s = sentinel_mod.Sentinel(max_skips=1, clock=lambda: 0.0)
+    sentinel_mod.install(s)
+    s.steps_skipped = 2
+    s.rollbacks = 1
+    cb.on_train_batch_end(1, logs)
+    assert logs["sentinel/steps_skipped"] == 2
+    assert logs["sentinel/rollbacks"] == 1
+    assert logs["sentinel/evictions"] == 0
+    assert logs["sentinel/last_fingerprint_mismatch_step"] == -1
+    # user-provided keys win over the merge (setdefault semantics)
+    epoch_logs = {"sentinel/steps_skipped": 99}
+    cb.on_epoch_end(0, epoch_logs)
+    assert epoch_logs["sentinel/steps_skipped"] == 99
+    monkeypatch.setattr(sentinel_mod, "_active", None)

@@ -51,7 +51,7 @@ def test_dp_matches_single_device(data):
             return xent(out, labels), mut["batch_stats"]
         (l, stats), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params = optax.apply_updates(params, updates)  # hvd-analyze: ok
         losses_ref.append(float(l))
 
     # --- DP over 8 devices, same global batch (2 images per rank) ---
