@@ -35,7 +35,83 @@ try:
 except ImportError:
     from jax.experimental.shard_map import shard_map
 
-from common import emit, on_tpu, slope_time, sync
+from common import (emit, median_ratio, on_tpu, slope_time,
+                    slope_time_paired, sync)
+
+
+def sweep_fusion():
+    """``--sweep-fusion``: interleaved HOROVOD_FUSION_THRESHOLD sweep.
+
+    Times a grouped (fused) gradient-shaped allreduce — a pytree of mixed
+    leaf sizes mimicking a model's grads — under 2–3 bucket sizes applied
+    via ``fusion_threshold_override`` at trace time, INTERLEAVED through
+    ``slope_time_paired`` (±10% tunnel-noise trap: never time arms in
+    separate blocks). Prints a per-size ratio table against the uncapped
+    single-buffer arm so bucket tuning is a reproducible artifact instead
+    of folklore. In this chained microbench the collectives have no
+    backward compute to hide behind — the table isolates the pure
+    bucketing overhead (launch/rendezvous per bucket); overlap GAINS show
+    up in the train-step A/B (profile_resnet.py on the CPU mesh,
+    benchmarks/resnet.py on chip).
+    """
+    import horovod_tpu as hvd
+    from horovod_tpu.collectives import ops
+    from horovod_tpu.collectives.ops import fusion_threshold_override
+    smap = jax.shard_map  # compat-shimmed (check_vma) only AFTER hvd import
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+    axis = hvd.RANK_AXIS
+    if n == 1:
+        emit("fusion_sweep", 0.0, "skipped (1 rank)")
+        return
+    # Gradient-shaped tree: a few big leaves + a tail of small ones
+    # (the realistic shape: conv/matmul kernels + biases/norm scales).
+    if on_tpu():
+        big, small, n_small = 4 << 20, 16 << 10, 24   # ~17 MB/device
+        thresholds = [("uncapped", 1 << 62), ("4mb", 4 << 20),
+                      ("256kb", 256 << 10)]
+    else:
+        big, small, n_small = 256 << 10, 4 << 10, 12  # CPU mesh: ~1.1 MB
+        thresholds = [("uncapped", 1 << 62), ("64kb", 64 << 10),
+                      ("8kb", 8 << 10)]
+    leaves = [jnp.ones((big // 4,), jnp.float32) for _ in range(4)] + \
+             [jnp.ones((small // 4,), jnp.float32) for _ in range(n_small)]
+    total_mb = sum(l.size * 4 for l in leaves) / (1 << 20)
+
+    def make_run(thr):
+        def chained(k):
+            def fn(tree):
+                def one(c, _):
+                    return ops.grouped_allreduce(c, ops.Sum), ()
+                c, _ = lax.scan(one, tree, None, length=k)
+                return c
+            # Leaves replicated (P() prefix-broadcasts over the tree):
+            # grads are replicated per-device in the DP step too.
+            return jax.jit(smap(
+                fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False))
+        with fusion_threshold_override(thr):
+            fns = {k: chained(k) for k in (2, 8)}
+            for f in fns.values():
+                sync(f(leaves))  # compile under the override
+
+        def run(k):
+            sync(fns[k](leaves))
+        return run
+
+    runs = {name: make_run(thr) for name, thr in thresholds}
+    times, rounds = slope_time_paired(runs, s_short=2, s_long=8,
+                                      return_rounds=True)
+    print(f"\nfusion sweep: {len(leaves)} leaves, {total_mb:.1f} MB/device, "
+          f"{n} ranks (ratio >1 = faster than uncapped)")
+    print(f"{'threshold':<10} {'ms/allreduce':>14} {'ratio_vs_uncapped':>19}")
+    for name, _ in thresholds:
+        ratio = median_ratio(rounds, "uncapped", name)
+        print(f"{name:<10} {times[name]*1e3:>14.3f} {ratio:>19.3f}")
+        emit(f"fusion_sweep_{name}", times[name] * 1e3, "ms/op",
+             ratio if name != "uncapped" else None)
 
 
 def main():
@@ -113,4 +189,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--sweep-fusion" in sys.argv:
+        sweep_fusion()
+    else:
+        main()

@@ -23,7 +23,8 @@ import optax
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import make_categorize, parse_xplane, report  # noqa: E402
+from xprof import (collective_overlap, make_categorize,  # noqa: E402
+                   parse_xplane, report)
 
 STEPS = 8  # one scan: enough occurrences to average per-op time
 
@@ -91,7 +92,8 @@ def main():
     report(f"bert_profile_b{per_chip}", totals, counts, wall_ps,
            async_ps, STEPS,
            categorize=make_categorize(extra),
-           extra_json={"batch": batch, "seq": seq})
+           extra_json={"batch": batch, "seq": seq},
+           overlap=collective_overlap(logdir))
 
 
 if __name__ == "__main__":

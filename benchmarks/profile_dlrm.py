@@ -22,7 +22,8 @@ import optax
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import make_categorize, parse_xplane, report  # noqa: E402
+from xprof import (collective_overlap, make_categorize,  # noqa: E402
+                   parse_xplane, report)
 
 STEPS = 8
 
@@ -124,7 +125,8 @@ def main():
            async_ps, STEPS,
            categorize=make_categorize(extra),
            extra_json={"batch": B, "tables": cfg.num_tables,
-                       "rows": R, "embed_dim": Dm})
+                       "rows": R, "embed_dim": Dm},
+           overlap=collective_overlap(logdir))
 
 
 if __name__ == "__main__":

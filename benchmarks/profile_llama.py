@@ -21,7 +21,8 @@ import optax
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import make_categorize, parse_xplane, report  # noqa: E402
+from xprof import (collective_overlap, make_categorize,  # noqa: E402
+                   parse_xplane, report)
 
 STEPS = 8
 
@@ -86,7 +87,8 @@ def main():
     cat = make_categorize(extra)
     report(f"llama_profile_b{per_chip}", totals, counts, wall_ps,
            async_ps, STEPS, categorize=cat,
-           extra_json={"batch": batch, "seq": seq})
+           extra_json={"batch": batch, "seq": seq},
+           overlap=collective_overlap(logdir))
 
     # r5 (VERDICT r4 #3): NAME the gather/scatter slice — dump the top
     # instructions in that category with enough of the instruction text

@@ -29,7 +29,8 @@ import optax
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))  # repo root (horovod_tpu pkg)
 sys.path.insert(0, _here)
-from xprof import make_categorize, parse_xplane, report  # noqa: E402
+from xprof import (collective_overlap, make_categorize,  # noqa: E402
+                   parse_xplane, report)
 
 STEPS = 8
 
@@ -123,7 +124,8 @@ def main():
     report(f"mixtral_profile_b{per_chip}", totals, counts, wall_ps,
            async_ps, STEPS,
            categorize=make_categorize(extra),
-           extra_json={"batch": batch, "seq": seq, "capacity": C})
+           extra_json={"batch": batch, "seq": seq, "capacity": C},
+           overlap=collective_overlap(logdir))
 
 
 if __name__ == "__main__":

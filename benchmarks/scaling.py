@@ -52,6 +52,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# CPU op-level trace events (for the overlap record) need the thunk-runtime
+# flag armed BEFORE the backend initializes — common.py pins it on import.
+from xprof import collective_overlap, ensure_cpu_op_events  # noqa: E402
+
+ensure_cpu_op_events()
+
 from common import median_ratio, slope_time_paired, sync  # noqa: E402  (sets backend)
 
 import jax  # noqa: E402
@@ -274,11 +280,29 @@ def main():
         "vs_baseline": round(eff_g, 4),
         "noise": _ratio_stats(rounds, "lplain8", "gspmd8"),
     }
-    for r in (rec, rec_h, rec_g):
+    # Overlap fraction of the dp8 arm's collectives (the ISSUE 6 metric,
+    # docs/fusion.md): recorded alongside the efficiency series so a
+    # scheduling regression (bucketed overlap collapsing toward 0) is
+    # visible round-over-round without real hardware. None when the trace
+    # carries no collective op events (e.g. a backend without per-op
+    # tracing) — recorded as such rather than faked.
+    import tempfile
+    logdir = tempfile.mkdtemp(prefix="scaling_ovl_")
+    with jax.profiler.trace(logdir):
+        run_dp(S_SHORT)
+    ovl = collective_overlap(logdir)
+    rec_o = {
+        "metric": "dp8_overlap_fraction",
+        "value": ovl["overlap_fraction"],
+        "unit": f"hidden/total collective ms in a traced {S_SHORT}-step "
+                "dp8 scan; docs/fusion.md",
+        "overlap": ovl,
+    }
+    for r in (rec, rec_h, rec_g, rec_o):
         print(json.dumps(r))
     if os.environ.get("HOROVOD_SCALING_NO_HISTORY", "").lower() \
             not in ("1", "true"):
-        _append_history([rec, rec_h, rec_g])
+        _append_history([rec, rec_h, rec_g, rec_o])
 
 
 def _ratio_stats(rounds, num, den) -> dict:

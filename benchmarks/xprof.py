@@ -16,11 +16,35 @@ regex) pairs matched against the instruction text, e.g. to tell a
 expert matmul.
 """
 
+import bisect
 import collections
 import glob
 import json
 import os
 import re
+
+#: XLA:CPU only emits per-op trace events under the thunk runtime; without
+#: this flag the host plane holds nothing but client-infra spans and the
+#: overlap metric has no events to intersect. Call :func:`ensure_cpu_op_events`
+#: BEFORE importing jax when profiling on the CPU mesh. (TPU device planes
+#: always carry "XLA Ops"; the flag is never needed — or set — there.)
+CPU_THUNK_FLAG = "--xla_cpu_use_thunk_runtime=true"
+
+
+def ensure_cpu_op_events():
+    """Arm per-op CPU trace events (no-op unless JAX_PLATFORMS selects cpu).
+
+    Must run before jax parses XLA_FLAGS (i.e. before the first backend
+    touch); safe to call unconditionally at the top of a profile script."""
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_use_thunk_runtime" not in flags:
+        # Appends only CPU_THUNK_FLAG, vetted on this image's CPU backend
+        # (and unreachable under the TPU backend — gated above).
+        os.environ["XLA_FLAGS"] = (  # hvd-analyze: ok
+            flags + " " + CPU_THUNK_FLAG).strip()
+
 
 _BASE_CATEGORIES = [
     ("convolution", re.compile(r"convolution|conv\d|^conv")),
@@ -76,6 +100,117 @@ def parse_xplane(logdir):
     return totals, counts, plane_names, wall_ps, async_total
 
 
+_COLLECTIVE_RE = re.compile(
+    r"all-reduce|all_reduce|reduce-scatter|reduce_scatter|all-gather|"
+    r"all_gather|all-to-all|all_to_all|collective-permute|collective")
+#: CPU thunk events are bare HLO op names ("dot.3", "all-reduce.1");
+#: anything with spaces/colons is client infra (ExecuteHelper, listeners).
+_CPU_OP_RE = re.compile(r"^%?[A-Za-z][\w.\-]*$")
+_UMBRELLAS = ("while", "tuple.", "jit_")
+
+
+def _merge(intervals):
+    """Sorted union of (start, end) intervals."""
+    intervals.sort()
+    merged = []
+    for s, e in intervals:
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return merged
+
+
+def _hidden_ps(collective, compute_union):
+    """Σ over collective intervals of their intersection with the union."""
+    starts = [m[0] for m in compute_union]
+    hidden = 0
+    for s, e in collective:
+        i = max(bisect.bisect_right(starts, s) - 1, 0)
+        while i < len(compute_union) and compute_union[i][0] < e:
+            hidden += max(
+                0, min(e, compute_union[i][1]) - max(s, compute_union[i][0]))
+            i += 1
+    return hidden
+
+
+def _plane_op_intervals(plane):
+    """(collective, compute) interval lists for one plane, or None when the
+    plane carries no XLA op events. TPU device planes: "XLA Ops" is the
+    serial per-core line and "Async XLA Ops" holds the overlapped DMA spans
+    (collective by construction — they only exist for async collectives
+    and their intersection with the compute line IS the hidden time). CPU
+    host plane (thunk runtime): every executor thread line carries bare
+    HLO-op-name events; umbrellas and infra spans are dropped."""
+    is_tpu = "/device:TPU" in plane.name
+    is_cpu = plane.name == "/host:CPU"
+    if not (is_tpu or is_cpu):
+        return None
+    meta = plane.event_metadata
+    coll, comp = [], []
+    for line in plane.lines:
+        if is_tpu and line.name not in ("XLA Ops", "Async XLA Ops"):
+            continue
+        if is_cpu and line.name == "python":
+            continue
+        force_coll = is_tpu and line.name == "Async XLA Ops"
+        for ev in line.events:
+            if ev.duration_ps <= 0:
+                continue
+            name = meta[ev.metadata_id].name if ev.metadata_id in meta else ""
+            stripped = name.lstrip("%")
+            if stripped.startswith(_UMBRELLAS):
+                continue
+            if is_cpu and not _CPU_OP_RE.match(name):
+                continue
+            iv = (ev.offset_ps, ev.offset_ps + ev.duration_ps)
+            if force_coll or _COLLECTIVE_RE.search(stripped.lower()):
+                coll.append(iv)
+            else:
+                comp.append(iv)
+    if not coll and not comp:
+        return None
+    return coll, comp
+
+
+def collective_overlap(logdir):
+    """Overlap-fraction metric: what share of the step's collective time is
+    hidden behind compute, from the newest xplane.pb under ``logdir``.
+
+    Per device plane (TPU cores; the whole /host:CPU plane on the CPU
+    mesh), collective op spans are intersected with the union of compute op
+    spans: a monolithic post-backward allreduce sits in a compute-silent
+    window (fraction → 0), while reverse-layer buckets run while backward
+    still produces the remaining grads (fraction → 1). Returns
+    ``{"collective_ms", "hidden_ms", "exposed_ms", "overlap_fraction",
+    "n_collective_events"}``; ``overlap_fraction`` is None when the trace
+    holds no collective spans."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    total = hidden = n_coll = 0
+    for plane in space.planes:
+        ivs = _plane_op_intervals(plane)
+        if ivs is None:
+            continue
+        coll, comp = ivs
+        n_coll += len(coll)
+        total += sum(e - s for s, e in coll)
+        hidden += _hidden_ps(coll, _merge(comp))
+    return {
+        "collective_ms": round(total / 1e9, 3),
+        "hidden_ms": round(hidden / 1e9, 3),
+        "exposed_ms": round((total - hidden) / 1e9, 3),
+        "overlap_fraction": (round(hidden / total, 4) if total else None),
+        "n_collective_events": n_coll,
+    }
+
+
 def short_name(name):
     """'%loop_fusion.12 = bf16[...] fusion(...)' -> 'loop_fusion.12'"""
     return name.split(" = ")[0].lstrip("%")
@@ -99,9 +234,10 @@ def make_categorize(extra=()):
 
 
 def report(metric, totals, counts, wall_ps, async_ps, steps, *,
-           categorize=None, extra_json=None, top_k=25):
+           categorize=None, extra_json=None, top_k=25, overlap=None):
     """Print the top-K table + category rollup + one JSON line; returns
-    the rollup dict {category: share}."""
+    the rollup dict {category: share}. ``overlap`` is an optional
+    :func:`collective_overlap` result folded into the print + JSON."""
     from common import peak_flops
     import numpy as np
     categorize = categorize or make_categorize()
@@ -110,6 +246,11 @@ def report(metric, totals, counts, wall_ps, async_ps, steps, *,
           f"{wall_ps/1e9/steps:.2f} ms/step; leaf-op occupancy "
           f"{grand/1e9:.1f} ms ({grand/max(wall_ps,1):.0%}); async DMA "
           f"span-sum {async_ps/1e9:.1f} ms (overlap, not occupancy)")
+    if overlap is not None and overlap.get("overlap_fraction") is not None:
+        print(f"overlap fraction: {overlap['overlap_fraction']:.3f} "
+              f"({overlap['hidden_ms']:.1f} of {overlap['collective_ms']:.1f}"
+              f" ms collective hidden behind compute; "
+              f"{overlap['exposed_ms']:.1f} ms exposed)")
     print(f"\n{'op':<52} {'category':<22} {'ms':>8} {'share':>7} {'n':>5}")
     rows = []
     for name, ps in totals.most_common(top_k):
@@ -135,6 +276,8 @@ def report(metric, totals, counts, wall_ps, async_ps, steps, *,
            "top": rows[:10]}
     if np.isfinite(peak):
         out["peak_tflops"] = round(peak / 1e12, 1)
+    if overlap is not None:
+        out["overlap"] = overlap
     if extra_json:
         out.update(extra_json)
     print("\n" + json.dumps(out))

@@ -39,6 +39,14 @@ CLAUDE.md "Environment traps"):
   in one step.  Guard with ``core/sentinel.py``'s health vector (or an
   explicit ``jnp.isfinite`` check), or pragma deliberate throwaway
   loops.
+- ``lint-monolithic-psum`` (WARNING): a gradient-computing train step
+  that reduces its grads leaf-by-leaf via ``tree_map(lambda g:
+  lax.psum(g, ...), grads)`` — one collective per leaf, in pytree
+  (first-layer-first) order.  The grouped/fused path
+  (``collectives.ops.grouped_allreduce``) packs leaves into
+  reverse-layer buckets sized by ``HOROVOD_FUSION_THRESHOLD`` so the
+  allreduce overlaps the backward; per-leaf psums forfeit both the
+  fusion and the overlap (docs/fusion.md).
 
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
@@ -73,9 +81,36 @@ GUARD_TOKENS = frozenset({
     "isfinite", "grads_finite", "health_vector", "all_finite",
 })
 
+# lint-monolithic-psum vocabulary: the per-leaf mesh reductions whose
+# tree-mapped form forfeits the fused/bucketed collective path.
+LEAF_REDUCE_NAMES = frozenset({"psum", "pmean"})
+
 
 def _is_guard_token(tok: str) -> bool:
     return tok in GUARD_TOKENS or "sentinel" in tok.lower()
+
+
+def _is_tree_map(name: str) -> bool:
+    """jax.tree_util.tree_map / jax.tree.map / bare tree_map."""
+    return name.split(".")[-1] == "tree_map" or name.endswith("tree.map")
+
+
+def _maps_leafwise_reduce(fn_arg) -> bool:
+    """True when a tree_map's function argument reduces each leaf over a
+    mesh axis: a lambda whose body calls psum/pmean, a direct psum/pmean
+    reference, or a functools.partial over one."""
+    if isinstance(fn_arg, ast.Lambda):
+        return any(
+            isinstance(sub, ast.Call)
+            and _dotted(sub.func).split(".")[-1] in LEAF_REDUCE_NAMES
+            for sub in ast.walk(fn_arg.body))
+    if isinstance(fn_arg, (ast.Attribute, ast.Name)):
+        return _dotted(fn_arg).split(".")[-1] in LEAF_REDUCE_NAMES
+    if isinstance(fn_arg, ast.Call) \
+            and _dotted(fn_arg.func).split(".")[-1] == "partial" \
+            and fn_arg.args:
+        return _dotted(fn_arg.args[0]).split(".")[-1] in LEAF_REDUCE_NAMES
+    return False
 
 
 # Directory names never linted (fixture corpora are known-bad on purpose).
@@ -121,6 +156,9 @@ class _Lint(ast.NodeVisitor):
         # to an inner (gradient-computing) function — enclosing functions
         # must not re-flag them.
         self._apply_handled: set = set()
+        # lint-monolithic-psum: same innermost-first attribution for
+        # tree-mapped per-leaf psum sites.
+        self._monolithic_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -295,6 +333,7 @@ class _Lint(ast.NodeVisitor):
         # an apply site is attributed to the SMALLEST enclosing function
         # that also computes gradients — the actual train-step body.
         self._check_unguarded_apply(node)
+        self._check_monolithic_psum(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -327,6 +366,34 @@ class _Lint(ast.NodeVisitor):
                     "allreduce spreads it to every replica); guard with "
                     "core/sentinel.py's health_vector or jnp.isfinite, "
                     "or pragma a deliberate throwaway loop")
+
+    def _check_monolithic_psum(self, node):
+        """lint-monolithic-psum: a gradient-computing step reducing its
+        grads leaf-by-leaf with a tree-mapped psum/pmean — one collective
+        per leaf instead of the grouped/fused bucketed path."""
+        sites, has_grad = [], False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _dotted(sub.func)
+            if name.split(".")[-1] in GRAD_CALL_NAMES:
+                has_grad = True
+            elif _is_tree_map(name) and sub.args \
+                    and id(sub) not in self._monolithic_handled \
+                    and _maps_leafwise_reduce(sub.args[0]):
+                sites.append(sub)
+        if not sites or not has_grad:
+            return  # stat-sync tree_maps outside a grad step are fine
+        for call in sites:
+            self._monolithic_handled.add(id(call))
+            self._add(
+                "lint-monolithic-psum", Severity.WARNING, call,
+                "gradients reduced leaf-by-leaf with a tree-mapped "
+                "psum/pmean: one collective per pytree leaf, forfeiting "
+                "HOROVOD_FUSION_THRESHOLD bucketing and the backward "
+                "overlap it buys; reduce the whole tree through "
+                "collectives.ops.grouped_allreduce (or "
+                "hierarchical_allreduce) instead — see docs/fusion.md")
 
     # -- file-level checks ---------------------------------------------
 

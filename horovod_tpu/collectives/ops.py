@@ -10,9 +10,12 @@ thing the reference's ``tensorflow/xla_mpi_ops.cc`` CustomCall explicitly
 could not do (it had to escape the graph via host callback; SURVEY.md §3.5).
 
 Fusion: the reference's fusion buffer + cycle-time batching is replaced by
-(a) XLA's collective combiner (configured from ``HOROVOD_FUSION_THRESHOLD``,
-see core/config.py) and (b) ``grouped_*`` ops which concatenate flat buffers
-explicitly — a compile-time fusion buffer with zero host involvement.
+``grouped_*`` ops which pack leaves into explicit flat buckets sized by
+``HOROVOD_FUSION_THRESHOLD`` (``_fused_reduce``) — a compile-time fusion
+buffer with zero host involvement, emitted in reverse-layer order so XLA's
+latency-hiding scheduler overlaps the first buckets' collectives with the
+still-running backward (docs/fusion.md). XLA's own collective combiner
+remains available as an opt-in (``HOROVOD_FUSION_APPLY_XLA_FLAGS``).
 
 Process sets lower to ``axis_index_groups`` — a partitioned ICI collective
 instead of the reference's per-set NCCL communicator (§2.1 process_set.cc).
@@ -269,53 +272,73 @@ def _fused_reduce(tensors, compression: Compressor, reduce_flat,
     ``hierarchical_allreduce``. ``member`` (traced bool) restores each
     non-member leaf to its input (process-set passthrough semantics).
 
-    ``max_bucket_bytes`` caps each collective's payload — the in-graph
+    ``max_bucket_bytes`` sizes the SCHEDULED buckets — the in-graph
     rendering of ``HOROVOD_FUSION_THRESHOLD`` (the reference's fusion-buffer
-    size, fusion_buffer_manager.cc): a buffer larger than the cap is split
-    into several independent collectives, which XLA's scheduler can overlap
-    with the producing backward computation; one giant buffer serializes
-    behind its last producer. This is the knob the transparent autotuner
-    (tools/autotune.py) searches.
+    size, fusion_buffer_manager.cc + its cycle-time batching): leaves are
+    greedily packed into per-dtype buckets walking the flatten order IN
+    REVERSE, because gradient pytrees flatten roughly first-layer-first
+    while backward produces the LAST layer's grads first — so each bucket's
+    producers are an early prefix of backward and XLA's latency-hiding
+    scheduler can fly the first buckets' collectives while the rest of
+    backward is still running. One giant buffer (the uncapped path)
+    serializes behind its LAST producer — the first layer's dW, i.e. the
+    very end of backward. A single leaf larger than the cap forms its own
+    bucket unsplit (reference semantics: tensors over the fusion-buffer
+    size go as one op — splitting one producer's payload buys no overlap).
+    This is the knob the transparent autotuner (tools/autotune.py) searches
+    and ``benchmarks/collectives.py --sweep-fusion`` sweeps.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tensors)
     if not leaves:
         return tensors
     compressed = [compression.compress(x) for x in leaves]
+
+    def finish(i, y_flat):
+        cx, cctx = compressed[i]
+        y = compression.decompress(y_flat.reshape(cx.shape), cctx)
+        if member is not None:
+            y = jnp.where(member, y, leaves[i])
+        return y
+
     if max_bucket_bytes == 0:
         # Fusion disabled (HOROVOD_FUSION_THRESHOLD=0, reference semantics):
         # one collective per tensor.
-        out0: List[Any] = []
-        for i, (cx, cctx) in enumerate(compressed):
-            y = compression.decompress(
-                reduce_flat(cx.ravel()).reshape(cx.shape), cctx)
-            if member is not None:
-                y = jnp.where(member, y, leaves[i])
-            out0.append(y)
-        return jax.tree_util.tree_unflatten(treedef, out0)
-    buckets: dict = {}
-    for i, (cx, _) in enumerate(compressed):
-        buckets.setdefault(cx.dtype, []).append(i)
+        return jax.tree_util.tree_unflatten(
+            treedef, [finish(i, reduce_flat(cx.ravel()))
+                      for i, (cx, _) in enumerate(compressed)])
     out: List[Any] = [None] * len(leaves)
-    for dtype, idxs in buckets.items():
+    if max_bucket_bytes:
+        # Scheduled bucketing: greedy reverse-order per-dtype packing.
+        cap = int(max_bucket_bytes)
+        bucket_idxs: List[List[int]] = []
+        open_bucket: dict = {}  # dtype -> (bucket position, bytes packed)
+        for i in reversed(range(len(leaves))):
+            cx = compressed[i][0]
+            nbytes = cx.size * cx.dtype.itemsize
+            cur = open_bucket.get(cx.dtype)
+            if cur is not None and cur[1] + nbytes <= cap:
+                bucket_idxs[cur[0]].append(i)
+                open_bucket[cx.dtype] = (cur[0], cur[1] + nbytes)
+            else:
+                bucket_idxs.append([i])
+                open_bucket[cx.dtype] = (len(bucket_idxs) - 1, nbytes)
+    else:
+        # Uncapped (no context / explicit None): one buffer per dtype.
+        per_dtype: dict = {}
+        for i, (cx, _) in enumerate(compressed):
+            per_dtype.setdefault(cx.dtype, []).append(i)
+        bucket_idxs = list(per_dtype.values())
+    for idxs in bucket_idxs:
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = finish(i, reduce_flat(compressed[i][0].ravel()))
+            continue
         flat = jnp.concatenate([compressed[i][0].ravel() for i in idxs])
-        red = None
-        if max_bucket_bytes:
-            step = max(1, int(max_bucket_bytes) // flat.dtype.itemsize)
-            if flat.size > step:
-                red = jnp.concatenate(
-                    [reduce_flat(flat[s:s + step])
-                     for s in range(0, flat.size, step)])
-        if red is None:
-            red = reduce_flat(flat)
+        red = reduce_flat(flat)
         off = 0
         for i in idxs:
-            cx, cctx = compressed[i]
-            sz = cx.size
-            y = compression.decompress(red[off:off + sz].reshape(cx.shape),
-                                       cctx)
-            if member is not None:
-                y = jnp.where(member, y, leaves[i])
-            out[i] = y
+            sz = compressed[i][0].size
+            out[i] = finish(i, red[off:off + sz])
             off += sz
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -399,7 +422,8 @@ def _hierarchical_axes(axis, process_set, op: str):
 
 def _hier_reduce_flat(flat, op: str, intra_axis: str, cross_axes,
                       n_total: int, prescale_factor: float,
-                      postscale_factor: float):
+                      postscale_factor: float,
+                      cross_compression: Optional[Compressor] = None):
     """Hierarchical sum/average of a flat 1-D buffer: reduce-scatter over the
     ICI axis → allreduce over the DCN axes → allgather back over ICI.
 
@@ -408,6 +432,14 @@ def _hier_reduce_flat(flat, op: str, intra_axis: str, cross_axes,
     reference's reason for HOROVOD_HIERARCHICAL_ALLREDUCE — keep the
     bandwidth-hungry phase on the fast fabric. Average divides on the shard,
     before the gather, so the scale runs on 1/n_intra of the elements.
+
+    ``cross_compression`` casts ONLY the cross-slice payload to the wire
+    dtype around the cross ``psum`` (reference: compression.py's wire cast,
+    applied where bytes are scarce — DCN). The ICI reduce-scatter, the
+    Average divide, and the ICI all-gather stay full-precision: the lossy
+    adds are bounded by n_cross − 1 (typically 1–3 slices), while the
+    n_intra-way accumulate — where bf16 error would actually compound —
+    keeps f32. Halves the DCN bytes for f32 gradients.
     """
     if prescale_factor != 1.0:
         flat = flat * prescale_factor
@@ -418,7 +450,12 @@ def _hier_reduce_flat(flat, op: str, intra_axis: str, cross_axes,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     shard = lax.psum_scatter(flat, intra_axis, scatter_dimension=0,
                              tiled=True)
-    shard = lax.psum(shard, cross_axes)
+    if cross_compression is not None:
+        wire, cctx = cross_compression.compress(shard)
+        wire = lax.psum(wire, cross_axes)
+        shard = cross_compression.decompress(wire, cctx)
+    else:
+        shard = lax.psum(shard, cross_axes)
     if op == Average:
         shard = shard / n_total
     if postscale_factor != 1.0:
@@ -427,9 +464,19 @@ def _hier_reduce_flat(flat, op: str, intra_axis: str, cross_axes,
     return out[:sz] if pad else out
 
 
+def _cross_compressor() -> Optional[Compressor]:
+    """The config-engaged DCN-hop compressor
+    (``HOROVOD_HIERARCHICAL_COMPRESSION``: none | bf16 | fp16), or None."""
+    if not _ctx.is_initialized():
+        return None
+    name = getattr(_ctx.context().config, "hierarchical_compression", "none")
+    return {"bf16": Compression.bf16, "fp16": Compression.fp16}.get(name)
+
+
 def hierarchical_allreduce(tensor: Any, op: str = Average, *,
                            intra_axis: str, cross_axes,
                            compression: Compressor = Compression.none,
+                           cross_compression: Optional[Compressor] = None,
                            prescale_factor: float = 1.0,
                            postscale_factor: float = 1.0) -> Any:
     """Explicit two-level allreduce over a (cross, intra) mesh decomposition.
@@ -443,17 +490,27 @@ def hierarchical_allreduce(tensor: Any, op: str = Average, *,
     config flag is set and the rank axis is a multi-axis tuple; call this
     directly to force the shape regardless of the flag. All leaves fuse into
     per-dtype flat buffers (one collective sequence per dtype).
+
+    ``cross_compression`` (default: resolve ``HOROVOD_HIERARCHICAL_
+    COMPRESSION`` from the context config) casts only the cross-slice (DCN)
+    hop's payload to the wire dtype — see ``_hier_reduce_flat``. Pass
+    ``Compression.none`` to force it off regardless of config.
     """
     if op not in (Sum, Average):
         raise ValueError("hierarchical allreduce supports Sum and Average; "
                          f"got {op!r}")
     cross = tuple(cross_axes) if isinstance(cross_axes, (tuple, list)) \
         else (cross_axes,)
+    if cross_compression is None:
+        cross_compression = _cross_compressor()
+    elif cross_compression is Compression.none:
+        cross_compression = None
     n_total = lax.axis_size((*cross, intra_axis))
     return _fused_reduce(
         tensor, compression,
         lambda flat: _hier_reduce_flat(flat, op, intra_axis, cross, n_total,
-                                       prescale_factor, postscale_factor),
+                                       prescale_factor, postscale_factor,
+                                       cross_compression=cross_compression),
         max_bucket_bytes=_fusion_threshold())
 
 

@@ -89,6 +89,12 @@ class Config:
     cache_verify_every: int = 0  # full-header audit every k-th occurrence
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # Wire compression for the hierarchical allreduce's CROSS-slice (DCN)
+    # hop only: "none" | "bf16" | "fp16". The ICI reduce-scatter/all-gather
+    # and the accumulate stay full-precision — only the scarce-axis payload
+    # is cast (reference: HOROVOD_COMPRESSION + compression.py fp16, applied
+    # here to the one hop where bytes are expensive).
+    hierarchical_compression: str = "none"
     # Observability. Reference: timeline.cc, stall_inspector.cc.
     timeline_path: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -137,6 +143,8 @@ class Config:
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool(
                 "HOROVOD_HIERARCHICAL_ALLGATHER", False),
+            hierarchical_compression=os.environ.get(
+                "HOROVOD_HIERARCHICAL_COMPRESSION", "none").lower() or "none",
             timeline_path=timeline,
             timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE", False),

@@ -22,11 +22,13 @@ spirit of this framework); run it inside ``shard_map`` over the pp axis.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..collectives import ops as _ops
 
 
 def pipeline(stage_fn: Callable, stage_params, x_microbatches,
@@ -71,7 +73,8 @@ def pipeline(stage_fn: Callable, stage_params, x_microbatches,
 
 
 def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
-                            axis_name: str):
+                            axis_name: str,
+                            dp_axis_name: Optional[str] = None):
     """Build ``vg(stage_params, x_microbatches, targets) -> (loss, grads)``
     for pipeline TRAINING inside ``shard_map`` over ``axis_name``.
 
@@ -82,6 +85,15 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
     AD through the scan + ppermute chain (the derived backward pipeline).
     Apply any optax update per-rank; no cross-stage averaging is wanted —
     stages are different parameters, not replicas.
+
+    ``dp_axis_name`` is the DP×PP seam: on a 2-axis (dp, pp) mesh each
+    stage's parameters ARE replicas along dp, so pass the dp axis and the
+    stage gradients are averaged over it through the grouped/fused
+    collective path (reverse-layer buckets sized by
+    ``HOROVOD_FUSION_THRESHOLD`` — same overlap machinery as the pure-DP
+    step). The reduce happens strictly AFTER differentiation: a psum
+    inside ``loss_of`` would seed one cotangent per device and scale
+    every gradient by the axis size (the cotangent trap).
     """
     def vg(stage_params, x_microbatches, targets):
         n = lax.axis_size(axis_name)
@@ -98,8 +110,13 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable,
             return jnp.where(idx == n - 1, l, jnp.zeros_like(l))
 
         loss, grads = jax.value_and_grad(loss_of)(stage_params)
-        # Replicate the scalar AFTER differentiation.
-        return lax.psum(loss, axis_name), grads
+        # Replicate the scalar / reduce the grads AFTER differentiation.
+        loss = lax.psum(loss, axis_name)
+        if dp_axis_name is not None:
+            grads = _ops.grouped_allreduce(grads, _ops.Average,
+                                           axis_name=dp_axis_name)
+            loss = lax.pmean(loss, dp_axis_name)
+        return loss, grads
 
     return vg
 

@@ -28,6 +28,7 @@ except ImportError:  # pragma: no cover
 from .core import context_api as _ctx
 from .core import sentinel as _sentinel
 from .core.watchdog import monitored_step
+from .collectives import ops as _ops
 from .collectives.ops import effective_axis_size, force_axis_size1
 from .optimizer import broadcast_parameters
 
@@ -154,12 +155,17 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
                     # TrainState is declared replicated (out_specs P()); if
                     # the model's BatchNorm does not itself sync
                     # (axis_name=None), per-device stats would silently
-                    # diverge — pmean makes them truly replicated (a no-op
-                    # when the model already synced them). Skipped on a
-                    # 1-member axis: XLA does not reliably elide
-                    # single-participant all-reduces.
-                    new_stats = jax.tree_util.tree_map(
-                        lambda s: jax.lax.pmean(s, axis), new_stats)
+                    # diverge — averaging makes them truly replicated (a
+                    # no-op when the model already synced them). Routed
+                    # through grouped_allreduce, NOT a per-leaf pmean
+                    # tree_map: the stats ride the same fused/bucketed
+                    # collective path as the gradients (one collective per
+                    # bucket instead of one tiny all-reduce per BN moment —
+                    # the exact pattern lint-monolithic-psum flags).
+                    # Skipped on a 1-member axis: XLA does not reliably
+                    # elide single-participant all-reduces.
+                    new_stats = _ops.grouped_allreduce(
+                        new_stats, _ops.Average, axis_name=axis)
                 if sentinel is not None:
                     # In-graph skip guard: a globally non-finite step must
                     # not touch params/opt_state/stats on ANY rank. The

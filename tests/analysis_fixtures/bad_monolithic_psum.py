@@ -1,0 +1,40 @@
+"""Fixture: lint-monolithic-psum (exactly ONE finding).
+
+A train step that reduces its gradients leaf-by-leaf with a tree-mapped
+``lax.psum`` — one collective per pytree leaf, forfeiting the fused
+path's reverse-layer buckets and the backward overlap they buy. Plus a
+suppressed twin and two clean look-alikes.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.collectives import ops
+
+
+def bad_train_step(params, batch):
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    grads = jax.tree_util.tree_map(  # <- lint-monolithic-psum
+        lambda g: lax.psum(g, "dp"), grads)
+    return loss, grads
+
+
+def suppressed_train_step(params, batch):
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    grads = jax.tree_util.tree_map(  # hvd-analyze: ok
+        lambda g: lax.psum(g, "dp"), grads)
+    return loss, grads
+
+
+def grouped_train_step(params, batch):
+    # The fused path: ONE (bucketed) collective for the whole tree.
+    loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * batch))(params)
+    grads = ops.grouped_allreduce(grads, ops.Average, axis_name="dp")
+    return loss, grads
+
+
+def stat_sync(stats):
+    # Tree-mapped pmean OUTSIDE a gradient step: there is no backward to
+    # overlap with, so this is not the trap; judged clean.
+    return jax.tree_util.tree_map(lambda s: lax.pmean(s, "dp"), stats)

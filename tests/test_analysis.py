@@ -207,6 +207,7 @@ LINT_CASES = [
     ("bad_slope_cadence.py", "lint-slope-cadence", "warning"),
     ("bad_silent_rpc.py", "lint-silent-rpc", "warning"),
     ("bad_unguarded_apply.py", "jax-unguarded-apply", "warning"),
+    ("bad_monolithic_psum.py", "lint-monolithic-psum", "warning"),
 ]
 
 

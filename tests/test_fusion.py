@@ -1,0 +1,242 @@
+"""Scheduled gradient-bucket fusion + DCN-hop wire compression (PR 6).
+
+HLO-level pins for the overlap-and-wire tier (docs/fusion.md): the
+fusion threshold reshapes the DP train step's gradient collective
+stream (reverse-layer buckets → N independent all-reduces, donation
+intact), ``HOROVOD_FUSION_THRESHOLD=0`` disables fusion per reference
+semantics (one collective per tensor), and
+``HOROVOD_HIERARCHICAL_COMPRESSION`` casts ONLY the cross-slice (DCN)
+hop to the wire dtype — proven by operand-byte accounting on the
+lowered program (tests/wire_accounting.py), not timing. Numerics:
+compression round-trips within wire tolerance, integer leaves ride
+untouched, and a compressed-hop training run matches the uncompressed
+losses to bf16 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.collectives import ops
+from horovod_tpu.collectives.compression import Compression
+from horovod_tpu.collectives.ops import fusion_threshold_override
+from horovod_tpu.core.config import Config
+from wire_accounting import collective_wire_costs
+
+
+def _n_allreduce(txt):
+    return txt.count('"stablehlo.all_reduce"')
+
+
+def _mlp_pieces(width=64, depth=4):
+    from flax import linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            for _ in range(depth):
+                x = nn.relu(nn.Dense(width)(x))
+            return nn.Dense(4)(x)
+
+    def loss_fn(out, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, labels).mean()
+
+    return MLP(), loss_fn
+
+
+def _lower_step_text(threshold):
+    """Lowered text of a fresh donated DP train step traced under the
+    given fusion threshold (fresh per call: jit caches lowerings, so an
+    override only matters on the first trace of a given step object)."""
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    model, loss_fn = _mlp_pieces()
+    opt = distributed(optax.sgd(0.1))
+    xs = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    ys = jnp.asarray(np.random.RandomState(1).randint(0, 4, size=(16,)))
+    state = create_train_state(model, jax.random.PRNGKey(0), xs[:2], opt,
+                               broadcast=False)
+    step = make_train_step(model, opt, loss_fn, donate=True)
+    with fusion_threshold_override(threshold):
+        return step.lower(state, xs, ys).as_text()
+
+
+def test_threshold_reshapes_train_step_collectives():
+    """The DP step's gradient allreduce goes out as one fused buffer
+    (uncapped), several independent bucket collectives (capped), or one
+    per tensor (threshold 0) — and buffer donation survives bucketing."""
+    hvd.shutdown()
+    hvd.init()
+    n_mono = _n_allreduce(_lower_step_text(1 << 62))
+    n_buck = _n_allreduce(_lower_step_text(20 << 10))
+    n_per = _n_allreduce(_lower_step_text(0))
+    # 10 grad leaves + the loss pmean: monolithic = 1 + 1.
+    assert n_mono == 2
+    assert n_per == 11
+    # Bucketed sits strictly between: several INDEPENDENT collectives
+    # (each an early-backward prefix's bucket), not one, not per-leaf.
+    assert n_mono < n_buck < n_per, (n_mono, n_buck, n_per)
+
+
+def test_donation_preserved_across_thresholds():
+    hvd.shutdown()
+    hvd.init()
+    for thr in (1 << 62, 20 << 10, 0):
+        txt = _lower_step_text(thr)
+        assert "jax.buffer_donor" in txt or "tf.aliasing_output" in txt, \
+            f"donation lost at threshold {thr}"
+
+
+def _mesh2d():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("cross", "intra"))
+
+
+def _hier_wire_costs(compression_name):
+    m2 = _mesh2d()
+    hvd.shutdown()
+    hvd.init(mesh=m2, config=Config(
+        hierarchical_allreduce=True,
+        hierarchical_compression=compression_name))
+    x = jnp.asarray(np.random.RandomState(5).randn(8, 64).astype(np.float32))
+    f = shard_map(lambda t: ops.allreduce(t, hvd.Sum), mesh=m2,
+                  in_specs=P(("cross", "intra")),
+                  out_specs=P(("cross", "intra")))
+    return collective_wire_costs(jax.jit(f).lower(x).as_text())
+
+
+def test_hierarchical_compression_bf16_cross_hop_only():
+    """HOROVOD_HIERARCHICAL_COMPRESSION=bf16 halves the cross-slice (DCN)
+    all_reduce payload and ONLY that payload: the ICI reduce-scatter and
+    all-gather stay f32-sized."""
+    B = 64 * 4  # per-device payload bytes (f32)
+    off = {c["op"]: c for c in _hier_wire_costs("none")}
+    on = {c["op"]: c for c in _hier_wire_costs("bf16")}
+    assert set(on) == {"reduce_scatter", "all_reduce", "all_gather"}
+
+    # Uncompressed baseline: the cross hop carries B/n_intra in f32.
+    assert off["all_reduce"]["operand_bytes"] == B // 4
+    # Compressed: same element count at 2 bytes — the DCN bytes halve.
+    assert on["all_reduce"]["operand_bytes"] == B // 4 // 2
+    # The ICI phases are untouched in both runs (full-precision psum
+    # accumulate over the 4-way axis; the convert pair wraps ONLY the
+    # cross psum).
+    for hop, key in (("reduce_scatter", "operand_bytes"),
+                     ("all_gather", "result_bytes")):
+        assert off[hop][key] == B
+        assert on[hop][key] == B
+
+
+def test_hierarchical_compression_env_var():
+    """HOROVOD_HIERARCHICAL_COMPRESSION reaches the config (reference env
+    surface: env_parser.cc + HOROVOD_COMPRESSION)."""
+    import os
+    prev = os.environ.get("HOROVOD_HIERARCHICAL_COMPRESSION")
+    os.environ["HOROVOD_HIERARCHICAL_COMPRESSION"] = "bf16"
+    try:
+        assert Config.from_env().hierarchical_compression == "bf16"
+    finally:
+        if prev is None:
+            del os.environ["HOROVOD_HIERARCHICAL_COMPRESSION"]
+        else:
+            os.environ["HOROVOD_HIERARCHICAL_COMPRESSION"] = prev
+
+
+def test_hierarchical_compression_numerics_close():
+    """Compressed-hop allreduce matches the uncompressed result within
+    bf16 wire tolerance: the lossy adds are bounded by n_cross - 1 = 1."""
+    x = np.random.RandomState(7).randn(8, 33).astype(np.float32)
+    outs = {}
+    for name in ("none", "bf16"):
+        m2 = _mesh2d()
+        hvd.shutdown()
+        hvd.init(mesh=m2, config=Config(hierarchical_allreduce=True,
+                                        hierarchical_compression=name))
+        f = shard_map(lambda t: ops.allreduce(t, hvd.Average), mesh=m2,
+                      in_specs=P(("cross", "intra")),
+                      out_specs=P(("cross", "intra")))
+        outs[name] = np.asarray(jax.jit(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(outs["none"], np.broadcast_to(
+        x.mean(0), outs["none"].shape), rtol=1e-5)
+    np.testing.assert_allclose(outs["bf16"], outs["none"],
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_train_losses_match_with_cross_compression():
+    """End-to-end acceptance: 2 training steps over the hierarchical mesh
+    with the DCN hop compressed match the uncompressed losses within bf16
+    tolerance."""
+    from horovod_tpu.optimizer import distributed
+    from horovod_tpu.train import create_train_state, make_train_step
+
+    model, loss_fn = _mlp_pieces(width=16, depth=1)
+    xs = np.random.RandomState(8).randn(16, 8).astype(np.float32)
+    ys = np.random.RandomState(9).randint(0, 4, size=(16,))
+    losses = {}
+    for name in ("none", "bf16"):
+        hvd.shutdown()
+        hvd.init(mesh=_mesh2d(), config=Config(
+            hierarchical_allreduce=True, hierarchical_compression=name))
+        opt = distributed(optax.sgd(0.1))
+        state = create_train_state(model, jax.random.PRNGKey(0), xs[:2],
+                                   opt, broadcast=False)
+        step = make_train_step(model, opt, loss_fn, donate=False)
+        ls = []
+        for _ in range(2):
+            state, loss = step(state, jnp.asarray(xs), jnp.asarray(ys))
+            ls.append(float(loss))
+        losses[name] = ls
+    assert all(np.isfinite(losses["bf16"]))
+    np.testing.assert_allclose(losses["bf16"], losses["none"], rtol=1e-2)
+
+
+# ------------------------------------------------- compressor round trip
+
+@pytest.mark.parametrize("comp,wire,rtol", [
+    (Compression.bf16, jnp.bfloat16, 8e-3),
+    (Compression.fp16, jnp.float16, 1e-3),
+])
+def test_cast_compressor_round_trip_floats(comp, wire, rtol):
+    """compress→decompress restores dtype and value within one wire-dtype
+    rounding step, across magnitudes."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray((rng.randn(257) * np.logspace(-3, 3, 257))
+                    .astype(np.float32))
+    cx, ctx = comp.compress(x)
+    assert cx.dtype == jnp.dtype(wire)
+    assert ctx == jnp.float32
+    y = comp.decompress(cx, ctx)
+    assert y.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=rtol)
+
+
+@pytest.mark.parametrize("comp", [Compression.bf16, Compression.fp16])
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.int8, jnp.bool_])
+def test_cast_compressor_leaves_non_floats_untouched(comp, dtype):
+    x = jnp.asarray([0, 1, 2, 3]).astype(dtype)
+    cx, ctx = comp.compress(x)
+    assert cx is x and ctx is None
+    assert comp.decompress(cx, ctx) is x
+
+
+def test_cast_compressor_skips_noop_cast():
+    """A leaf already at the wire dtype must pass through with ctx=None —
+    no identity astype pair polluting the HLO (the bench-parity
+    byte-identity pin for bf16 models under Compression.bf16)."""
+    x = jnp.ones((8,), jnp.bfloat16)
+    cx, ctx = Compression.bf16.compress(x)
+    assert cx is x and ctx is None
+    assert Compression.bf16.decompress(cx, ctx) is x
+
+    def round_trip(t):
+        c, k = Compression.bf16.compress(t)
+        return Compression.bf16.decompress(c, k) * 1.0
+
+    txt = jax.jit(round_trip).lower(x).as_text()
+    assert txt.count("stablehlo.convert") == 0, txt

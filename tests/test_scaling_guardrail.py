@@ -45,7 +45,9 @@ def test_scaling_guardrail_emits_sane_efficiency():
     # (r2 measured ~1.01 flat, hierarchical similar). Inside the rails
     # but outside the nominal band -> warn: single-run movement there is
     # within the stated noise (see the recorded per-arm "noise" field).
-    for rec in recs.values():
+    for name, rec in recs.items():
+        if not name.endswith("_scaling_efficiency"):
+            continue
         assert HARD_LO <= rec["value"] <= HARD_HI, rec
         noise = rec.get("noise") or {}
         assert noise.get("rounds", 0) >= 3, \
@@ -60,3 +62,10 @@ def test_scaling_guardrail_emits_sane_efficiency():
                 f"{noise.get('spread')} over {noise.get('rounds')} rounds "
                 "— investigate if it persists round-over-round "
                 "(benchmarks/scaling_history.jsonl)")
+    # The overlap record (PR 6, docs/fusion.md) rides the same run: a
+    # fraction in [0, 1], or None when the trace held no collective op
+    # events — either way it must be present in the series.
+    assert "dp8_overlap_fraction" in recs
+    frac = recs["dp8_overlap_fraction"]["value"]
+    assert frac is None or 0.0 <= frac <= 1.0, frac
+    assert "overlap" in recs["dp8_overlap_fraction"]
