@@ -39,6 +39,15 @@ CLAUDE.md "Environment traps"):
   in one step.  Guard with ``core/sentinel.py``'s health vector (or an
   explicit ``jnp.isfinite`` check), or pragma deliberate throwaway
   loops.
+- ``lint-unbounded-poll`` (WARNING): a ``while`` loop that calls the
+  coordinator's ``get_world`` with no pacing anywhere in the loop body —
+  no ``sleep``, no ``wait``/``wait_for``, and no ``wait=`` long-poll
+  bound on the call itself.  One such loop is a busy-wait against a
+  single HTTP service; N of them is the thundering herd the pod-scale
+  protocol exists to prevent (benchmarks/control_plane.py measures the
+  melt).  Pace with an interval + jitter
+  (``HOROVOD_ELASTIC_POLL_JITTER``), or park server-side with
+  ``get_world(wait=...)``.  Bounded ``for`` loops are exempt.
 - ``lint-monolithic-psum`` (WARNING): a gradient-computing train step
   that reduces its grads leaf-by-leaf via ``tree_map(lambda g:
   lax.psum(g, ...), grads)`` — one collective per leaf, in pytree
@@ -84,6 +93,12 @@ GUARD_TOKENS = frozenset({
 # lint-monolithic-psum vocabulary: the per-leaf mesh reductions whose
 # tree-mapped form forfeits the fused/bucketed collective path.
 LEAF_REDUCE_NAMES = frozenset({"psum", "pmean"})
+
+# lint-unbounded-poll vocabulary: the coordinator poll, and the calls
+# that count as pacing a poll loop (a sleep, a condition/event wait, or
+# the server-side long-poll park via get_world(wait=...)).
+POLL_CALL_NAMES = frozenset({"get_world"})
+PACING_CALL_NAMES = frozenset({"sleep", "wait", "wait_for"})
 
 
 def _is_guard_token(tok: str) -> bool:
@@ -159,6 +174,9 @@ class _Lint(ast.NodeVisitor):
         # lint-monolithic-psum: same innermost-first attribution for
         # tree-mapped per-leaf psum sites.
         self._monolithic_handled: set = set()
+        # lint-unbounded-poll: poll sites already attributed to an
+        # enclosing while loop (nested loops must not re-flag them).
+        self._poll_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -287,6 +305,38 @@ class _Lint(ast.NodeVisitor):
             if windows:
                 self.slope_windows.append((node, windows))
 
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        # lint-unbounded-poll: get_world inside a while loop whose body
+        # shows no pacing at all — no sleep, no condition/event wait, and
+        # no wait= long-poll bound on the call. Bounded for loops (the
+        # retry pattern) are exempt; the loop TEST is included in the scan
+        # so `while not stop.wait(interval)` counts as paced.
+        polls, paced = [], False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            last = _dotted(sub.func).split(".")[-1]
+            if last in POLL_CALL_NAMES:
+                if any(kw.arg == "wait" for kw in sub.keywords):
+                    paced = True
+                elif id(sub) not in self._poll_handled:
+                    polls.append(sub)
+            elif last in PACING_CALL_NAMES:
+                paced = True
+        if polls and not paced:
+            for call in polls:
+                self._poll_handled.add(id(call))
+                self._add(
+                    "lint-unbounded-poll", Severity.WARNING, call,
+                    "get_world called in a while loop with no pacing (no "
+                    "sleep/wait in the loop, no wait= long-poll bound on "
+                    "the call): a busy-wait against the coordinator — N "
+                    "workers doing this is the thundering herd the "
+                    "pod-scale protocol prevents; pace with an interval + "
+                    "HOROVOD_ELASTIC_POLL_JITTER, or park server-side via "
+                    "get_world(wait=...) (see benchmarks/control_plane.py)")
         self.generic_visit(node)
 
     def visit_Try(self, node):

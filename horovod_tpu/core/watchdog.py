@@ -287,6 +287,10 @@ class StepMonitor:
         from ..elastic import constants as C
         return _env_float(C.POLL_INTERVAL_ENV, C.DEFAULT_POLL_INTERVAL_S)
 
+    def _long_poll_s(self) -> float:
+        from ..elastic import constants as C
+        return _env_float(C.LONG_POLL_ENV, C.DEFAULT_LONG_POLL_S)
+
     def _ensure_watcher(self) -> None:
         """Background poller of the driver's ``/world`` failure feed. Only
         polls while a step is in flight — an idle process costs the
@@ -323,7 +327,15 @@ class StepMonitor:
                 continue
             from ..elastic.service import CoordinatorLostError
             try:
-                world = client.get_world()
+                # Bounded long-poll once the client holds a world cursor:
+                # the request parks server-side until the membership/
+                # failure counters move, so a peer death reaches this
+                # watcher IMMEDIATELY (the rescue deadline arms on push
+                # latency, not poll cadence) while an unchanged world
+                # costs one tiny not-modified reply per bound instead of
+                # one full payload per tick.
+                wait = self._long_poll_s()
+                world = client.get_world(wait=wait if wait > 0 else None)
             except CoordinatorLostError as e:
                 # Escalate via the deadline machinery: the in-flight
                 # step/round is abandoned on its next tick.

@@ -48,6 +48,55 @@ DEFAULT_POLL_INTERVAL_S = 0.2
 #: discovers can miss a membership bump entirely on short generations.
 POLL_INTERVAL_ENV = "HOROVOD_ELASTIC_POLL_INTERVAL"
 
+#: env: fractional jitter applied to each worker's notification-poll
+#: cadence (decorrelated per worker: the actual gap between polls is
+#: uniform over [interval*(1-j), interval*(1+j)], and the FIRST poll of a
+#: generation is phase-shifted uniformly over [0, interval)). Without it,
+#: N workers launched together poll on aligned ticks and thundering-herd
+#: the coordinator every interval (measured in
+#: benchmarks/control_plane.py). 0 disables (the pre-scale behavior).
+POLL_JITTER_ENV = "HOROVOD_ELASTIC_POLL_JITTER"
+DEFAULT_POLL_JITTER = 0.5
+
+#: env: bound (seconds) of the coordinator ``/world`` long-poll used by
+#: background watchers (core/watchdog.py failure feed, scale-harness
+#: agents). A long-polled request parks server-side until the membership
+#: eid moves or the bound expires, so steady-state traffic is event-
+#: driven instead of interval-driven — AND change notification arrives
+#: immediately instead of at the next tick. 0 disables (plain polls).
+LONG_POLL_ENV = "HOROVOD_ELASTIC_LONG_POLL_SECONDS"
+DEFAULT_LONG_POLL_S = 10.0
+
+#: Server-side clamp on any client-requested long-poll bound: a parked
+#: handler holds one coordinator thread, so unbounded waits would let a
+#: buggy client pin threads forever.
+LONG_POLL_CAP_S = 60.0
+
+#: env: how many world/failure events the coordinator retains for
+#: versioned-delta ``/world`` responses. A client whose last-seen cursor
+#: fell behind the retained window gets a full-snapshot fallback instead
+#: of a delta (counted by the client as ``snapshot_fallbacks``).
+EVENT_BUFFER_ENV = "HOROVOD_COORDINATOR_EVENT_BUFFER"
+DEFAULT_EVENT_BUFFER = 512
+
+#: env: target aggregate request rate (req/s) the coordinator paces its
+#: clients toward. Every ``/world`` reply advertises
+#: ``poll_s = max(DEFAULT_POLL_INTERVAL_S, np / target)`` and clients
+#: stretch their poll cadence to it, so steady-state coordinator load
+#: stays ~flat as the world grows instead of scaling linearly with np
+#: (the gloo-rendezvous melt mode SURVEY.md flags upstream).
+TARGET_RPS_ENV = "HOROVOD_COORDINATOR_TARGET_RPS"
+DEFAULT_TARGET_RPS = 50.0
+
+#: env: journal compaction cadence — after this many appended mutation
+#: records the coordinator folds its live state into ONE ``snapshot``
+#: record and truncates the history, keeping crash-restart rebuild cost
+#: O(live state) instead of O(every membership change ever). 0 disables.
+#: ``version``/``failure_seq`` ride inside the snapshot, so the rebuilt
+#: counters are identical to an uncompacted replay.
+COMPACT_EVERY_ENV = "HOROVOD_COORDINATOR_JOURNAL_COMPACT_EVERY"
+DEFAULT_COMPACT_EVERY = 512
+
 #: env: path of the driver's coordinator *address file*. The driver writes
 #: the service's current host:port here and rewrites it after a
 #: crash-restart (the rebuilt service binds a fresh ephemeral port);

@@ -234,6 +234,16 @@ class ElasticDriver:
             # old world size.
             C.POLL_INTERVAL_ENV: str(self._settings.discovery_interval_s),
         }
+        # Pod-scale poll hygiene (docs/elastic.md "Scale tuning"): jitter
+        # decorrelates lockstep workers' commit-time polls, the long-poll
+        # bound turns background failure-feed watchers event-driven.
+        # User-provided values (env or settings) win, same rule as the
+        # stall window below.
+        for knob, default in ((C.POLL_JITTER_ENV, C.DEFAULT_POLL_JITTER),
+                              (C.LONG_POLL_ENV, C.DEFAULT_LONG_POLL_S)):
+            if not os.environ.get(knob) and \
+                    knob not in (self._settings.env or {}):
+                extra[knob] = str(default)
         # Arm the engine's transport stall watchdog (core/engine.py
         # _bounded): standalone runs keep the reference default (warn only,
         # never shutdown — nobody would relaunch them), but under THIS

@@ -20,23 +20,92 @@ any undecodable line (and logs it once), so the rebuilt state is simply
 "as of the last durable record" — the same contract as elastic/state.py's
 checksummed commits, without needing a checksum because records are
 line-framed and individually self-contained.
+
+Compaction (pod-scale control plane): an append-only log grows with every
+membership change and worker death, so at O(1000) workers with constant
+churn a crash-restart replay becomes O(history). ``compact(state)`` folds
+the live state into ONE ``snapshot`` record and atomically replaces the
+log (tmp + rename — a crash mid-compaction leaves either the old or the
+new file, never a torn one). Replay treats a ``snapshot`` record as a
+reset-to-this-state, so ``version``/``failure_seq`` rebuilt from a
+compacted journal are byte-for-byte the values an uncompacted replay
+would produce. Appends after compaction extend the new file as usual.
+
+The mutation records double as the **wire format of the versioned-delta
+``/world`` protocol** (elastic/service.py): the coordinator's in-memory
+event buffer holds exactly these records, and delta clients replay them
+through the same :func:`apply_record` used here — one replay semantics,
+three consumers (journal rebuild, compaction snapshot, client delta).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import tempfile
 from typing import Any, Dict, Optional, TextIO
 
 from ..core.logging import get_logger
 
 
+def empty_state() -> Dict[str, Any]:
+    """The zero state every replay starts from."""
+    return {
+        "version": 0, "hosts": {}, "np": 0,
+        "failures": [], "failure_seq": 0, "registrations": {},
+    }
+
+
+def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
+    """Apply one mutation record to ``state`` in place. Returns False for
+    unknown ops (callers log once). The single replay semantics shared by
+    journal rebuild, compaction snapshots, and the delta-protocol client
+    (elastic/service.py CoordinatorClient)."""
+    op = rec["op"]
+    if op == "world":
+        state["version"] = int(rec["version"])
+        state["hosts"] = dict(rec["hosts"])
+        state["np"] = int(rec["np"])
+        state["failures"] = []   # per-generation, cleared by update
+    elif op == "failure":
+        state["failure_seq"] = int(rec["seq"])
+        state["failures"].append(
+            {"host": rec["host"], "code": int(rec["code"])})
+    elif op == "register":
+        state["registrations"][str(rec["process_id"])] = float(rec["ts"])
+    elif op == "register_batch":
+        # Coalesced per-host registration: one record (one fsync) for a
+        # whole host's worth of workers instead of one per worker.
+        ts = float(rec["ts"])
+        for pid in rec["process_ids"]:
+            state["registrations"][str(pid)] = ts
+    elif op == "snapshot":
+        # Compaction marker: reset to the embedded live state.
+        snap = rec["state"]
+        state.clear()
+        state.update(empty_state())
+        state["version"] = int(snap["version"])
+        state["hosts"] = dict(snap["hosts"])
+        state["np"] = int(snap["np"])
+        state["failures"] = [dict(f) for f in snap["failures"]]
+        state["failure_seq"] = int(snap["failure_seq"])
+        state["registrations"] = {str(k): float(v) for k, v
+                                  in snap["registrations"].items()}
+    else:
+        return False
+    return True
+
+
 class CoordinatorJournal:
-    """Append-only JSON-lines log of coordinator state mutations."""
+    """Append-only JSON-lines log of coordinator state mutations, with
+    periodic snapshot+truncate compaction."""
 
     def __init__(self, path: str):
         self.path = path
         self._fh: Optional[TextIO] = None
+        #: mutation records appended since the last compaction — the
+        #: service compares this against its compact-every threshold.
+        self.records_since_snapshot = 0
 
     def _file(self) -> TextIO:
         if self._fh is None or self._fh.closed:
@@ -49,7 +118,8 @@ class CoordinatorJournal:
         the journal only matters when the process serving the state dies,
         so buffered-but-unwritten records would defeat its purpose. The
         write rate is human-scale (membership changes and worker deaths),
-        not per-step."""
+        not per-step — and per-worker bursts (registration) arrive
+        coalesced as one ``register_batch`` record per host."""
         fh = self._file()
         fh.write(json.dumps(record, sort_keys=True) + "\n")
         fh.flush()
@@ -59,6 +129,43 @@ class CoordinatorJournal:
             pass
         except ValueError:  # closed underneath us during teardown
             pass
+        self.records_since_snapshot += 1
+
+    def compact(self, state: Dict[str, Any]) -> None:
+        """Replace the whole log with one ``snapshot`` record holding
+        ``state``. Atomic (tmp + rename): a crash mid-compaction leaves
+        either the full old history or the full snapshot — replay handles
+        both identically. The open append handle points at the OLD inode
+        after the rename, so it is closed here and lazily reopened on the
+        next append."""
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".compact")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps({"op": "snapshot", "state": state},
+                                    sort_keys=True) + "\n")
+                fh.flush()
+                try:
+                    os.fsync(fh.fileno())
+                except OSError:
+                    pass
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.close()
+        self.records_since_snapshot = 0
+
+    def size_bytes(self) -> int:
+        """Current on-disk journal size (scale-harness observability)."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
 
     def close(self) -> None:
         if self._fh is not None and not self._fh.closed:
@@ -74,36 +181,22 @@ def replay(path: str) -> Optional[Dict[str, Any]]:
             lines = fh.readlines()
     except OSError:
         return None
-    state: Dict[str, Any] = {
-        "version": 0, "hosts": {}, "np": 0,
-        "failures": [], "failure_seq": 0, "registrations": {},
-    }
+    state = empty_state()
     seen = 0
     for lineno, line in enumerate(lines, 1):
         if not line.strip():
             continue
         try:
             rec = json.loads(line)
-            op = rec["op"]
+            known = apply_record(state, rec)
         except (ValueError, KeyError, TypeError):
             get_logger().warning(
                 "coordinator journal %s: skipping undecodable record at "
                 "line %d (torn tail from a crash mid-append)", path, lineno)
             continue
         seen += 1
-        if op == "world":
-            state["version"] = int(rec["version"])
-            state["hosts"] = dict(rec["hosts"])
-            state["np"] = int(rec["np"])
-            state["failures"] = []   # per-generation, cleared by update
-        elif op == "failure":
-            state["failure_seq"] = int(rec["seq"])
-            state["failures"].append(
-                {"host": rec["host"], "code": int(rec["code"])})
-        elif op == "register":
-            state["registrations"][str(rec["process_id"])] = float(rec["ts"])
-        else:
+        if not known:
             get_logger().warning(
                 "coordinator journal %s: unknown op %r at line %d — "
-                "skipped", path, op, lineno)
+                "skipped", path, rec.get("op"), lineno)
     return state if seen else None

@@ -22,6 +22,33 @@ commit/check points).
 Wire format: JSON body + ``X-HVD-Sig`` HMAC (runner/secret.py) over the
 body, both directions. Replay within a job is harmless (monotonic version).
 
+Pod-scale protocol (benchmarks/control_plane.py measures each piece; the
+upstream analog — the gloo rendezvous KV store — is SURVEY.md's flagged
+melt mode at O(1000) workers):
+
+- **Versioned deltas**: a client that has seen the world before sends its
+  cursor (``since_v``/``since_s`` — the two monotonic counters; their sum
+  is the event id, since every mutation bumps exactly one by 1). Unchanged
+  → a tiny not-modified reply. Changed within the retained event window →
+  only the events since the cursor, in the SAME record format the journal
+  uses, replayed client-side through journal.apply_record. Too far behind
+  (or incoherent) → full-snapshot fallback, counted client-side.
+- **Bounded long-poll**: ``wait=<s>`` parks the request server-side (one
+  thread per parked poll, ``ThreadingHTTPServer``) until the event id
+  moves or the bound expires (clamped to ``LONG_POLL_CAP_S``). Background
+  watchers get event-driven notification — failure push latency drops from
+  "next tick" to immediate — while steady-state request rate drops to ~one
+  per client per bound.
+- **Advertised pacing**: every ``/world`` reply carries
+  ``poll_s = max(DEFAULT_POLL_INTERVAL_S, np / TARGET_RPS)`` and plain
+  pollers stretch their cadence to it, so aggregate request rate stays
+  ~flat as the world grows instead of linear in np.
+- **Coalesced registration**: ``/register`` accepts ``process_ids`` (one
+  request + ONE journal fsync per host) alongside single ``process_id``.
+- **Journal compaction**: after ``HOROVOD_COORDINATOR_JOURNAL_COMPACT_EVERY``
+  journaled mutations the live state is folded into one snapshot record
+  (elastic/journal.py) so crash-restart replay is O(live state).
+
 Control-plane hardening (docs/failure_model.md "control plane" rows):
 
 - **Retrying client**: every logical call makes up to
@@ -38,15 +65,19 @@ Control-plane hardening (docs/failure_model.md "control plane" rows):
   (elastic/journal.py); the driver rebuilds a dead service from the journal
   with both monotonic counters intact and republishes the new port via the
   address file (``HOROVOD_ELASTIC_COORD_ADDR_FILE``), which the client
-  re-reads on connect failure.
+  re-reads on connect failure. The rebuilt service starts with an empty
+  event window, so surviving delta clients land exactly once on the
+  snapshot fallback and resume deltas from there.
 - **Fault seam**: when ``HOROVOD_FAULT_SPEC`` is armed, each client attempt
   consults testing/faults.py for call-count-scheduled ``rpc_*`` faults
   (drop/delay/refuse/garble/badsig) — chaos tests inject control-plane
-  failures deterministically at this one seam.
+  failures deterministically at this one seam, delta and snapshot replies
+  alike.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -54,14 +85,21 @@ import threading
 import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Iterator, Optional
+from typing import Callable, Dict, Iterable, Iterator, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from ..core.logging import get_logger
 from ..runner import secret as _secret
 from . import constants as C
-from .journal import CoordinatorJournal, replay as _journal_replay
+from .journal import (CoordinatorJournal, apply_record as _apply_record,
+                      replay as _journal_replay)
 
 SIG_HEADER = "X-HVD-Sig"
+
+#: The exact keys of the canonical world payload — what ``get_world``
+#: returns regardless of which wire shape (full/nm/delta/snapshot)
+#: produced it. Frozen by tests/test_elastic.py's dict-equality asserts.
+WORLD_KEYS = ("version", "hosts", "np", "failures", "failure_seq")
 
 
 class CoordinatorLostError(RuntimeError):
@@ -145,6 +183,11 @@ class CoordinatorService:
                  journal_path: Optional[str] = None, restore: bool = False):
         self._key = secret_key
         self._lock = threading.Lock()
+        # Long-poll park/wake shares the service lock: mutators already
+        # hold it, so notify_all from inside their critical sections is
+        # legal, and parked handlers re-check state without a second lock.
+        self._cond = threading.Condition(self._lock)
+        self._closing = False
         self._version = 0
         self._hosts: Dict[str, int] = {}
         self._np = 0
@@ -157,6 +200,18 @@ class CoordinatorService:
         # survivor does not re-arm on its predecessor's death.
         self._failures: list = []
         self._failure_seq = 0
+        # Delta window: (eid, record) pairs in journal-record format; eid
+        # is version+failure_seq AFTER the record applied (consecutive —
+        # each mutation bumps exactly one counter by 1). Registrations do
+        # not enter the window: they are not part of the world payload, so
+        # a registration storm cannot evict membership history.
+        self._events: collections.deque = collections.deque(
+            maxlen=max(1, _env_int(C.EVENT_BUFFER_ENV,
+                                   C.DEFAULT_EVENT_BUFFER)))
+        self._target_rps = max(0.0, _env_float(C.TARGET_RPS_ENV,
+                                               C.DEFAULT_TARGET_RPS))
+        self._compact_every = max(0, _env_int(C.COMPACT_EVERY_ENV,
+                                              C.DEFAULT_COMPACT_EVERY))
         self._journal = CoordinatorJournal(journal_path) if journal_path \
             else None
         if restore and journal_path:
@@ -188,25 +243,50 @@ class CoordinatorService:
 
             def _reply(self, obj, code=200):
                 body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header(SIG_HEADER, _secret.sign(svc._key, body))
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header(SIG_HEADER,
+                                     _secret.sign(svc._key, body))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (OSError, ValueError):
+                    # The peer gave up (typically: timed out and hung up
+                    # while this handler was parked in a long-poll).
+                    # Nothing left to tell it.
+                    pass
 
             def do_GET(self):
-                if self.path == "/world":
-                    with svc._lock:
-                        self._reply({"version": svc._version,
-                                     "hosts": svc._hosts, "np": svc._np,
-                                     "failures": list(svc._failures),
-                                     "failure_seq": svc._failure_seq})
-                else:
+                parsed = urlsplit(self.path)
+                if parsed.path != "/world":
                     get_logger().debug(
                         "coordinator: unknown GET path %s from %s",
                         self.path, self._peer())
                     self._reply({"error": "not found"}, 404)
+                    return
+                q = parse_qs(parsed.query)
+
+                def _qnum(name, cast):
+                    try:
+                        return cast(q[name][0])
+                    except (KeyError, IndexError, ValueError, TypeError):
+                        return None
+
+                since_v = _qnum("since_v", int)
+                since_s = _qnum("since_s", int)
+                wait_s = min(max(_qnum("wait", float) or 0.0, 0.0),
+                             C.LONG_POLL_CAP_S)
+                cursor = (since_v + since_s) \
+                    if since_v is not None and since_s is not None else None
+                with svc._cond:
+                    if cursor is not None and wait_s > 0:
+                        svc._cond.wait_for(
+                            lambda: svc._closing or
+                            svc._version + svc._failure_seq != cursor,
+                            timeout=wait_s)
+                    reply = svc._world_reply_locked(since_v, since_s)
+                self._reply(reply)
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
@@ -220,8 +300,13 @@ class CoordinatorService:
                     return
                 msg = json.loads(body or b"{}")
                 if self.path == "/register":
-                    svc._record_register(int(msg["process_id"]),
-                                         time.monotonic())
+                    if "process_ids" in msg:
+                        svc._record_register_batch(
+                            [int(p) for p in msg["process_ids"]],
+                            time.monotonic())
+                    else:
+                        svc._record_register(int(msg["process_id"]),
+                                             time.monotonic())
                     self._reply({"ok": True})
                 else:
                     get_logger().debug(
@@ -247,12 +332,76 @@ class CoordinatorService:
         driver polls this and rebuilds from the journal."""
         return self._thread.is_alive()
 
+    # -- /world reply triage (caller holds the lock) -------------------------
+
+    def _poll_s_locked(self) -> float:
+        """Advertised poll cadence: stretch with world size toward the
+        target aggregate request rate, never below the reference interval
+        (small worlds keep the snappy cadence the chaos tests rely on)."""
+        if self._target_rps <= 0:
+            return C.DEFAULT_POLL_INTERVAL_S
+        return max(C.DEFAULT_POLL_INTERVAL_S, self._np / self._target_rps)
+
+    def _snapshot_locked(self) -> dict:
+        return {"version": self._version, "hosts": dict(self._hosts),
+                "np": self._np, "failures": [dict(f) for f in self._failures],
+                "failure_seq": self._failure_seq}
+
+    def _world_reply_locked(self, since_v: Optional[int],
+                            since_s: Optional[int]) -> dict:
+        poll_s = self._poll_s_locked()
+        if since_v is None or since_s is None:
+            # Legacy/first-contact bare GET: the full payload (plus the
+            # pacing hint, which canonicalizing clients strip).
+            full = self._snapshot_locked()
+            full["poll_s"] = poll_s
+            return full
+        if since_v == self._version and since_s == self._failure_seq:
+            return {"nm": True, "version": self._version,
+                    "failure_seq": self._failure_seq, "poll_s": poll_s}
+        cursor = since_v + since_s
+        eid = self._version + self._failure_seq
+        if cursor < eid and self._events \
+                and self._events[0][0] <= cursor + 1:
+            delta = [rec for (e, rec) in self._events if e > cursor]
+            return {"delta": delta, "version": self._version,
+                    "failure_seq": self._failure_seq, "poll_s": poll_s}
+        # Cursor fell out of the retained window, runs AHEAD of this
+        # service (its predecessor crashed before journaling?), or the
+        # counters are incoherent: full-snapshot fallback.
+        return {"snapshot": self._snapshot_locked(), "poll_s": poll_s}
+
+    # -- mutators ------------------------------------------------------------
+
+    def _maybe_compact_locked(self) -> None:
+        if (self._journal and self._compact_every > 0
+                and self._journal.records_since_snapshot
+                >= self._compact_every):
+            state = self._snapshot_locked()
+            state["registrations"] = {str(k): v
+                                      for k, v in self._started.items()}
+            self._journal.compact(state)
+
     def _record_register(self, process_id: int, ts: float) -> None:
         with self._lock:
             self._started[process_id] = ts
             if self._journal:
                 self._journal.append({"op": "register",
                                       "process_id": process_id, "ts": ts})
+                self._maybe_compact_locked()
+
+    def _record_register_batch(self, process_ids: Iterable[int],
+                               ts: float) -> None:
+        """Coalesced per-host registration: one request and ONE journal
+        fsync for a whole host's worth of workers."""
+        pids = [int(p) for p in process_ids]
+        with self._lock:
+            for pid in pids:
+                self._started[pid] = ts
+            if self._journal:
+                self._journal.append({"op": "register_batch",
+                                      "process_ids": pids, "ts": ts})
+                self._maybe_compact_locked()
 
     def update_world(self, hosts: Dict[str, int], np_: int) -> int:
         """Publish a new membership view; returns the new version."""
@@ -261,10 +410,16 @@ class CoordinatorService:
             self._hosts = dict(hosts)
             self._np = np_
             self._failures = []   # failures are per-generation; seq stays
+            self._events.append(
+                (self._version + self._failure_seq,
+                 {"op": "world", "version": self._version,
+                  "hosts": dict(self._hosts), "np": np_}))
             if self._journal:
                 self._journal.append({"op": "world",
                                       "version": self._version,
                                       "hosts": self._hosts, "np": np_})
+                self._maybe_compact_locked()
+            self._cond.notify_all()
             return self._version
 
     def mark_failure(self, host: str, code: int) -> int:
@@ -276,10 +431,16 @@ class CoordinatorService:
         with self._lock:
             self._failure_seq += 1
             self._failures.append({"host": host, "code": int(code)})
+            self._events.append(
+                (self._version + self._failure_seq,
+                 {"op": "failure", "host": host, "code": int(code),
+                  "seq": self._failure_seq}))
             if self._journal:
                 self._journal.append({"op": "failure", "host": host,
                                       "code": int(code),
                                       "seq": self._failure_seq})
+                self._maybe_compact_locked()
+            self._cond.notify_all()
             return self._failure_seq
 
     @property
@@ -296,7 +457,18 @@ class CoordinatorService:
         with self._lock:
             return dict(self._started)
 
+    def journal_size_bytes(self) -> int:
+        """On-disk journal size (scale-harness observability; 0 when the
+        service runs journal-less)."""
+        return self._journal.size_bytes() if self._journal else 0
+
+    def _release_parked(self) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+
     def close(self) -> None:
+        self._release_parked()
         self._server.shutdown()
         self._server.server_close()
         if self._journal:
@@ -305,7 +477,11 @@ class CoordinatorService:
     def simulate_crash(self) -> None:
         """Chaos-test hook: die the way a real service death looks from
         the driver's side — the socket is torn down and the serve thread
-        exits WITHOUT journal finalization or any orderly handoff."""
+        exits WITHOUT journal finalization or any orderly handoff.
+        Parked long-polls are released first (a dead process drops them
+        immediately; daemon threads parked for the long-poll cap would
+        leak the sockets into the next test instead)."""
+        self._release_parked()
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5)
@@ -320,16 +496,28 @@ class CoordinatorClient:
     re-resolved from the address file (a driver that crash-restarted its
     service republishes the new port there). ``sleep``/``clock`` are
     injectable so retry/escalation tests run on a fake clock — no real
-    sleeps in tier-1."""
+    sleeps in tier-1.
+
+    The client keeps the last world it assembled and sends its cursor on
+    every subsequent ``/world``, so unchanged worlds cost a not-modified
+    reply and changed worlds cost only the delta (replayed through
+    journal.apply_record — the same semantics journal rebuild uses).
+    Whatever the wire shape, :meth:`get_world` returns the SAME canonical
+    payload dict (``WORLD_KEYS`` exactly) the full response always had."""
 
     def __init__(self, addr: str, secret_key: bytes,
                  timeout_s: Optional[float] = None,
                  policy: Optional[RetryPolicy] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 delta: bool = True):
         self._base = f"http://{addr}"
         self._key = secret_key
+        #: False = never send a cursor: every /world is a full fetch (the
+        #: pre-delta wire protocol — the A/B baseline arm of
+        #: benchmarks/control_plane.py; no production caller sets this).
+        self._delta = delta
         self._policy = policy or RetryPolicy.from_env()
         if timeout_s is not None:
             self._policy.timeout_s = timeout_s
@@ -341,7 +529,20 @@ class CoordinatorClient:
         self.sig_failures = 0
         #: HTTP attempts made (the rpc fault schedule's call-count axis).
         self.calls = 0
+        #: Response-body bytes received across all calls (the scale
+        #: harness's bytes-per-membership-change metric reads this).
+        self.bytes_received = 0
+        #: Times a delta request came back as a full snapshot (cursor fell
+        #: out of the server's event window / crash-restarted server).
+        self.snapshot_fallbacks = 0
+        #: Times the delta replay was abandoned and re-fetched from
+        #: scratch (incoherent delta — should stay 0 outside fault tests).
+        self.resyncs = 0
+        #: Server-advertised poll cadence from the last ``/world`` reply
+        #: (None until one arrives). Pollers stretch to it (state.py).
+        self.advertised_poll_s: Optional[float] = None
         self._failing_since: Optional[float] = None
+        self._world: Optional[dict] = None
         self._lock = threading.Lock()
 
     # -- persistent-loss bookkeeping ----------------------------------------
@@ -415,7 +616,8 @@ class CoordinatorClient:
 
     # -- one attempt ---------------------------------------------------------
 
-    def _request(self, path: str, data: Optional[bytes], fault) -> dict:
+    def _request(self, path: str, data: Optional[bytes], fault,
+                 timeout_s: Optional[float] = None) -> dict:
         """One HTTP attempt. Raises OSError on transport failure and
         _SignatureError on HMAC mismatch (counted + logged distinctly)."""
         from urllib import request as _urlreq
@@ -428,7 +630,9 @@ class CoordinatorClient:
                 url, data=data,
                 headers={"Content-Type": "application/json",
                          SIG_HEADER: _secret.sign(self._key, data)})
-        with _urlreq.urlopen(req, timeout=self._policy.timeout_s) as r:
+        with _urlreq.urlopen(
+                req, timeout=timeout_s if timeout_s is not None
+                else self._policy.timeout_s) as r:
             body = r.read()
             sig = r.headers.get(SIG_HEADER, "")
         if fault is not None and fault.kind == "rpc_garble":
@@ -444,12 +648,14 @@ class CoordinatorClient:
                 "(signature failure #%d on %s — tampered or corrupt "
                 "control-plane reply, NOT a network error)", count, url)
             raise _SignatureError(url)
+        with self._lock:
+            self.bytes_received += len(body)
         return json.loads(body)
 
     # -- the retrying logical call ------------------------------------------
 
-    def _call(self, path: str, data: Optional[bytes] = None
-              ) -> Optional[dict]:
+    def _call(self, path: str, data: Optional[bytes] = None,
+              timeout_s: Optional[float] = None) -> Optional[dict]:
         """Retry ``_request`` under the policy. Returns the decoded reply,
         or None when every attempt failed (transient failure — callers
         treat it as 'no change'). Raises CoordinatorLostError once the
@@ -459,7 +665,7 @@ class CoordinatorClient:
         for attempt in range(self._policy.attempts):
             fault = self._next_call_fault()
             try:
-                reply = self._request(path, data, fault)
+                reply = self._request(path, data, fault, timeout_s)
                 self._note_success()
                 return reply
             except _SignatureError:
@@ -481,12 +687,138 @@ class CoordinatorClient:
         self._note_failure()
         return None
 
-    def get_world(self) -> Optional[dict]:
+    # -- world-cache maintenance ---------------------------------------------
+
+    def _world_copy(self) -> Optional[dict]:
+        """The canonical payload (exactly ``WORLD_KEYS``), copied so
+        callers mutating it cannot poison the delta cache."""
+        with self._lock:
+            w = self._world
+            if w is None:
+                return None
+            return {"version": w["version"], "hosts": dict(w["hosts"]),
+                    "np": w["np"],
+                    "failures": [dict(f) for f in w["failures"]],
+                    "failure_seq": w["failure_seq"]}
+
+    @staticmethod
+    def _canonical(payload: dict) -> dict:
+        return {"version": int(payload["version"]),
+                "hosts": dict(payload["hosts"]),
+                "np": int(payload["np"]),
+                "failures": [dict(f) for f in payload["failures"]],
+                "failure_seq": int(payload["failure_seq"])}
+
+    def _resync(self, reason: str) -> Optional[dict]:
+        """Abandon the cursor and fetch one fresh full world (used when a
+        delta/nm reply does not cohere with the cache)."""
+        with self._lock:
+            self._world = None
+            self.resyncs += 1
+        get_logger().warning(
+            "coordinator delta state incoherent (%s) — resyncing with a "
+            "full /world fetch", reason)
+        reply = self._call("/world")
+        if reply is None:
+            return None
+        return self._ingest_world(reply, allow_resync=False)
+
+    def _ingest_world(self, reply: dict,
+                      allow_resync: bool = True) -> Optional[dict]:
+        """Fold one ``/world`` reply (any wire shape) into the cached
+        world and return the canonical payload."""
+        poll = reply.get("poll_s")
+        if poll is not None:
+            try:
+                self.advertised_poll_s = float(poll)
+            except (TypeError, ValueError):
+                pass
+        try:
+            if reply.get("nm"):
+                with self._lock:
+                    w = self._world
+                    ok = (w is not None
+                          and w["version"] == reply.get("version")
+                          and w["failure_seq"] == reply.get("failure_seq"))
+                if ok:
+                    return self._world_copy()
+                if not allow_resync:
+                    return None
+                return self._resync("not-modified for a cursor we no "
+                                    "longer hold")
+            if "delta" in reply:
+                with self._lock:
+                    w = self._world
+                    state = None if w is None else \
+                        {"version": w["version"], "hosts": dict(w["hosts"]),
+                         "np": w["np"],
+                         "failures": [dict(f) for f in w["failures"]],
+                         "failure_seq": w["failure_seq"]}
+                if state is None:
+                    if not allow_resync:
+                        return None
+                    return self._resync("delta without a cached base")
+                for rec in reply["delta"]:
+                    _apply_record(state, rec)
+                if state["version"] != int(reply["version"]) or \
+                        state["failure_seq"] != int(reply["failure_seq"]):
+                    if not allow_resync:
+                        return None
+                    return self._resync(
+                        "delta replay landed on "
+                        f"v{state['version']}/s{state['failure_seq']}, "
+                        f"server says v{reply['version']}/"
+                        f"s{reply['failure_seq']}")
+                with self._lock:
+                    self._world = state
+                return self._world_copy()
+            if "snapshot" in reply:
+                state = self._canonical(reply["snapshot"])
+                with self._lock:
+                    had_cursor = self._world is not None
+                    self._world = state
+                    if had_cursor:
+                        self.snapshot_fallbacks += 1
+                return self._world_copy()
+            # Full payload (legacy server / first contact).
+            state = self._canonical(reply)
+        except (KeyError, TypeError, ValueError) as e:
+            if not allow_resync:
+                return None
+            return self._resync(f"malformed reply ({e!r})")
+        with self._lock:
+            self._world = state
+        return self._world_copy()
+
+    # -- the public surface ---------------------------------------------------
+
+    def get_world(self, wait: Optional[float] = None) -> Optional[dict]:
         """Current membership view, or None while the driver is merely
         *transiently* unreachable (callers treat that as 'no change').
         Persistent loss raises CoordinatorLostError instead — a dead
-        driver must not look like a quiet network forever."""
-        return self._call("/world")
+        driver must not look like a quiet network forever.
+
+        ``wait`` (seconds) long-polls: the server parks the request until
+        the membership/failure counters move or the bound expires, then
+        answers as usual (``nm`` if nothing moved). Only takes effect once
+        a first world has been fetched (the cursor is what the server
+        parks on); the per-attempt HTTP timeout is extended by the bound
+        so a full park does not read as a transport failure."""
+        path = "/world"
+        timeout_s: Optional[float] = None
+        with self._lock:
+            w = self._world
+        if w is not None and self._delta:
+            path = (f"/world?since_v={w['version']}"
+                    f"&since_s={w['failure_seq']}")
+            if wait is not None and wait > 0:
+                bound = min(float(wait), C.LONG_POLL_CAP_S)
+                path += f"&wait={bound:g}"
+                timeout_s = self._policy.timeout_s + bound
+        reply = self._call(path, timeout_s=timeout_s)
+        if reply is None:
+            return None
+        return self._ingest_world(reply)
 
     def register(self, process_id: int) -> bool:
         """Announce this worker; retried under the same policy. Returns
@@ -494,5 +826,14 @@ class CoordinatorClient:
         workers when its start-timeout trips, so a dropped registration
         is visible on the driver side too."""
         body = json.dumps({"process_id": process_id}).encode()
+        reply = self._call("/register", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def register_batch(self, process_ids: Iterable[int]) -> bool:
+        """Announce a whole host's workers in ONE request (and one journal
+        fsync server-side) — the pod-scale path the launcher's per-host
+        process uses instead of np parallel :meth:`register` calls."""
+        body = json.dumps(
+            {"process_ids": [int(p) for p in process_ids]}).encode()
         reply = self._call("/register", data=body)
         return bool(reply and reply.get("ok"))

@@ -37,6 +37,7 @@ import hashlib
 import hmac
 import os
 import pickle
+import random
 import tempfile
 import threading
 import time
@@ -55,15 +56,30 @@ class WorkerNotificationManager:
     Reference parity: ``horovod/runner/elastic/worker.py``'s
     WorkerNotificationManager, with the push inverted into a rate-limited
     poll of the driver's coordinator service (see elastic/service.py).
+
+    Pod-scale cadence (benchmarks/control_plane.py): SPMD commits happen
+    in lockstep (collectives synchronize the steps), so N workers whose
+    rate-limiters all expire together poll the coordinator on aligned
+    ticks — a thundering herd every interval. The gap to the next allowed
+    poll is therefore drawn per-worker as ``interval * uniform(1-j, 1+j)``
+    (``HOROVOD_ELASTIC_POLL_JITTER``, decorrelated: each gap independent),
+    and the interval itself stretches to the server-advertised ``poll_s``
+    pacing so aggregate request rate stays ~flat as the world grows. The
+    FIRST poll of a generation stays immediate — a membership bump that
+    predates the launch must be observed at the first commit, not an
+    interval later. ``_clock``/``_rng`` are injectable (fake-clock tests).
     """
 
     def __init__(self):
         self._client = None
         self._launch_version: Optional[int] = None
-        self._last_poll = 0.0
+        self._next_poll_due = 0.0    # 0 = first check() polls immediately
         self._poll_interval_s = C.DEFAULT_POLL_INTERVAL_S
+        self._jitter = C.DEFAULT_POLL_JITTER
         self._pending = False
         self._lock = threading.Lock()
+        self._clock: Callable[[], float] = time.monotonic
+        self._rng = random.Random()
 
     def init_from_env(self) -> None:
         addr = os.environ.get(C.COORD_ADDR_ENV)
@@ -85,6 +101,27 @@ class WorkerNotificationManager:
                 self._poll_interval_s = float(iv)
             except ValueError:
                 pass
+        jv = os.environ.get(C.POLL_JITTER_ENV)
+        if jv:
+            try:
+                self._jitter = max(0.0, float(jv))
+            except ValueError:
+                pass
+
+    def _schedule_next_poll(self, now: float) -> None:
+        """Earliest next poll: the configured interval stretched to the
+        server's advertised pacing, jittered so lockstep workers drift
+        apart instead of herding on aligned ticks. Caller holds the lock."""
+        interval = self._poll_interval_s
+        adv = getattr(self._client, "advertised_poll_s", None)
+        if adv:
+            interval = max(interval, float(adv))
+        if self._jitter > 0:
+            gap = interval * self._rng.uniform(1.0 - self._jitter,
+                                               1.0 + self._jitter)
+        else:
+            gap = interval
+        self._next_poll_due = now + max(gap, 0.0)
 
     def check(self) -> None:
         """Raise HostsUpdatedInterrupt if membership moved past the version
@@ -95,10 +132,10 @@ class WorkerNotificationManager:
                 raise HostsUpdatedInterrupt()
             if self._client is None or self._launch_version is None:
                 return
-            now = time.monotonic()
-            if now - self._last_poll < self._poll_interval_s:
+            now = self._clock()
+            if now < self._next_poll_due:
                 return
-            self._last_poll = now
+            self._schedule_next_poll(now)
             from ..core.exceptions import HorovodInternalError
             from .service import CoordinatorLostError
             try:

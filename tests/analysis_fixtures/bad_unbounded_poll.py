@@ -1,0 +1,51 @@
+"""lint-unbounded-poll fixture: a while loop hammering the coordinator's
+get_world with no pacing at all. Exactly ONE finding: the hot loop; the
+suppressed twin, the slept loop, the long-polled loop, the stop.wait()
+loop, and the bounded for-retry must all stay clean."""
+
+import time
+
+
+def hot_poll(client):
+    while True:
+        world = client.get_world()  # <- lint-unbounded-poll
+        if world and world["version"] > 3:
+            return world
+
+
+def suppressed_hot_poll(client):
+    while True:
+        world = client.get_world()  # hvd-analyze: ok
+        if world:
+            return world
+
+
+def paced_poll(client):
+    while True:
+        world = client.get_world()
+        if world:
+            return world
+        time.sleep(0.2)
+
+
+def long_polled(client):
+    while True:
+        world = client.get_world(wait=10.0)
+        if world:
+            return world
+
+
+def event_paced_poll(client, stop, interval):
+    while not stop.wait(interval):
+        world = client.get_world()
+        if world:
+            return world
+    return None
+
+
+def bounded_retry(client):
+    for _ in range(3):
+        world = client.get_world()
+        if world:
+            return world
+    return None

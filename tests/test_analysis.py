@@ -208,6 +208,7 @@ LINT_CASES = [
     ("bad_silent_rpc.py", "lint-silent-rpc", "warning"),
     ("bad_unguarded_apply.py", "jax-unguarded-apply", "warning"),
     ("bad_monolithic_psum.py", "lint-monolithic-psum", "warning"),
+    ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
 ]
 
 

@@ -12,6 +12,8 @@ import json
 import logging
 import random
 import socket
+import threading
+import time
 
 import pytest
 
@@ -353,6 +355,223 @@ def test_journal_replay_missing_and_empty(tmp_path):
     empty = tmp_path / "empty.journal"
     empty.write_text("")
     assert journal_mod.replay(str(empty)) is None
+
+
+# -- pod-scale wire protocol: versioned deltas ------------------------------
+
+
+def _snapshot(addr, key):
+    """A cursorless full fetch — the ground truth every delta-replayed
+    client view must reconstruct exactly."""
+    c, _ = _client(addr, key, delta=False)
+    w = c.get_world()
+    assert w is not None
+    return w
+
+
+def test_delta_replay_equals_snapshot_at_every_version(service):
+    """THE protocol property: after any mutation sequence, a client that
+    only ever consumed deltas holds byte-identical world state to a fresh
+    full fetch — at every intermediate version, with zero resyncs."""
+    svc, key = service
+    addr = f"127.0.0.1:{svc.port}"
+    c, _ = _client(addr, key)                 # delta protocol (default)
+    c.get_world()                             # establish the cursor
+    rng = random.Random(7)
+    hosts_pool = ["a", "b", "c", "d"]
+    for _ in range(40):
+        if rng.random() < 0.6:
+            hosts = {h: rng.randint(1, 8)
+                     for h in rng.sample(hosts_pool, rng.randint(1, 4))}
+            svc.update_world(hosts, sum(hosts.values()))
+        else:
+            svc.mark_failure(rng.choice(hosts_pool),
+                             rng.choice([1, 9, 137]))
+        assert c.get_world() == _snapshot(addr, key)
+    assert c.resyncs == 0 and c.snapshot_fallbacks == 0
+
+
+def test_delta_too_far_behind_falls_back_to_snapshot(clean_env):
+    """A client whose cursor predates the event buffer gets a coherent
+    full snapshot (counted), never a gapped delta."""
+    clean_env.setenv(C.EVENT_BUFFER_ENV, "2")
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        addr = f"127.0.0.1:{svc.port}"
+        c, _ = _client(addr, key)
+        svc.update_world({"a": 1}, 1)
+        assert c.get_world()["version"] == 1
+        for _ in range(6):                    # evict the client's slot
+            svc.mark_failure("a", 1)
+        assert c.get_world() == _snapshot(addr, key)
+        assert c.snapshot_fallbacks == 1 and c.resyncs == 0
+    finally:
+        svc.close()
+
+
+def test_delta_equals_snapshot_across_compaction_and_restart(
+        clean_env, tmp_path):
+    """The satellite property end-to-end: delta-replayed view stays equal
+    to the full snapshot THROUGH journal compaction, a coordinator crash,
+    and the journal-restored successor (where the stale cursor must take
+    the snapshot fallback — the restored event buffer is empty)."""
+    clean_env.setenv(C.COMPACT_EVERY_ENV, "4")
+    key = _secret.make_secret_key()
+    jp = str(tmp_path / "coordinator.journal")
+    addr_file = tmp_path / "coordinator.addr"
+    clean_env.setenv(C.COORD_ADDR_FILE_ENV, str(addr_file))
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=jp)
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    for i in range(12):                       # >> compaction cadence
+        svc.update_world({"a": 1 + i % 3}, 1 + i % 3)
+        svc.mark_failure("a", 1)
+        assert c.get_world() == _snapshot(f"127.0.0.1:{svc.port}", key)
+    with open(jp, encoding="utf-8") as fh:    # compaction really fired
+        assert json.loads(fh.readline())["op"] == "snapshot"
+    v, s = svc.version, svc.failure_seq
+    svc.simulate_crash()
+    new = CoordinatorService(key, bind_host="127.0.0.1",
+                             journal_path=jp, restore=True)
+    try:
+        assert (new.version, new.failure_seq) == (v, s)
+        addr_file.write_text(f"127.0.0.1:{new.port}\n")
+        addr = f"127.0.0.1:{new.port}"
+        # Cursor == restored counters → not-modified; cache still exact.
+        assert c.get_world() == _snapshot(addr, key)
+        new.mark_failure("a", 137)
+        # The post-restore buffer starts at this event, so the delta path
+        # resumes seamlessly; equality must hold through it.
+        assert c.get_world() == _snapshot(addr, key)
+        for i in range(3):
+            new.update_world({"b": 2 + i}, 2 + i)
+            assert c.get_world() == _snapshot(addr, key)
+        assert c.resyncs == 0
+    finally:
+        new.close()
+
+
+# -- bounded long-poll + threaded service -----------------------------------
+
+
+def test_long_poll_parks_until_publish_and_does_not_block_others(service):
+    """A parked /world?wait= handler holds no lock: a publish wakes it
+    with the new version, and a concurrent plain get_world sails through
+    while it is parked (threaded service, per-request handler threads)."""
+    svc, key = service
+    svc.update_world({"a": 1}, 1)
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    assert c.get_world()["version"] == 1
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.setdefault("w", c.get_world(wait=30.0)),
+        daemon=True)
+    t.start()
+    time.sleep(0.25)
+    assert t.is_alive()                       # parked, not timed out
+    other, _ = _client(f"127.0.0.1:{svc.port}", key)
+    t0 = time.monotonic()
+    assert other.get_world()["version"] == 1  # not head-of-line-blocked
+    assert time.monotonic() - t0 < 2.0
+    svc.update_world({"a": 2}, 2)
+    t.join(timeout=5)
+    assert not t.is_alive() and got["w"]["version"] == 2
+
+
+def test_long_poll_expiry_returns_cached_world_cheaply(service):
+    svc, key = service
+    svc.update_world({"a": 1}, 1)
+    c, _ = _client(f"127.0.0.1:{svc.port}", key)
+    w1 = c.get_world()
+    b0 = c.bytes_received
+    t0 = time.monotonic()
+    w2 = c.get_world(wait=0.2)                # no change → nm after 0.2 s
+    assert time.monotonic() - t0 >= 0.15
+    assert w2 == w1
+    # the not-modified reply is a fraction of the initial full payload
+    assert c.bytes_received - b0 < b0
+
+
+def test_slow_client_does_not_block_concurrent_requests(service):
+    """Satellite: a client that connects and stalls mid-request must not
+    head-of-line-block an unrelated get_world (one handler thread each)."""
+    svc, key = service
+    svc.update_world({"a": 1}, 1)
+    slow = socket.create_connection(("127.0.0.1", svc.port))
+    try:
+        slow.sendall(b"GET /world")           # half a request line; stall
+        time.sleep(0.1)
+        c, _ = _client(f"127.0.0.1:{svc.port}", key)
+        t0 = time.monotonic()
+        w = c.get_world()
+        assert w is not None and w["version"] == 1
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        slow.close()
+
+
+# -- worker poll jitter (fake clock) ----------------------------------------
+
+
+class _StubWorldClient:
+    advertised_poll_s = None
+
+    def __init__(self):
+        self.polls = 0
+
+    def get_world(self, wait=None):
+        self.polls += 1
+        return {"version": 0, "hosts": {}, "np": 0,
+                "failures": [], "failure_seq": 0}
+
+
+def _manager(interval=1.0, jitter=0.5, seed=1234):
+    nm = state_mod.WorkerNotificationManager()
+    nm._client = _StubWorldClient()
+    nm._launch_version = 0
+    nm._poll_interval_s = interval
+    nm._jitter = jitter
+    nm._rng = random.Random(seed)
+    clk = {"now": 100.0}
+    nm._clock = lambda: clk["now"]
+    return nm, clk
+
+
+def test_poll_jitter_spreads_gaps_on_fake_clock():
+    """Satellite: decorrelated jitter — each scheduled gap an independent
+    uniform draw from [interval·(1−j), interval·(1+j)], genuinely spread
+    (no lockstep herd), and the FIRST poll immediate."""
+    nm, clk = _manager()
+    assert nm._next_poll_due == 0.0           # pre-launch bump observable
+    gaps = []
+    for _ in range(200):
+        nm.check()
+        gaps.append(nm._next_poll_due - clk["now"])
+        before = nm._client.polls
+        nm.check()                            # within the gap: no poll
+        assert nm._client.polls == before
+        clk["now"] = nm._next_poll_due + 1e-6
+    assert nm._client.polls == 200
+    assert min(gaps) >= 0.5 and max(gaps) <= 1.5
+    assert max(gaps) - min(gaps) > 0.5        # fills the band
+    assert len({round(g, 6) for g in gaps}) > 150   # decorrelated draws
+
+
+def test_poll_jitter_zero_gives_exact_interval():
+    nm, clk = _manager(jitter=0.0)
+    nm.check()
+    assert nm._next_poll_due == clk["now"] + 1.0
+
+
+def test_poll_gap_stretches_to_server_advertised_pacing():
+    nm, clk = _manager(interval=1.0, jitter=0.5)
+    nm._client.advertised_poll_s = 4.0        # server: np/target_rps
+    gaps = []
+    for _ in range(50):
+        nm.check()
+        gaps.append(nm._next_poll_due - clk["now"])
+        clk["now"] = nm._next_poll_due + 1e-6
+    assert min(gaps) >= 2.0 and max(gaps) <= 6.0
 
 
 # -- fault grammar ----------------------------------------------------------
