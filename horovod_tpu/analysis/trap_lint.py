@@ -56,6 +56,16 @@ CLAUDE.md "Environment traps"):
   reverse-layer buckets sized by ``HOROVOD_FUSION_THRESHOLD`` so the
   allreduce overlaps the backward; per-leaf psums forfeit both the
   fusion and the overlap (docs/fusion.md).
+- ``lint-blocking-telemetry`` (WARNING): a telemetry record call
+  (``telemetry.inc/set_gauge/observe/record_event`` or a
+  registry/ring method) inside a loop whose arguments force a device
+  fetch — ``.block_until_ready()``, ``np.asarray(...)``,
+  ``jax.device_get(...)``.  Telemetry's overhead contract
+  (docs/telemetry.md) is host-side-only recording: a blocking fetch
+  per step stalls the async dispatch pipeline, exactly the cost the
+  ≤1.02 overhead guard exists to prevent.  Record values the host
+  already fetched (the watchdog span / Keras logs), or fetch OUTSIDE
+  the telemetry call at a point that must synchronize anyway.
 
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
@@ -99,6 +109,28 @@ LEAF_REDUCE_NAMES = frozenset({"psum", "pmean"})
 # the server-side long-poll park via get_world(wait=...)).
 POLL_CALL_NAMES = frozenset({"get_world"})
 PACING_CALL_NAMES = frozenset({"sleep", "wait", "wait_for"})
+
+# lint-blocking-telemetry vocabulary: record entry points (generic names
+# like ``inc`` count only with a telemetry/registry/ring prefix; the
+# distinctive ones also count bare, as imported from core.telemetry),
+# and the calls that force a device fetch.
+TELEMETRY_RECORD_NAMES = frozenset({
+    "inc", "set_gauge", "observe", "record_event", "record",
+})
+TELEMETRY_BARE_NAMES = frozenset({"record_event", "set_gauge"})
+FETCH_CALL_NAMES = frozenset({"block_until_ready", "asarray",
+                              "device_get"})
+
+
+def _is_telemetry_record(name: str) -> bool:
+    parts = name.split(".")
+    if parts[-1] not in TELEMETRY_RECORD_NAMES:
+        return False
+    prefix = ".".join(parts[:-1]).lower()
+    if not prefix:
+        return parts[-1] in TELEMETRY_BARE_NAMES
+    return ("telemetry" in prefix or prefix.endswith("registry")
+            or prefix.endswith("ring"))
 
 
 def _is_guard_token(tok: str) -> bool:
@@ -177,6 +209,9 @@ class _Lint(ast.NodeVisitor):
         # lint-unbounded-poll: poll sites already attributed to an
         # enclosing while loop (nested loops must not re-flag them).
         self._poll_handled: set = set()
+        # lint-blocking-telemetry: loop nesting (a "step loop" is any
+        # for/while the record call sits inside).
+        self._loop_depth = 0
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -291,6 +326,26 @@ class _Lint(ast.NodeVisitor):
                         and isinstance(kw.value.value, int):
                     self.cadences.append(kw.value.value)
 
+        if self._loop_depth > 0 and _is_telemetry_record(name):
+            fetches = [
+                _dotted(sub.func).split(".")[-1]
+                for arg in (list(node.args)
+                            + [kw.value for kw in node.keywords])
+                for sub in ast.walk(arg)
+                if isinstance(sub, ast.Call)
+                and _dotted(sub.func).split(".")[-1] in FETCH_CALL_NAMES]
+            if fetches:
+                self._add(
+                    "lint-blocking-telemetry", Severity.WARNING, node,
+                    f"telemetry record call forces a device fetch "
+                    f"({'/'.join(sorted(set(fetches)))}) inside a loop: "
+                    "per-step blocking reads stall the async dispatch "
+                    "pipeline — record values the host already fetched "
+                    "(watchdog span, Keras logs), or fetch outside the "
+                    "telemetry call at a point that must synchronize "
+                    "anyway (docs/telemetry.md overhead contract)",
+                    {"fetches": fetches})
+
         if name.endswith("slope_time_paired"):
             windows = []
             for arg in node.args[1:3]:
@@ -306,6 +361,13 @@ class _Lint(ast.NodeVisitor):
                 self.slope_windows.append((node, windows))
 
         self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_AsyncFor = visit_For
 
     def visit_While(self, node):
         # lint-unbounded-poll: get_world inside a while loop whose body
@@ -337,7 +399,9 @@ class _Lint(ast.NodeVisitor):
                     "pod-scale protocol prevents; pace with an interval + "
                     "HOROVOD_ELASTIC_POLL_JITTER, or park server-side via "
                     "get_world(wait=...) (see benchmarks/control_plane.py)")
+        self._loop_depth += 1
         self.generic_visit(node)
+        self._loop_depth -= 1
 
     def visit_Try(self, node):
         # lint-silent-rpc: a try block that performs an RPC (urlopen)

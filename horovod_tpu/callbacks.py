@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from .core import context_api as _ctx
+from .core import telemetry as _telemetry
 from .core.logging import get_logger
 from .optimizer.functions import broadcast_parameters
 
@@ -104,18 +105,36 @@ class CallbackLoop:
     def batch_end(self, batch: int, logs: Optional[Dict[str, Any]] = None):
         logs = logs if logs is not None else {}
         _merge_sentinel_counters(logs)
+        _record_logs_telemetry("batch_end", batch, logs)
         for c in self.callbacks:
             c.on_batch_end(batch, self, logs)
 
     def epoch_end(self, epoch: int, logs: Optional[Dict[str, Any]] = None):
         logs = logs if logs is not None else {}
         _merge_sentinel_counters(logs)
+        _record_logs_telemetry("epoch_end", epoch, logs)
         for c in self.callbacks:
             c.on_epoch_end(epoch, self, logs)
 
     def train_end(self):
         for c in self.callbacks:
             c.on_train_end(self)
+
+
+def _record_logs_telemetry(kind: str, index: int,
+                           logs: Dict[str, Any]) -> None:
+    """Flight-recorder snapshot of loop metrics the host ALREADY holds.
+    Only plain Python/numpy scalars are taken — a live jax.Array in the
+    logs would force a device fetch here, which the telemetry contract
+    forbids inside the step loop (docs/telemetry.md 'overhead guard')."""
+    if not _telemetry.enabled():
+        return
+    scalars = {k: float(v) for k, v in logs.items()
+               if isinstance(v, (int, float, np.floating, np.integer))}
+    _telemetry.record_event(kind, index=int(index), **scalars)
+    loss = scalars.get("loss")
+    if loss is not None:
+        _telemetry.set_gauge("hvd_loop_loss", loss)
 
 
 def _merge_sentinel_counters(logs: Dict[str, Any]) -> None:

@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 import jax
 
+from ..core import telemetry as _telemetry
 from ..core.logging import get_logger
 
 
@@ -60,6 +61,9 @@ class CheckpointManager:
         if saved:
             get_logger().info("checkpoint queued at step %d -> %s", step,
                               self._dir)
+            _telemetry.inc("hvd_commits_total")
+            _telemetry.record_event("checkpoint_commit", step=int(step),
+                                    directory=self._dir)
         return saved
 
     def restore(self, step: Optional[int] = None,
@@ -82,7 +86,11 @@ class CheckpointManager:
         args = (ocp.args.StandardRestore(like) if like is not None
                 else ocp.args.StandardRestore())
         if step is not None:
-            return self._mgr.restore(step, args=args)
+            out = self._mgr.restore(step, args=args)
+            _telemetry.inc("hvd_restores_total")
+            _telemetry.record_event("checkpoint_restore", step=int(step),
+                                    directory=self._dir)
+            return out
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(
@@ -105,6 +113,10 @@ class CheckpointManager:
                     "altered the state tree) this silently rewinds "
                     "training; pass step= to fail loudly instead.",
                     s, self._dir, [f[0] for f in failed])
+            _telemetry.inc("hvd_restores_total")
+            _telemetry.record_event("checkpoint_restore", step=int(s),
+                                    directory=self._dir,
+                                    stale=bool(failed))
             return out
         newest_exc = failed[0][1]
         if len({(type(e).__name__, str(e)) for _, e in failed}) == 1:

@@ -41,6 +41,7 @@ import numpy as np
 from jax import lax
 
 from horovod_tpu.core import context_api as _ctx
+from ..core import telemetry as _telemetry
 from ..core.process_sets import ProcessSet
 from .compression import Compression, Compressor
 
@@ -300,9 +301,18 @@ def _fused_reduce(tensors, compression: Compressor, reduce_flat,
             y = jnp.where(member, y, leaves[i])
         return y
 
+    # Trace-time telemetry: bucket count and wire bytes are static
+    # properties of the traced pytree (cx.size/itemsize are Python ints
+    # here), so the record fires once per TRACE, never per execution —
+    # zero cost inside the compiled step.
+    total_bytes = sum(cx.size * cx.dtype.itemsize for cx, _ in compressed)
+
     if max_bucket_bytes == 0:
         # Fusion disabled (HOROVOD_FUSION_THRESHOLD=0, reference semantics):
         # one collective per tensor.
+        _telemetry.inc("hvd_collective_issues_total")
+        _telemetry.record_event("collective_issue", buckets=len(compressed),
+                                tensors=len(leaves), bytes=total_bytes)
         return jax.tree_util.tree_unflatten(
             treedef, [finish(i, reduce_flat(cx.ravel()))
                       for i, (cx, _) in enumerate(compressed)])
@@ -328,6 +338,9 @@ def _fused_reduce(tensors, compression: Compressor, reduce_flat,
         for i, (cx, _) in enumerate(compressed):
             per_dtype.setdefault(cx.dtype, []).append(i)
         bucket_idxs = list(per_dtype.values())
+    _telemetry.inc("hvd_collective_issues_total")
+    _telemetry.record_event("collective_issue", buckets=len(bucket_idxs),
+                            tensors=len(leaves), bytes=total_bytes)
     for idxs in bucket_idxs:
         if len(idxs) == 1:
             i = idxs[0]

@@ -669,6 +669,42 @@ class JaxProcessEngine(CollectiveEngine):
                 box["error"] = e
             box["done"].set()
 
+    _TRANSPORT_ERROR_MARKERS = (
+        "Gloo", "Connection reset by peer", "Broken pipe",
+        "Connection refused", "Socket closed", "connection closed")
+
+    def _translate_transport_error(self, e: BaseException, what: str):
+        """Map a transport-level collective failure (a gloo reset/refused —
+        what a peer dying MID-round looks like, as opposed to the silent
+        hang the stall windows bound) to ``HorovodInternalError``: the
+        reference's collective-error signal that ``@hvd.elastic.run``
+        catches. Returns the replacement exception, or None when ``e`` is
+        not a transport failure (user errors must propagate untouched)."""
+        msg = str(e)
+        if not any(m in msg for m in self._TRANSPORT_ERROR_MARKERS):
+            return None
+        from .exceptions import HorovodInternalError
+        from . import telemetry as _telemetry
+        self._transport_lost = (
+            f"engine {what} failed in the collective transport: {msg[:300]}"
+            " — a peer died mid-round; re-init required (under hvdrun "
+            "--min-np the elastic driver relaunches the job)")
+        _telemetry.inc("hvd_transport_lost_total", cause="transport_error")
+        _telemetry.record_event("transport_lost", what=what,
+                                cause="transport_error", error=msg[:200])
+        return HorovodInternalError(self._transport_lost)
+
+    def _run_translated(self, fn, what: str):
+        """Direct-call path of :meth:`_bounded` with the same transport-
+        error translation as the round-thread path."""
+        try:
+            return fn()
+        except Exception as e:   # noqa: BLE001 — filtered by the markers
+            translated = self._translate_transport_error(e, what)
+            if translated is not None:
+                raise translated from e
+            raise
+
     def _bounded(self, fn, what: str):
         """Run one blocking transport call under the stall watchdog.
 
@@ -697,9 +733,10 @@ class JaxProcessEngine(CollectiveEngine):
         # the reference default that used to mean "blocked forever").
         peer_armed = _watchdog.engine_peer_watch_armed()
         if warn <= 0 and shutdown <= 0 and not peer_armed:
-            return fn()
+            return self._run_translated(fn, what)
         if getattr(self._stall_in_pool, "flag", False):
-            return fn()   # nested transport call, already on the round thread
+            # nested transport call, already on the round thread
+            return self._run_translated(fn, what)
         if self._transport_lost is not None:
             from .exceptions import HorovodInternalError
             raise HorovodInternalError(self._transport_lost)
@@ -719,7 +756,12 @@ class JaxProcessEngine(CollectiveEngine):
             while True:
                 if box["done"].wait(timeout=0.25):
                     if "error" in box:
-                        raise box["error"]
+                        err = box["error"]
+                        translated = self._translate_transport_error(
+                            err, what)
+                        if translated is not None:
+                            raise translated from err
+                        raise err
                     return box["result"]
                 idle = _time.monotonic() - start
                 if warn > 0 and idle >= warn and not warned:
@@ -732,12 +774,18 @@ class JaxProcessEngine(CollectiveEngine):
                         what, idle, shutdown)
                 if shutdown > 0 and idle >= shutdown:
                     from .exceptions import HorovodInternalError
+                    from . import telemetry as _telemetry
                     self._transport_lost = (
                         f"engine {what} stalled for >{shutdown:.0f}s "
                         "(HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); the "
                         "transport is considered lost — re-init required "
                         "(under hvdrun --min-np the elastic driver "
                         "relaunches the job)")
+                    _telemetry.inc("hvd_transport_lost_total",
+                                   cause="stall_shutdown")
+                    _telemetry.record_event("transport_lost", what=what,
+                                            cause="stall_shutdown",
+                                            idle_seconds=round(idle, 3))
                     raise HorovodInternalError(self._transport_lost)
                 reason = _watchdog.engine_deadline_reason(start)
                 if reason is not None:
@@ -746,11 +794,17 @@ class JaxProcessEngine(CollectiveEngine):
                     # stays parked in the dead collective, same escalation
                     # as the stall shutdown above.
                     from .exceptions import HorovodInternalError
+                    from . import telemetry as _telemetry
                     self._transport_lost = (
                         f"engine {what} abandoned: {reason}; the transport "
                         "is considered lost — re-init required (under "
                         "hvdrun --min-np the elastic driver relaunches "
                         "the job)")
+                    _telemetry.inc("hvd_transport_lost_total",
+                                   cause="deadline")
+                    _telemetry.record_event("transport_lost", what=what,
+                                            cause="deadline",
+                                            reason=str(reason)[:200])
                     raise HorovodInternalError(self._transport_lost)
         finally:
             if peer_armed:

@@ -247,6 +247,14 @@ class Sentinel:
                                  action.reason))
             get_logger().warning("sentinel: %s at step %d (%s)",
                                  action.kind, step, action.reason)
+            # Verdict + ladder transition into the flight ring/registry
+            # (host-side scalars only — health was already decoded).
+            from . import telemetry as _telemetry
+            _telemetry.inc("hvd_sentinel_verdicts_total", kind=action.kind)
+            _telemetry.record_event("sentinel", verdict=action.kind,
+                                    step=step, rank=action.rank,
+                                    reason=action.reason,
+                                    in_containment=self.in_containment)
         return action
 
     # -- the ladder ----------------------------------------------------------
@@ -360,6 +368,12 @@ def default_evict(action: SentinelAction) -> None:
             action.reason)
         # Hard exit (no atexit): mirrors run_fn's restart exit — a rank
         # voted corrupt must not run teardown collectives against peers.
+        # Dump the flight ring first: this is the evicted rank's only
+        # chance to leave a forensic record for the incident report.
+        from . import telemetry as _telemetry
+        _telemetry.record_event("evict_exit", rank=my_rank,
+                                reason=action.reason)
+        _telemetry.dump_flight("sentinel_evict")
         os._exit(C.EVICT_EXIT_CODE)
     raise HorovodInternalError(
         f"sentinel {action.kind}: rank {action.rank} voted corrupt "

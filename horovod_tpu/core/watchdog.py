@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from . import telemetry as _telemetry
 from .exceptions import HorovodInternalError
 from .logging import get_logger
 
@@ -122,6 +123,10 @@ class StepMonitor:
         self._inflight_what: Optional[str] = None
         # Peer death: (monotonic time observed, description).
         self._peer_failure: Optional[tuple] = None
+        # Graceful membership bump observed mid-round: (monotonic time,
+        # description). Peers exit RESTART at their next commit, so an
+        # in-flight round they leave behind can never complete.
+        self._membership_reset: Optional[tuple] = None
         # Control-plane loss: the coordinator has been continuously
         # unreachable past HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS
         # (CoordinatorLostError from the retrying client). Unlike a peer
@@ -144,6 +149,7 @@ class StepMonitor:
         self._watcher_started = False
         self._client = None        # CoordinatorClient, lazy
         self._client_missing = False
+        self._telemetry_pushed = 0.0  # last piggybacked metrics push
 
     # -- configuration (re-read per step: tests and drivers set env late) --
 
@@ -190,11 +196,16 @@ class StepMonitor:
     # -- heartbeats ---------------------------------------------------------
 
     def heartbeat(self) -> Dict[str, Any]:
-        """Watcher-visible step progress snapshot."""
+        """Watcher-visible step progress snapshot.
+
+        The snapshot is also published through the telemetry registry
+        (``hvd_heartbeat_*`` gauges + ``hvd_sentinel_*``), so the
+        torch/TF heartbeat spans and jit-step spans report through one
+        surface instead of a parallel bespoke dict."""
         from . import sentinel as _sentinel
         with self._lock:
             now = time.monotonic()
-            return {
+            hb = {
                 "steps_completed": self._steps_completed,
                 "in_flight": self._inflight_since is not None,
                 "in_flight_what": self._inflight_what,
@@ -208,19 +219,66 @@ class StepMonitor:
                 # when no sentinel is active this process.
                 "sentinel": _sentinel.counters(),
             }
+        _telemetry.set_gauge("hvd_heartbeat_steps_completed",
+                             hb["steps_completed"])
+        _telemetry.set_gauge("hvd_heartbeat_in_flight",
+                             1.0 if hb["in_flight"] else 0.0)
+        _telemetry.set_gauge("hvd_heartbeat_in_flight_seconds",
+                             hb["in_flight_seconds"])
+        for k, v in hb["sentinel"].items():
+            _telemetry.set_gauge("hvd_sentinel_%s" % k, float(v))
+        return hb
 
     # -- peer liveness ------------------------------------------------------
 
     def notify_peer_failure(self, info: str) -> None:
         """Arm the peer-death deadline on the in-flight step (called by the
         coordinator watcher; tests inject directly)."""
+        first = False
         with self._lock:
             if self._peer_failure is None:
                 self._peer_failure = (time.monotonic(), info)
+                first = True
+        if first:
+            # The survivor's "rescue" record: a peer died and this rank
+            # armed containment. Dump the ring now — even if the ensuing
+            # restart goes through os._exit (which skips atexit), the
+            # forensic record of the last steps already exists on disk.
+            _telemetry.inc("hvd_peer_failures_total")
+            _telemetry.record_event("rescue", reason=info,
+                                    grace_s=self.peer_grace_s)
+            _telemetry.dump_flight("peer_failure")
         get_logger().warning(
             "peer failure notified: %s — arming %.1fs grace deadline on "
             "the in-flight step (%s)", info, self.peer_grace_s,
             PEER_GRACE_ENV)
+
+    def notify_membership_reset(self, info: str) -> None:
+        """Arm the abandon deadline for a GRACEFUL membership bump observed
+        while a round is in flight. The cooperative reset protocol assumes
+        every worker polls the bump at its next commit — but a worker whose
+        jittered commit-time poll was paced past the bump can already be
+        parked inside the next collective when its peers restart-exit; no
+        commit ever comes, and the generation wedges until the stall window
+        (the host-add test deadlocked exactly so: the resetter blocked in
+        the runtime's shutdown barrier against the wedged survivor). The
+        peer-grace window gives an in-flight round that CAN still complete
+        (peers not yet exited) time to finish and take the interrupt at
+        commit instead; abandoning early costs nothing extra — a version
+        bump means this worker must roll back to its last commit and
+        restart either way."""
+        first = False
+        with self._lock:
+            if self._membership_reset is None:
+                self._membership_reset = (time.monotonic(), info)
+                first = True
+        if first:
+            _telemetry.record_event("generation_change", reason=info,
+                                    grace_s=self.peer_grace_s)
+            get_logger().info(
+                "membership changed mid-round: %s — arming %.1fs grace "
+                "deadline on the in-flight round (%s)", info,
+                self.peer_grace_s, PEER_GRACE_ENV)
 
     def notify_control_plane_lost(self, info: str) -> None:
         """Mark the control plane lost (called when the retrying client
@@ -235,6 +293,8 @@ class StepMonitor:
                 self._control_plane_lost = info
                 first = True
         if first:
+            _telemetry.inc("hvd_control_plane_lost_total")
+            _telemetry.record_event("rpc_escalation", reason=info)
             get_logger().error("control plane lost: %s — escalating "
                                "instead of polling a dead coordinator "
                                "forever", info)
@@ -254,6 +314,7 @@ class StepMonitor:
         fresh monitor.)"""
         with self._lock:
             self._peer_failure = None
+            self._membership_reset = None
             self._control_plane_lost = None
             self._completed_by_what = {}
             # Re-resolve the coordinator on next use: the recovery may
@@ -341,8 +402,10 @@ class StepMonitor:
                 # step/round is abandoned on its next tick.
                 self.notify_control_plane_lost(str(e))
                 continue
+            self._maybe_push_telemetry(client)
             if not world:
                 continue
+            self._maybe_notify_membership_reset(world)
             seq = int(world.get("failure_seq", 0))
             prev = self._failure_seq_seen
             # Always adopt the coordinator's seq — including DOWN (a new
@@ -365,6 +428,46 @@ class StepMonitor:
                 for f in failures)
             self.notify_peer_failure(desc)
 
+    def _maybe_notify_membership_reset(self, world: Dict[str, Any]) -> None:
+        """Arm the graceful-reset deadline when the coordinator's membership
+        version has moved past the version this worker was launched with
+        (see notify_membership_reset for why commit-time polling alone is
+        not enough)."""
+        with self._lock:
+            if self._membership_reset is not None:
+                return
+        from ..elastic import constants as C
+        try:
+            launch = int(os.environ.get(C.WORLD_VERSION_ENV) or 0)
+            version = int(world.get("version") or 0)
+        except (TypeError, ValueError):
+            return
+        if launch and version > launch:
+            self.notify_membership_reset(
+                f"membership version {version} > launch version {launch}")
+
+    def _maybe_push_telemetry(self, client) -> None:
+        """Piggyback a compact metrics delta (plus a throttled heartbeat
+        ring event) on the ``/world`` poll the watcher already pays for —
+        no extra poll loop, no extra connection."""
+        now = time.monotonic()
+        if now - self._telemetry_pushed < 2.0:
+            return
+        self._telemetry_pushed = now
+        hb = self.heartbeat()
+        _telemetry.record_event(
+            "heartbeat", steps_completed=hb["steps_completed"],
+            in_flight=hb["in_flight"], in_flight_what=hb["in_flight_what"],
+            in_flight_seconds=round(hb["in_flight_seconds"], 3))
+        delta = _telemetry.export_delta()
+        if delta is None:
+            return
+        try:
+            client.push_metrics(_telemetry.active().rank, delta)
+        except Exception as e:   # noqa: BLE001 — push is best-effort;
+            # escalation belongs to the get_world path, not the piggyback.
+            get_logger().debug("telemetry push skipped: %s", e)
+
     # -- deadline evaluation ------------------------------------------------
 
     def deadline_reason(self, started: float,
@@ -384,11 +487,17 @@ class StepMonitor:
                     f"{self.step_timeout_s:.0f}s{scaled}")
         with self._lock:
             pf = self._peer_failure
+            mr = self._membership_reset
             cpl = self._control_plane_lost
         if pf is not None and now - pf[0] >= self.peer_grace_s:
             return (f"peer died ({pf[1]}); in-flight collective cannot "
                     f"complete ({PEER_GRACE_ENV}={self.peer_grace_s:.0f}s "
                     "elapsed)")
+        if mr is not None and now - mr[0] >= self.peer_grace_s:
+            return (f"hosts updated ({mr[1]}); peers reset at their next "
+                    "commit, so the in-flight round cannot complete — "
+                    "restarting into the new world "
+                    f"({PEER_GRACE_ENV}={self.peer_grace_s:.0f}s elapsed)")
         if cpl is not None:
             # No grace on top: the continuous-failure window already
             # elapsed inside the client before this flag was set.
@@ -400,6 +509,8 @@ class StepMonitor:
             return True
         with self._lock:
             if self._peer_failure is not None and self.peer_grace_s > 0:
+                return True
+            if self._membership_reset is not None and self.peer_grace_s > 0:
                 return True
             if self._control_plane_lost is not None:
                 return True
@@ -420,14 +531,16 @@ class StepMonitor:
         @contextlib.contextmanager
         def span():
             with self._lock:
-                self._inflight_since = time.monotonic()
+                started = self._inflight_since = time.monotonic()
                 self._inflight_what = what
+            _telemetry.record_event("step_begin", what=what)
             if self.peer_watch_available():
                 self._ensure_watcher()
             try:
                 yield
                 with self._lock:
                     self._steps_completed += 1
+                self._note_step_done(what, started)
             finally:
                 with self._lock:
                     self._inflight_since = None
@@ -435,6 +548,19 @@ class StepMonitor:
         return span()
 
     # -- the monitored call -------------------------------------------------
+
+    def _note_step_done(self, what: str, started: Optional[float]) -> None:
+        """Per-step telemetry: counters/histogram plus a ring event. All
+        inputs are host scalars the monitor already holds — never a
+        device fetch (lint-blocking-telemetry guards this invariant)."""
+        dt = (time.monotonic() - started) if started is not None else 0.0
+        with self._lock:
+            n = self._steps_completed
+        _telemetry.inc("hvd_steps_total", what=what)
+        _telemetry.observe("hvd_step_seconds", dt, what=what)
+        _telemetry.set_gauge("hvd_last_step", n)
+        _telemetry.record_event("step_end", what=what, step=n,
+                                seconds=round(dt, 6))
 
     def _fetch_worker(self, q) -> None:
         """Fetch-thread loop. DAEMON on purpose: after a deadline expiry it
@@ -465,6 +591,11 @@ class StepMonitor:
             self._queue = None
         self._mark_engines_lost(msg)
         get_logger().error("%s", msg)
+        _telemetry.inc("hvd_watchdog_expiries_total")
+        _telemetry.record_event("watchdog_expiry", reason=reason)
+        # Dump BEFORE the exit below: os._exit skips atexit hooks, so
+        # this is the only chance to leave a flight record.
+        _telemetry.dump_flight("watchdog_expiry")
         if self.action == "exit":
             from ..elastic import constants as C
             # The runtime cannot be interrupted from Python: make the
@@ -489,13 +620,16 @@ class StepMonitor:
             # resize re-earn this via reset_for_recovery).
             first_of_signature = self._completed_by_what.get(what, 0) == 0
         scale = self.compile_mult if first_of_signature else 1.0
+        _telemetry.record_event("step_begin", what=what)
         try:
             if not self.armed():
                 out = fn()
                 with self._lock:
+                    started = self._inflight_since
                     self._steps_completed += 1
                     self._completed_by_what[what] = \
                         self._completed_by_what.get(what, 0) + 1
+                self._note_step_done(what, started)
                 return out
             if self.peer_watch_available():
                 self._ensure_watcher()
@@ -527,6 +661,7 @@ class StepMonitor:
                         self._steps_completed += 1
                         self._completed_by_what[what] = \
                             self._completed_by_what.get(what, 0) + 1
+                    self._note_step_done(what, started)
                     return box["result"]
                 reason = self.deadline_reason(started, timeout_scale=scale)
                 if reason is not None:

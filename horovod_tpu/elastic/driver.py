@@ -22,12 +22,14 @@ GPU/Gloo-ism this design deliberately drops (SURVEY.md §7 step 7).
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..core import telemetry as _telemetry
 from ..core.logging import get_logger
 from ..runner import secret as _secret
 from ..runner.exec_run import (default_coordinator_addr, is_local,
@@ -111,6 +113,19 @@ class ElasticDriver:
                                            journal_path=self._journal_path)
         self._service_lock = threading.Lock()
         self._resets = 0
+        # Flight-recorder/incident directory. Deliberately NOT under
+        # _coord_dir (which run() rmtree's): the dumps and the assembled
+        # incident_<failure_seq>.json ARE the post-mortem record and must
+        # outlive the job. Operators point HOROVOD_FLIGHT_DIR somewhere
+        # durable; the fallback is a pid-stamped tempdir that is never
+        # cleaned by this process.
+        self._flight_dir = (os.environ.get(_telemetry.FLIGHT_DIR_ENV)
+                            or os.path.join(tempfile.gettempdir(),
+                                            f"hvd_flight_{os.getpid()}"))
+        os.makedirs(self._flight_dir, exist_ok=True)
+        self._incident_seq_seen = 0
+        get_logger().info("flight-recorder dir: %s (%s)", self._flight_dir,
+                          _telemetry.FLIGHT_DIR_ENV)
 
     # -- membership ----------------------------------------------------------
 
@@ -233,6 +248,10 @@ class ElasticDriver:
             # inside one poll window would miss the bump and finish at the
             # old world size.
             C.POLL_INTERVAL_ENV: str(self._settings.discovery_interval_s),
+            # Workers dump their flight-recorder rings here on abnormal
+            # exit; the driver assembles surviving dumps into the
+            # incident report after a failed generation.
+            _telemetry.FLIGHT_DIR_ENV: self._flight_dir,
         }
         # Pod-scale poll hygiene (docs/elastic.md "Scale tuning"): jitter
         # decorrelates lockstep workers' commit-time polls, the long-poll
@@ -280,9 +299,10 @@ class ElasticDriver:
             threading.Thread(target=_registration_watch, daemon=True).start()
 
         def run_one(a):
+            note: Dict[str, bool] = {}
             code = run_host_process(a, self._command, self._settings, coord,
                                     self._key, stop, extra_env=extra,
-                                    output_dir=out_dir)
+                                    output_dir=out_dir, sweep_note=note)
             with lock:
                 codes[a.hostname] = code
             # Fate sharing: first non-zero exit retires the whole
@@ -295,14 +315,17 @@ class ElasticDriver:
             # in-flight step instead of blocking until the stall window
             # (docs/failure_model.md).
             if code != 0:
-                # Sentinel evictions are published UNCONDITIONALLY: every
-                # survivor exits RESTART at the same step (the eviction
-                # vote is replicated), so the first survivor's exit can
-                # set `stop` before the evicted rank's code lands — the
-                # not-stopped guard alone would lose the failure record
-                # the ban and the failure_seq advance both hang off.
+                # A death the SWEEP caused (collateral SIGTERM/SIGKILL of
+                # a worker the driver itself tore down after the stop
+                # event) is not a failure; an ORGANIC death is, no matter
+                # which landed first. The old `not stop.is_set()` proxy
+                # lost the victim's failure record — and the incident
+                # report hanging off the failure_seq advance — whenever a
+                # rescued survivor's RESTART exit won the race with the
+                # victim's own exit-code delivery.
                 if code == C.EVICT_EXIT_CODE or (
-                        code != C.RESTART_EXIT_CODE and not stop.is_set()):
+                        code != C.RESTART_EXIT_CODE
+                        and not note.get("swept")):
                     self._service.mark_failure(a.hostname, code)
                 stop.set()
 
@@ -343,6 +366,7 @@ class ElasticDriver:
                 stop.set()
                 watcher.join()
                 result = self._classify(codes)
+                self._maybe_assemble_incident(version, codes)
                 if result == "success":
                     return 0
                 if result == "abort":
@@ -362,6 +386,43 @@ class ElasticDriver:
             import shutil
             shutil.rmtree(commit_dir, ignore_errors=True)
             shutil.rmtree(self._coord_dir, ignore_errors=True)
+
+    # -- post-mortem assembly ------------------------------------------------
+
+    def _journal_tail(self, n: int = 50) -> List[dict]:
+        """Last ``n`` decodable coordinator journal records — the control-
+        plane side of the incident timeline."""
+        try:
+            with open(self._journal_path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()[-n:]
+        except OSError:
+            return []
+        out = []
+        for line in lines:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out
+
+    def _maybe_assemble_incident(self, version: int,
+                                 codes: Dict[str, int]) -> None:
+        """After a failed generation, line up the surviving ranks' flight
+        dumps, the coordinator journal tail, and the coordinator's last
+        per-rank metrics (which carry the VICTIM's last-known step — the
+        victim itself never got to dump) into ``incident_<seq>.json``.
+        Runs once per failure_seq advance; all generations of one run
+        share the flight dir, so the report numbering is monotonic."""
+        seq = self._service.failure_seq
+        if seq <= self._incident_seq_seen:
+            return
+        self._incident_seq_seen = seq
+        _telemetry.assemble_incident(
+            self._flight_dir, seq,
+            journal_tail=self._journal_tail(),
+            coordinator_metrics=self._service.metrics_snapshot(),
+            failure={"generation": version,
+                     "codes": {h: int(c) for h, c in codes.items()}})
 
     def _watch_membership(self, hosts: Dict[str, int], version: int,
                           stop: threading.Event) -> None:

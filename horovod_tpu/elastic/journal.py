@@ -53,6 +53,7 @@ def empty_state() -> Dict[str, Any]:
     return {
         "version": 0, "hosts": {}, "np": 0,
         "failures": [], "failure_seq": 0, "registrations": {},
+        "metrics": {},
     }
 
 
@@ -79,6 +80,15 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         ts = float(rec["ts"])
         for pid in rec["process_ids"]:
             state["registrations"][str(pid)] = ts
+    elif op == "metrics":
+        # One worker's cumulative metrics delta (core/telemetry.py wire
+        # shape: {"c": {series_id: value}, "g": {...}}). Values are
+        # cumulative, so merging is a plain key update and replay order
+        # within a rank keeps last-writer-wins semantics.
+        per_rank = state.setdefault("metrics", {}).setdefault(
+            str(rec["rank"]), {"c": {}, "g": {}})
+        per_rank["c"].update(rec.get("c", {}))
+        per_rank["g"].update(rec.get("g", {}))
     elif op == "snapshot":
         # Compaction marker: reset to the embedded live state.
         snap = rec["state"]
@@ -91,6 +101,9 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         state["failure_seq"] = int(snap["failure_seq"])
         state["registrations"] = {str(k): float(v) for k, v
                                   in snap["registrations"].items()}
+        state["metrics"] = {str(k): {"c": dict(v.get("c", {})),
+                                     "g": dict(v.get("g", {}))}
+                            for k, v in snap.get("metrics", {}).items()}
     else:
         return False
     return True

@@ -31,6 +31,7 @@ import os
 import sys
 from typing import Callable
 
+from ..core import telemetry as _telemetry
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..core.logging import get_logger
 from . import constants as C
@@ -90,8 +91,14 @@ def run(func: Callable) -> Callable:
         while True:
             try:
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
+            except HorovodInternalError as err:
                 from ..core.watchdog import monitor
+                # The survivor's rescue record: the data plane failed and
+                # this rank is entering recovery. Ring-dump NOW — the
+                # restart path below hard-exits (os._exit skips atexit).
+                _telemetry.inc("hvd_elastic_rescues_total")
+                _telemetry.record_event("rescue", reason=str(err)[:200])
+                _telemetry.dump_flight("horovod_internal_error")
                 if monitor().heartbeat().get("control_plane_lost"):
                     # Not a data-plane failure: the coordinator stayed
                     # unreachable past HOROVOD_COORDINATOR_LOST_TIMEOUT_
@@ -127,6 +134,9 @@ def run(func: Callable) -> Callable:
                 state.sync()
             except HostsUpdatedInterrupt as e:
                 get_logger().info("hosts updated: resetting")
+                _telemetry.inc("hvd_generation_changes_total")
+                _telemetry.record_event("generation_change",
+                                        mode=_mode())
                 if _mode() == "restart":
                     sys.exit(C.RESTART_EXIT_CODE)
                 _reinitialize()

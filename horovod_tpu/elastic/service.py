@@ -88,6 +88,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Iterable, Iterator, Optional
 from urllib.parse import parse_qs, urlsplit
 
+from ..core import telemetry as _telemetry
 from ..core.logging import get_logger
 from ..runner import secret as _secret
 from . import constants as C
@@ -212,6 +213,11 @@ class CoordinatorService:
                                                C.DEFAULT_TARGET_RPS))
         self._compact_every = max(0, _env_int(C.COMPACT_EVERY_ENV,
                                               C.DEFAULT_COMPACT_EVERY))
+        # Aggregated worker telemetry: rank (str) -> {"c": {...}, "g":
+        # {...}} compact snapshots (core/telemetry.py wire shape). NOT
+        # part of the /world payload (WORLD_KEYS is frozen) and never
+        # enters the delta window — served separately at GET /metrics.
+        self._metrics: Dict[str, dict] = {}
         self._journal = CoordinatorJournal(journal_path) if journal_path \
             else None
         if restore and journal_path:
@@ -224,6 +230,7 @@ class CoordinatorService:
                 self._failure_seq = state["failure_seq"]
                 self._started = {int(k): v for k, v
                                  in state["registrations"].items()}
+                self._metrics = state.get("metrics", {})
                 get_logger().info(
                     "coordinator state restored from journal %s "
                     "(version=%d failure_seq=%d hosts=%s)", journal_path,
@@ -257,8 +264,29 @@ class CoordinatorService:
                     # Nothing left to tell it.
                     pass
 
+            def _reply_text(self, text: str, code=200):
+                body = text.encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header(SIG_HEADER,
+                                     _secret.sign(svc._key, body))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (OSError, ValueError):
+                    pass
+
             def do_GET(self):
                 parsed = urlsplit(self.path)
+                if parsed.path == "/metrics":
+                    # Prometheus text exposition: per-rank samples (rank
+                    # label injected) + fleet rollup. Plain text so a
+                    # stock scraper / curl needs no HMAC support (the
+                    # signature header is still set for our own client).
+                    self._reply_text(svc.metrics_text())
+                    return
                 if parsed.path != "/world":
                     get_logger().debug(
                         "coordinator: unknown GET path %s from %s",
@@ -307,6 +335,11 @@ class CoordinatorService:
                     else:
                         svc._record_register(int(msg["process_id"]),
                                              time.monotonic())
+                    self._reply({"ok": True})
+                elif self.path == "/metrics":
+                    # Worker metrics push, piggybacked on the existing
+                    # poll cadence (watchdog watcher / commit-time check).
+                    svc._record_metrics(msg)
                     self._reply({"ok": True})
                 else:
                     get_logger().debug(
@@ -380,6 +413,9 @@ class CoordinatorService:
             state = self._snapshot_locked()
             state["registrations"] = {str(k): v
                                       for k, v in self._started.items()}
+            state["metrics"] = {k: {"c": dict(v.get("c", {})),
+                                    "g": dict(v.get("g", {}))}
+                                for k, v in self._metrics.items()}
             self._journal.compact(state)
 
     def _record_register(self, process_id: int, ts: float) -> None:
@@ -402,6 +438,40 @@ class CoordinatorService:
                 self._journal.append({"op": "register_batch",
                                       "process_ids": pids, "ts": ts})
                 self._maybe_compact_locked()
+
+    def _record_metrics(self, msg: dict) -> None:
+        """Merge one worker's cumulative metrics delta and journal it so
+        the aggregate survives a coordinator crash-restart. Does NOT bump
+        ``version``/``failure_seq`` or enter the delta window — metrics
+        churn must not wake long-polls or evict membership history."""
+        try:
+            rank = str(int(msg["rank"]))
+            c = {str(k): float(v) for k, v in msg.get("c", {}).items()}
+            g = {str(k): float(v) for k, v in msg.get("g", {}).items()}
+        except (KeyError, TypeError, ValueError):
+            get_logger().debug("coordinator: malformed metrics push "
+                               "ignored: %r", msg)
+            return
+        with self._lock:
+            per_rank = self._metrics.setdefault(rank, {"c": {}, "g": {}})
+            per_rank["c"].update(c)
+            per_rank["g"].update(g)
+            if self._journal:
+                self._journal.append({"op": "metrics", "rank": rank,
+                                      "c": c, "g": g})
+                self._maybe_compact_locked()
+
+    def metrics_snapshot(self) -> Dict[str, dict]:
+        """Per-rank compact snapshots (deep-copied) — the incident
+        report embeds this to carry the victim's last-known state."""
+        with self._lock:
+            return {k: {"c": dict(v.get("c", {})), "g": dict(v.get("g", {}))}
+                    for k, v in self._metrics.items()}
+
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body: Prometheus text exposition of
+        per-rank samples plus the fleet rollup."""
+        return _telemetry.render_prometheus(self.metrics_snapshot())
 
     def update_world(self, hosts: Dict[str, int], np_: int) -> int:
         """Publish a new membership view; returns the new version."""
@@ -672,6 +742,9 @@ class CoordinatorClient:
                 last = None  # already counted + logged distinctly
             except OSError as e:
                 last = e
+                _telemetry.inc("hvd_rpc_attempt_failures_total")
+                _telemetry.record_event("rpc_retry", path=path,
+                                        attempt=attempt, error=str(e))
                 # A refused connect is what a crash-restarted coordinator
                 # looks like until the new port is published: re-resolve
                 # from the address file before backing off.
@@ -827,6 +900,17 @@ class CoordinatorClient:
         is visible on the driver side too."""
         body = json.dumps({"process_id": process_id}).encode()
         reply = self._call("/register", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def push_metrics(self, rank: int, delta: dict) -> bool:
+        """Push one compact cumulative metrics delta
+        (``core/telemetry.py::export_delta`` shape). Piggybacked on the
+        poll cadence by its callers; a dropped push is healed by the next
+        one (values are cumulative, not increments)."""
+        body = json.dumps({"rank": int(rank),
+                           "c": delta.get("c", {}),
+                           "g": delta.get("g", {})}).encode()
+        reply = self._call("/metrics", data=body)
         return bool(reply and reply.get("ok"))
 
     def register_batch(self, process_ids: Iterable[int]) -> bool:

@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from ..core import telemetry as _telemetry
 from ..core.exceptions import HostsUpdatedInterrupt
 from ..core.logging import get_logger
 from . import constants as C
@@ -152,6 +153,18 @@ class WorkerNotificationManager:
                 from ..core.watchdog import monitor
                 monitor().notify_control_plane_lost(str(e))
                 raise HorovodInternalError(str(e)) from e
+            # Piggyback the compact metrics delta on the poll this commit
+            # already paid for — the coordinator aggregates it for
+            # GET /metrics. Best-effort: cumulative values mean a dropped
+            # push is healed by the next one.
+            delta = _telemetry.export_delta()
+            if delta is not None:
+                try:
+                    self._client.push_metrics(_telemetry.active().rank,
+                                              delta)
+                except Exception as push_err:  # noqa: BLE001
+                    get_logger().debug("telemetry push skipped: %s",
+                                       push_err)
             if world is not None and world["version"] > self._launch_version:
                 get_logger().info(
                     "membership version %d > launch version %d: hosts updated",
@@ -406,6 +419,9 @@ class FrameworkState(State):
             _persist(self._commit_dir,
                      {"seq": self._commit_seq, "fw": self._saved_fw,
                       "scalars": self._saved_scalars})
+            _telemetry.inc("hvd_commits_total")
+            _telemetry.record_event("checkpoint_commit",
+                                    seq=self._commit_seq)
 
     def restore(self) -> None:
         if self._saved_fw is not None:
@@ -424,6 +440,8 @@ class FrameworkState(State):
         self._saved_fw = payload.get("fw")
         self._saved_scalars = dict(payload.get("scalars", {}))
         self.restore()
+        _telemetry.inc("hvd_restores_total")
+        _telemetry.record_event("checkpoint_restore", seq=self._commit_seq)
         return True
 
     def sync(self) -> None:
@@ -477,6 +495,9 @@ class ObjectState(State):
             self._commit_seq += 1
             _persist(self._commit_dir,
                      {"seq": self._commit_seq, "attrs": self._saved})
+            _telemetry.inc("hvd_commits_total")
+            _telemetry.record_event("checkpoint_commit",
+                                    seq=self._commit_seq)
 
     def restore(self) -> None:
         for k, v in self._saved.items():
@@ -495,6 +516,8 @@ class ObjectState(State):
         self._commit_seq = int(payload.get("seq", 0))
         self._saved = payload.get("attrs", payload)
         self.restore()
+        _telemetry.inc("hvd_restores_total")
+        _telemetry.record_event("checkpoint_restore", seq=self._commit_seq)
         return True
 
     def sync(self) -> None:

@@ -246,7 +246,8 @@ def run_host_process(a: HostAssignment, command: Sequence[str],
                      settings: Settings, coordinator_addr: str,
                      secret_key: Optional[bytes], stop: threading.Event,
                      extra_env: Optional[Dict[str, str]] = None,
-                     output_dir: Optional[str] = None) -> int:
+                     output_dir: Optional[str] = None,
+                     sweep_note: Optional[dict] = None) -> int:
     """Run ONE host's worker process to completion; the single launch path
     shared by the static launcher and the elastic driver's generations.
 
@@ -273,7 +274,7 @@ def run_host_process(a: HostAssignment, command: Sequence[str],
                                stderr=err,
                                prefix=str(a.process_id) if settings.verbose
                                else None,
-                               events=[stop])
+                               events=[stop], sweep_note=sweep_note)
             line = get_ssh_command(a, command, env, settings,
                                    cwd=os.getcwd(),
                                    secret_on_stdin=secret_key is not None)
@@ -284,7 +285,7 @@ def run_host_process(a: HostAssignment, command: Sequence[str],
                            stderr=err,
                            prefix=str(a.process_id) if settings.verbose
                            else None,
-                           events=[stop],
+                           events=[stop], sweep_note=sweep_note,
                            stdin_data=("".join(ln + "\n"
                                                for ln in stdin_lines)
                                        .encode()

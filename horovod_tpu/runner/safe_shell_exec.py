@@ -65,7 +65,8 @@ def execute(command: "List[str] | str",
             prefix: Optional[str] = None,
             events: Optional[List[threading.Event]] = None,
             timeout_s: Optional[float] = None,
-            stdin_data: Optional[bytes] = None) -> int:
+            stdin_data: Optional[bytes] = None,
+            sweep_note: Optional[dict] = None) -> int:
     """Run ``command`` in a new process group; return its exit code.
 
     ``events``: if any event is set, the process tree is torn down (the
@@ -74,6 +75,10 @@ def execute(command: "List[str] | str",
     ``timeout_s``: wall-clock cap on THIS process (used for bounded probes,
     not worker lifetimes). ``stdin_data``: written to the child's stdin then
     closed (secret delivery; keeps it off the command line).
+    ``sweep_note``: if given, ``sweep_note["swept"] = True`` is set when the
+    process was terminated BY the events sweep rather than dying on its own
+    — the elastic driver needs the distinction to record organic deaths as
+    failures without also recording its own teardown's collateral exits.
     """
     shell = isinstance(command, str)
     out_sink = stdout if stdout is not None else sys.stdout
@@ -118,6 +123,8 @@ def execute(command: "List[str] | str",
             if proc.poll() is not None:
                 break
             if events and any(e.is_set() for e in events):
+                if sweep_note is not None:
+                    sweep_note["swept"] = True
                 terminate_process_group(proc)
                 break
             if deadline and time.monotonic() > deadline:

@@ -151,7 +151,9 @@ class _DistributedGradientTape:
         # span keeps the step heartbeat honest for the peer-liveness
         # watcher. The call stays on THIS thread — tf.function tracing on
         # a side thread would serialize on TF's tracing lock.
+        from ..core import telemetry as _telemetry
         from ..core import watchdog as _watchdog
+        _telemetry.inc("hvd_frontend_steps_total", frontend="tensorflow")
         with _watchdog.monitor().step_span("tf_gradient"):
             return self._gradient_inner(target, sources, output_gradients)
 

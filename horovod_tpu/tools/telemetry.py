@@ -1,0 +1,232 @@
+"""``python -m horovod_tpu.tools.telemetry`` — telemetry render CLI.
+
+The reference surfaces run health as a Chrome-trace Timeline and log
+lines; this tool is the read side of the TPU rebuild's telemetry
+(core/telemetry.py): it renders the coordinator's ``GET /metrics``
+snapshot and the elastic driver's ``incident_<seq>.json`` post-mortems
+as terminal tables, and converts flight-recorder rings to Chrome-trace
+events so ``tools/timeline.py::merge_chrome_traces`` can lay the
+host-side incident story next to an xplane/profiler export.
+
+Subcommands::
+
+    metrics  <url-or-file>         # GET /metrics (or a saved dump) -> table
+    incident <incident_N.json>     # cross-rank post-mortem -> tables
+    trace    <flight-dir|files...> # rings -> chrome trace (use -o out.json)
+
+``parse_prometheus`` is deliberately a *minimal* text-exposition parser
+(names, labels, values, ``# TYPE`` lines — no exemplars/timestamps): it
+is also the tier-1 round-trip check that what the coordinator serves is
+well-formed (tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def parse_prometheus(text: str) -> Dict[str, Any]:
+    """Parse Prometheus text exposition into
+    ``{"types": {name: kind}, "samples": {sid: float}}``.
+
+    The sample id keeps the label string exactly as served (labels are
+    already emitted sorted by core/telemetry.py), so parse(render(x))
+    round-trips sid-for-sid. Raises ValueError on malformed lines —
+    the round-trip test relies on that strictness.
+    """
+    types: Dict[str, str] = {}
+    samples: Dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue  # HELP / other comments
+        # sample: name{labels} value   (labels optional; value last token)
+        if "}" in line:
+            sid, _, rest = line.rpartition("} ")
+            if not sid:
+                raise ValueError("line %d: malformed sample: %r"
+                                 % (lineno, line))
+            sid += "}"
+        else:
+            sid, _, rest = line.partition(" ")
+        rest = rest.strip().split()[0] if rest.strip() else ""
+        if not sid or not rest:
+            raise ValueError("line %d: malformed sample: %r"
+                             % (lineno, line))
+        name = sid.partition("{")[0]
+        if not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError("line %d: bad metric name %r" % (lineno, name))
+        try:
+            samples[sid] = float(rest)
+        except ValueError:
+            raise ValueError("line %d: bad value %r" % (lineno, rest))
+    return {"types": types, "samples": samples}
+
+
+def _table(rows: List[Tuple[str, ...]], header: Tuple[str, ...]) -> str:
+    widths = [len(h) for h in header]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    fmt = "  ".join("%%-%ds" % w for w in widths)
+    out = [fmt % header, fmt % tuple("-" * w for w in widths)]
+    out += [fmt % row for row in rows]
+    return "\n".join(out)
+
+
+def _fetch_metrics(source: str) -> str:
+    if os.path.exists(source):
+        with open(source) as f:
+            return f.read()
+    import urllib.request
+    if not source.startswith("http"):
+        source = "http://%s/metrics" % source
+    with urllib.request.urlopen(source, timeout=10) as resp:
+        return resp.read().decode()
+
+
+def cmd_metrics(source: str, out=sys.stdout) -> int:
+    parsed = parse_prometheus(_fetch_metrics(source))
+    rows = []
+    for sid in sorted(parsed["samples"]):
+        name = sid.partition("{")[0]
+        labels = sid.partition("{")[2].rstrip("}")
+        v = parsed["samples"][sid]
+        rows.append((name, labels, parsed["types"].get(name, "?"),
+                     ("%d" % v) if v == int(v) else repr(v)))
+    print(_table(rows, ("metric", "labels", "type", "value")), file=out)
+    return 0
+
+
+def _fmt_event(ev: Dict[str, Any]) -> str:
+    extra = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+    return " ".join("%s=%s" % (k, v) for k, v in sorted(extra.items()))
+
+
+def cmd_incident(path: str, out=sys.stdout, tail: int = 12) -> int:
+    with open(path) as f:
+        report = json.load(f)
+    print("incident failure_seq=%s  generation=%s  exit_codes=%s"
+          % (report.get("failure_seq"),
+             report.get("failure", {}).get("generation"),
+             report.get("failure", {}).get("codes")), file=out)
+    metrics = report.get("coordinator_metrics", {})
+    if metrics:
+        rows = []
+        for rank in sorted(metrics, key=str):
+            g = metrics[rank].get("g", {})
+            last = g.get("hvd_last_step")
+            rows.append((str(rank),
+                         "?" if last is None else "%d" % last,
+                         str(len(metrics[rank].get("c", {})))))
+        print(file=out)
+        print("last-known state per rank (coordinator metrics — includes "
+              "ranks that died without dumping):", file=out)
+        print(_table(rows, ("rank", "last_step", "counters")), file=out)
+    for rank in sorted(report.get("ranks", {}), key=int):
+        events = report["ranks"][rank]
+        print(file=out)
+        print("rank %s — last %d of %d recorded events:"
+              % (rank, min(tail, len(events)), len(events)), file=out)
+        rows = [("%.3f" % ev.get("t", 0.0), str(ev.get("kind")),
+                 _fmt_event(ev)) for ev in events[-tail:]]
+        print(_table(rows, ("t", "kind", "fields")), file=out)
+    if not report.get("ranks"):
+        print("(no surviving flight dumps)", file=out)
+    return 0
+
+
+def ring_to_chrome(events: List[Dict[str, Any]], rank: int,
+                   t0: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Flight-recorder events -> Chrome-trace events.
+
+    ``step_begin``/``step_end`` pairs become B/E spans; everything else
+    becomes an instant event carrying its fields as ``args``. Timestamps
+    are wall-clock anchored at ``t0`` (default: the earliest event across
+    the rank), so rings from different ranks line up on the same axis —
+    exactly what the cross-rank incident view needs.
+    """
+    if t0 is None:
+        t0 = min((ev.get("t", 0.0) for ev in events), default=0.0)
+    out = []
+    for ev in events:
+        ts = int((ev.get("t", t0) - t0) * 1e6)
+        kind = ev.get("kind", "?")
+        args = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+        if kind == "step_begin":
+            out.append({"name": args.get("what", "step"), "cat": "step",
+                        "ph": "B", "ts": ts, "pid": rank, "tid": 0})
+        elif kind == "step_end":
+            out.append({"name": args.get("what", "step"), "cat": "step",
+                        "ph": "E", "ts": ts, "pid": rank, "tid": 0,
+                        "args": args})
+        else:
+            out.append({"name": kind, "cat": "telemetry", "ph": "i",
+                        "ts": ts, "pid": rank, "tid": 0, "s": "p",
+                        "args": args})
+    out.append({"name": "process_name", "ph": "M", "pid": rank,
+                "args": {"name": "rank %d flight" % rank}})
+    return out
+
+
+def cmd_trace(sources: List[str], out_path: str) -> int:
+    from ..core.telemetry import load_flight_dumps
+    per_rank: Dict[int, List[Dict[str, Any]]] = {}
+    for src in sources:
+        if os.path.isdir(src):
+            per_rank.update(load_flight_dumps(src))
+        else:
+            base = os.path.basename(src)
+            try:
+                rank = int(base[len("flight_"):-len(".jsonl")])
+            except ValueError:
+                rank = len(per_rank)
+            with open(src) as f:
+                per_rank[rank] = [json.loads(ln) for ln in f if ln.strip()]
+    t0 = min((ev.get("t", 0.0) for evs in per_rank.values() for ev in evs),
+             default=0.0)
+    events: List[Dict[str, Any]] = []
+    for rank in sorted(per_rank):
+        events.extend(ring_to_chrome(per_rank[rank], rank, t0=t0))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    print("wrote %s (%d events, %d ranks) — merge with an xplane export "
+          "via tools/timeline.py::merge_chrome_traces"
+          % (out_path, len(events), len(per_rank)))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.tools.telemetry",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("metrics", help="render a /metrics snapshot")
+    p.add_argument("source", help="coordinator URL, host:port, or saved file")
+    p = sub.add_parser("incident", help="render an incident report")
+    p.add_argument("path")
+    p.add_argument("--tail", type=int, default=12,
+                   help="events shown per rank (default 12)")
+    p = sub.add_parser("trace", help="flight rings -> chrome trace")
+    p.add_argument("sources", nargs="+",
+                   help="flight dir or flight_<rank>.jsonl files")
+    p.add_argument("-o", "--out", default="flight_trace.json")
+    a = ap.parse_args(argv)
+    if a.cmd == "metrics":
+        return cmd_metrics(a.source)
+    if a.cmd == "incident":
+        return cmd_incident(a.path, tail=a.tail)
+    return cmd_trace(a.sources, a.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ single writer thread drains to disk (crash-safe incremental JSON array).
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
@@ -32,7 +33,10 @@ class Timeline:
         self.path = path
         self.mark_cycles = mark_cycles
         self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
-        self._start = time.time()
+        # Monotonic clock anchored at construction: wall-clock (time.time)
+        # is NTP-steppable mid-run, which reorders/negates span timestamps
+        # in the viewer; perf_counter never goes backwards.
+        self._start = time.perf_counter()
         self._open_spans: dict = {}
         self._lock = threading.Lock()
         d = os.path.dirname(path)
@@ -46,11 +50,16 @@ class Timeline:
                                         name="hvd-timeline-writer")
         self._closed = False
         self._writer.start()
+        # Normal interpreter exit closes the JSON array even when the
+        # owner forgot stop_timeline(); close() is idempotent so an
+        # explicit close first is fine. (os._exit paths skip atexit by
+        # design — the flight recorder covers those, docs/telemetry.md.)
+        atexit.register(self.close)
 
     # -- event API (mirrors timeline.cc ActivityStart/ActivityEnd/Marker) --
 
     def _us(self) -> int:
-        return int((time.time() - self._start) * 1e6)
+        return int((time.perf_counter() - self._start) * 1e6)
 
     def activity_start(self, name: str, activity: str, rank: int = 0,
                        tid: int = 0) -> None:

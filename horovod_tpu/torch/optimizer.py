@@ -381,7 +381,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # inside synchronize() get their deadline rescue from the engine's
         # _bounded; the span keeps the step heartbeat honest and gives the
         # peer-liveness watcher an in-flight window to poll under.
+        from ..core import telemetry as _telemetry
         from ..core import watchdog as _watchdog
+        _telemetry.inc("hvd_frontend_steps_total", frontend="torch")
         with _watchdog.monitor().step_span("torch_step"):
             if self._should_synchronize:
                 self.synchronize()

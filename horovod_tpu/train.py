@@ -27,6 +27,7 @@ except ImportError:  # pragma: no cover
 
 from .core import context_api as _ctx
 from .core import sentinel as _sentinel
+from .core import telemetry as _telemetry
 from .core.watchdog import monitored_step
 from .collectives import ops as _ops
 from .collectives.ops import effective_axis_size, force_axis_size1
@@ -238,6 +239,11 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         # timeline is read PER CALL (a runtime check, like the reference's)
         # so start_timeline/stop_timeline work in any order relative to
         # building the step, and a closed timeline is never written to.
+        # Registry counter, not a device read: the dispatch is async and
+        # the loss is still a future here — step timing/loss reads belong
+        # to the watchdog span and the Keras callback, which see values
+        # the host already fetched.
+        _telemetry.inc("hvd_dispatches_total", what="train_step")
         tl = _ctx.context().timeline if _ctx.is_initialized() else None
         if tl is None or getattr(tl, "_closed", False):
             return dispatch(*args, **kwargs)

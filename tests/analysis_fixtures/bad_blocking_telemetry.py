@@ -1,0 +1,33 @@
+"""lint-blocking-telemetry fixture: a step loop whose telemetry record
+forces a device fetch (np.asarray on the live loss) every iteration —
+the blocking read stalls the async dispatch pipeline that the ≤1.02
+overhead guard protects. Exactly ONE finding: the host-side record
+below the loop and the fetch-outside-the-call pattern must stay clean.
+"""
+import numpy as np
+
+from horovod_tpu.core import telemetry as _telemetry
+
+
+def train(step_fn, state, batches):
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        # loss is still a device future here; asarray blocks on it.
+        _telemetry.record_event(  # <- lint-blocking-telemetry
+            "step_end", loss=float(np.asarray(loss)))
+    return state
+
+
+def train_fetch_outside(step_fn, state, batches):
+    # Clean: the fetch happens OUTSIDE the telemetry call, at a point
+    # the caller chose to synchronize anyway.
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        host_loss = float(np.asarray(loss))
+        _telemetry.record_event("step_end", loss=host_loss)
+    return state
+
+
+def summarize(final_loss):
+    # Clean: not in a loop — a one-off end-of-run fetch is fine.
+    _telemetry.record_event("train_end", loss=float(np.asarray(final_loss)))

@@ -209,6 +209,7 @@ LINT_CASES = [
     ("bad_unguarded_apply.py", "jax-unguarded-apply", "warning"),
     ("bad_monolithic_psum.py", "lint-monolithic-psum", "warning"),
     ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
+    ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
 ]
 
 

@@ -1117,6 +1117,109 @@ def test_persistent_coordinator_loss_escalates_worker(tmp_path):
     assert elapsed < 120, f"escalation not bounded: {elapsed:.0f}s"
 
 
+TELEMETRY_CHAOS_WORKER = """
+import json
+import os
+import signal
+import time
+# Survivors must be rescued by their OWN HorovodInternalError path (which
+# records the rescue event and dumps the flight ring) — not the driver's
+# fate-sharing SIGTERM, whose default handler would die without dumping.
+# A rank wedged inside the compiled runtime couldn't run a handler either.
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.core import watchdog
+from horovod_tpu.optimizer import allgather_object
+from horovod_tpu.testing import faults
+
+hvd.init()
+mon = watchdog.monitor()
+state = elastic.ObjectState(step=0)
+
+@elastic.run
+def train(state):
+    while state.step < 8:
+        faults.on_step(state.step, rank=hvd.rank())   # victim dies here
+        with mon.step_span("telemetry_chaos_step"):
+            allgather_object(float(state.step))
+        state.step += 1
+        state.commit()   # piggybacks the metrics delta on the poll
+        time.sleep(0.3)
+    return state.step
+
+train(state)
+print(json.dumps({"final_step": state.step, "size": hvd.size()}),
+      flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_chaos_kill_produces_cross_rank_incident_report(tmp_path):
+    """The flight-recorder/incident tentpole end to end (docs/telemetry.md):
+    3 real workers in a collective loop; rank 2 is SIGKILLed at step 5.
+    Both survivors take HorovodInternalError, record a ``rescue`` event
+    and dump their rings to HOROVOD_FLIGHT_DIR; the driver assembles
+    ``incident_1.json`` joining the surviving dumps, the coordinator
+    journal tail, and the coordinator's per-rank metrics — which carry
+    the VICTIM's last-known step even though the victim never dumped.
+    The relaunched generation then finishes cleanly."""
+    flight_dir = tmp_path / "flight"
+    disco = tmp_path / "discover.sh"
+    disco.write_text(
+        "#!/bin/sh\necho localhost:1\necho 127.0.0.2:1\necho 127.0.0.3:1\n")
+    disco.chmod(0o755)
+    script = tmp_path / "telemetry_chaos_worker.py"
+    script.write_text(TELEMETRY_CHAOS_WORKER)
+    r = _run_hvdrun(["-np", "3", "--min-np", "2", "--max-np", "3",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "kill:rank=2,step=5",
+                     sys.executable, str(script)], timeout=300,
+                    env_extra={"HOROVOD_FLIGHT_DIR": str(flight_dir),
+                               "HOROVOD_FAULT_MARKER_DIR":
+                                   str(tmp_path / "fault_markers"),
+                               # peer push is the rescue; stall window as
+                               # fallback — both beat the 5s SIGKILL
+                               "HOROVOD_PEER_FAILURE_GRACE_SECONDS": "1",
+                               "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4",
+                               "HOROVOD_LOG_LEVEL": "INFO"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert lines and all(l["final_step"] == 8 for l in lines), r.stdout
+
+    incidents = sorted(flight_dir.glob("incident_*.json"))
+    assert incidents, list(flight_dir.iterdir())
+    report = json.loads(incidents[0].read_text())
+    assert report["failure_seq"] >= 1
+
+    # ≥2 surviving ranks dumped, each with the rescue event; the victim
+    # (rank 2) never dumped — it was SIGKILLed mid-step.
+    survivors = {rk for rk in report["ranks"] if rk != "2"}
+    assert len(survivors) >= 2, report["ranks"].keys()
+    for rk in survivors:
+        kinds = [ev["kind"] for ev in report["ranks"][rk]]
+        assert "rescue" in kinds, (rk, kinds)
+        assert "step_end" in kinds, (rk, kinds)
+        assert kinds[-1] == "flight_dump", (rk, kinds)
+
+    # the victim's last-known step survives via the coordinator's last
+    # pushed metrics (commit() piggybacks the delta on the poll cadence)
+    victim = report["coordinator_metrics"]["2"]
+    assert victim["g"]["hvd_last_step"] >= 1.0, victim
+    assert report["journal_tail"], report.keys()
+
+    # the CLI renders the report (the post-mortem the operator reads)
+    import io
+    from horovod_tpu.tools.telemetry import cmd_incident
+    buf = io.StringIO()
+    assert cmd_incident(str(incidents[0]), out=buf) == 0
+    text = buf.getvalue()
+    assert "rescue" in text and "last_step" in text
+
+
 SENTINEL_NAN_WORKER = """
 import json
 import numpy as np

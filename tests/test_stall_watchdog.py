@@ -151,7 +151,8 @@ def test_elastic_driver_arms_default_shutdown_window(monkeypatch):
     captured = {}
 
     def fake_run_host_process(a, command, settings, coord, key, stop,
-                              extra_env=None, output_dir=None):
+                              extra_env=None, output_dir=None,
+                              sweep_note=None):
         captured.update(extra_env or {})
         return 0
 

@@ -101,6 +101,47 @@ def test_peer_failure_arms_grace_deadline(monkeypatch):
     assert time.monotonic() - t0 < 10.0
 
 
+def test_membership_reset_arms_grace_deadline(monkeypatch):
+    """A GRACEFUL membership bump (version moved past the launch version,
+    nobody died) must also rescue a blocked round: the cooperative reset
+    relies on commit-time polls, but a worker already parked inside a
+    collective its peers abandoned never reaches another commit — the
+    host-add deadlock this pins down (resetter wedged in the runtime's
+    shutdown barrier against the survivor's dead round)."""
+    from horovod_tpu.elastic import constants as C
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(PEER_GRACE_ENV, "0.4")
+    monkeypatch.setenv(C.WORLD_VERSION_ENV, "1")
+    m = StepMonitor()
+    # What the /world watcher sees mid-round: the driver bumped to v2.
+    m._maybe_notify_membership_reset({"version": 2, "failure_seq": 0})
+    t0 = time.monotonic()
+    with pytest.raises(HorovodInternalError, match="hosts updated"):
+        m.monitored_call(lambda: threading.Event().wait(), what="t")
+    assert time.monotonic() - t0 < 10.0
+    # Same-or-older versions must NOT arm.
+    m2 = StepMonitor()
+    m2._maybe_notify_membership_reset({"version": 1, "failure_seq": 0})
+    assert not m2.armed()
+    m2.reset_for_recovery()
+
+
+def test_reset_for_recovery_clears_membership_reset(monkeypatch):
+    """The in-process recovery path re-enters the NEW world: the old
+    generation's membership-reset flag must not abandon its steps."""
+    from horovod_tpu.elastic import constants as C
+    _clear_env(monkeypatch)
+    monkeypatch.setenv(PEER_GRACE_ENV, "0.1")
+    monkeypatch.setenv(C.WORLD_VERSION_ENV, "1")
+    m = StepMonitor()
+    m._maybe_notify_membership_reset({"version": 2})
+    time.sleep(0.2)   # grace long expired
+    assert m.armed()
+    m.reset_for_recovery()
+    assert not m.armed()
+    assert m.monitored_call(lambda: "ok", what="t") == "ok"
+
+
 def test_peer_push_rescues_blocked_step(monkeypatch):
     """End-to-end push through the real CoordinatorService: driver marks a
     failure on /world, the monitor's watcher polls it up and abandons the
