@@ -67,6 +67,17 @@ CLAUDE.md "Environment traps"):
   already fetched (the watchdog span / Keras logs), or fetch OUTSIDE
   the telemetry call at a point that must synchronize anyway.
 
+- ``lint-blocking-commit`` (WARNING): a bare ``jax.device_get`` inside
+  a step/commit loop — a loop that also calls ``.commit()``.  The
+  elastic commit path is pipelined (elastic/state.py
+  ``_CommitWriter``): ``commit()`` takes a cheap on-device copy and the
+  background writer overlaps the device→host transfer with subsequent
+  steps, so a synchronous ``device_get`` of training state feeding the
+  commit re-serializes exactly the stall the async writer removes (and
+  shows up as ``hvd_commit_stall_seconds``).  Hand ``commit()`` the
+  LIVE arrays and let the writer fetch them off-thread; fetch host
+  copies yourself only outside the step loop.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -120,6 +131,13 @@ TELEMETRY_RECORD_NAMES = frozenset({
 TELEMETRY_BARE_NAMES = frozenset({"record_event", "set_gauge"})
 FETCH_CALL_NAMES = frozenset({"block_until_ready", "asarray",
                               "device_get"})
+
+# lint-blocking-commit vocabulary: the commit entry point marking a loop
+# as a step/commit loop, and the synchronous fetch that defeats the async
+# commit writer. Restricted to ``device_get`` (not ``asarray``, which has
+# many host-side uses) to keep the rule precise.
+COMMIT_CALL_NAMES = frozenset({"commit"})
+COMMIT_FETCH_NAMES = frozenset({"device_get"})
 
 
 def _is_telemetry_record(name: str) -> bool:
@@ -209,6 +227,9 @@ class _Lint(ast.NodeVisitor):
         # lint-unbounded-poll: poll sites already attributed to an
         # enclosing while loop (nested loops must not re-flag them).
         self._poll_handled: set = set()
+        # lint-blocking-commit: fetch sites already attributed to an
+        # enclosing (outermost) commit loop.
+        self._commit_fetch_handled: set = set()
         # lint-blocking-telemetry: loop nesting (a "step loop" is any
         # for/while the record call sits inside).
         self._loop_depth = 0
@@ -362,7 +383,35 @@ class _Lint(ast.NodeVisitor):
 
         self.generic_visit(node)
 
+    def _check_blocking_commit(self, node):
+        """lint-blocking-commit: in a loop that calls ``.commit()``, a
+        bare ``jax.device_get`` re-serializes the device→host fetch the
+        async commit writer exists to overlap. Visited outer loop first,
+        so the whole step loop (not each inner block) gets one pass and
+        nested loops skip already-attributed fetch sites."""
+        calls = [sub for sub in ast.walk(node) if isinstance(sub, ast.Call)]
+        if not any(_dotted(c.func).split(".")[-1] in COMMIT_CALL_NAMES
+                   for c in calls):
+            return
+        for c in calls:
+            if _dotted(c.func).split(".")[-1] not in COMMIT_FETCH_NAMES:
+                continue
+            if id(c) in self._commit_fetch_handled:
+                continue
+            self._commit_fetch_handled.add(id(c))
+            self._add(
+                "lint-blocking-commit", Severity.WARNING, c,
+                "bare jax.device_get inside a step/commit loop: the "
+                "commit path is pipelined (elastic/state.py "
+                "_CommitWriter fetches off-thread from a cheap on-device "
+                "copy) — a synchronous fetch here re-serializes the "
+                "device-to-host stall the async writer removes "
+                "(hvd_commit_stall_seconds). Pass commit() the live "
+                "arrays; fetch host copies only outside the step loop "
+                "(docs/checkpointing.md)")
+
     def visit_For(self, node):
+        self._check_blocking_commit(node)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1
@@ -399,6 +448,7 @@ class _Lint(ast.NodeVisitor):
                     "pod-scale protocol prevents; pace with an interval + "
                     "HOROVOD_ELASTIC_POLL_JITTER, or park server-side via "
                     "get_world(wait=...) (see benchmarks/control_plane.py)")
+        self._check_blocking_commit(node)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1

@@ -12,11 +12,17 @@ that owns it, asynchronously):
    broadcast_object used after torch.load on rank 0)
 3. Spark estimator Store checkpoints          → :class:`LocalStore` /
    :class:`Store` registry (reference: ``horovod/spark/common/store.py``)
+
+The elastic commits in (1) persist through :class:`BlobStore`, the
+content-addressed shard store (per-leaf blake2b-addressed blobs + one
+small manifest per commit; docs/checkpointing.md).
 """
 
 from .manager import (CheckpointManager, latest_step, like_of,
                       restore_and_broadcast)
-from .store import LocalStore, Store, get_store
+from .store import (BlobIntegrityError, BlobStore, LocalStore, Store,
+                    blob_digest, get_store, newest_manifest_seq)
 
-__all__ = ["CheckpointManager", "LocalStore", "Store", "get_store",
-           "latest_step", "like_of", "restore_and_broadcast"]
+__all__ = ["BlobIntegrityError", "BlobStore", "CheckpointManager",
+           "LocalStore", "Store", "blob_digest", "get_store", "latest_step",
+           "like_of", "newest_manifest_seq", "restore_and_broadcast"]

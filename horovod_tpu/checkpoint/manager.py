@@ -82,14 +82,19 @@ class CheckpointManager:
         change), not per-file corruption — the original error is
         re-raised instead of being buried under FileNotFoundError. Pass
         an explicit ``step=`` to disable the fallback entirely."""
+        import time
         import orbax.checkpoint as ocp
+        t0 = time.perf_counter()
         args = (ocp.args.StandardRestore(like) if like is not None
                 else ocp.args.StandardRestore())
         if step is not None:
             out = self._mgr.restore(step, args=args)
+            latency = time.perf_counter() - t0
             _telemetry.inc("hvd_restores_total")
+            _telemetry.set_gauge("hvd_resume_latency_seconds", latency)
             _telemetry.record_event("checkpoint_restore", step=int(step),
-                                    directory=self._dir)
+                                    directory=self._dir,
+                                    latency_s=round(latency, 6))
             return out
         steps = self.all_steps()
         if not steps:
@@ -113,10 +118,13 @@ class CheckpointManager:
                     "altered the state tree) this silently rewinds "
                     "training; pass step= to fail loudly instead.",
                     s, self._dir, [f[0] for f in failed])
+            latency = time.perf_counter() - t0
             _telemetry.inc("hvd_restores_total")
+            _telemetry.set_gauge("hvd_resume_latency_seconds", latency)
             _telemetry.record_event("checkpoint_restore", step=int(s),
                                     directory=self._dir,
-                                    stale=bool(failed))
+                                    stale=bool(failed),
+                                    latency_s=round(latency, 6))
             return out
         newest_exc = failed[0][1]
         if len({(type(e).__name__, str(e)) for _, e in failed}) == 1:

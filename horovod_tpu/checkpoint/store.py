@@ -6,13 +6,26 @@ the same URL-dispatched factory (:func:`get_store`) and the same role —
 resolve logical names (checkpoints, logs) to concrete paths and hand out
 filesystem operations — with LocalStore implemented and remote schemes
 gated on their optional clients, as the reference gates on pyarrow/boto3.
+
+This module also hosts :class:`BlobStore`, the content-addressed shard
+store behind elastic commits (elastic/state.py). Upstream's elastic state
+sync is broadcast-on-reset of the WHOLE state (``horovod/common/elastic``);
+here every commit decomposes into per-leaf blobs keyed by their blake2b
+digest plus one small manifest, so unchanged leaves (frozen embeddings,
+non-trained buffers, replicated params another rank already committed on a
+shared disk) cost zero bytes on every later commit — and a resume only
+moves the blobs a rank is actually missing (docs/checkpointing.md).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import json
 import os
 import shutil
-from typing import List
+import time
+from typing import Dict, List, Optional, Tuple
 
 
 class Store:
@@ -97,6 +110,245 @@ class LocalStore(Store):
 
     def is_remote(self) -> bool:
         return False
+
+
+#: Digest size (bytes) of the content address; same blake2b family the
+#: legacy single-frame commits used for their integrity trailer, so the
+#: move is "verify at write" → "address the store".
+BLOB_DIGEST_SIZE = 16
+
+#: Manifest schema marker; an unparsable or wrong-magic manifest is
+#: treated as torn and skipped on the newest→oldest restore walk.
+MANIFEST_MAGIC = "HVDMAN1"
+
+_MANIFEST_PREFIX = "manifest."
+_MANIFEST_SUFFIX = ".json"
+
+
+class BlobIntegrityError(RuntimeError):
+    """A blob's bytes no longer hash to its content address."""
+
+
+def blob_digest(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=BLOB_DIGEST_SIZE).hexdigest()
+
+
+class BlobStore:
+    """Content-addressed blob store + manifest log under one directory.
+
+    Layout (``root`` is ``<commit_dir>/cas`` for elastic commits)::
+
+        root/blobs/<digest[:2]>/<digest>     # immutable, write-if-absent
+        root/manifest.<seq:08d>.json         # atomic tmp+rename publish
+
+    Writes are idempotent and concurrency-safe on a shared filesystem:
+    two ranks storing the same content race to rename identical bytes to
+    the same address, and a manifest publish is a single ``os.replace``
+    so readers only ever see a complete manifest or none (the torn-commit
+    discipline — same as the coordinator's journal compaction).
+
+    Digests are verified at *read* (:meth:`get_blob`), not at write: the
+    address IS the checksum, so a bit-flipped blob fails loudly at
+    restore and the caller walks back to an older manifest.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._blob_root = os.path.join(root, "blobs")
+        #: per-instance traffic accounting (benchmarks/checkpoint.py);
+        #: the cross-process view lives in the telemetry counters the
+        #: committer records (docs/telemetry.md).
+        self.stats: Dict[str, int] = {
+            "bytes_written": 0, "bytes_deduped": 0,
+            "blobs_written": 0, "blobs_deduped": 0,
+        }
+
+    # -- blobs ---------------------------------------------------------------
+
+    def blob_path(self, digest: str) -> str:
+        return os.path.join(self._blob_root, digest[:2], digest)
+
+    def has_blob(self, digest: str) -> bool:
+        return os.path.exists(self.blob_path(digest))
+
+    def put_blob(self, data: bytes) -> Tuple[str, bool]:
+        """Store ``data`` at its content address; returns ``(digest,
+        wrote)`` where ``wrote`` is False when an identical blob was
+        already present (dedup — across commits AND across ranks sharing
+        the directory)."""
+        digest = blob_digest(data)
+        path = self.blob_path(digest)
+        if os.path.exists(path):
+            self.stats["bytes_deduped"] += len(data)
+            self.stats["blobs_deduped"] += 1
+            return digest, False
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.stats["bytes_written"] += len(data)
+        self.stats["blobs_written"] += 1
+        return digest, True
+
+    def get_blob(self, digest: str, verify: bool = True) -> bytes:
+        """Read a blob by address, re-hashing it — a mismatch raises
+        :class:`BlobIntegrityError` (restore-time verification)."""
+        with open(self.blob_path(digest), "rb") as f:
+            data = f.read()
+        if verify and not hmac.compare_digest(blob_digest(data), digest):
+            raise BlobIntegrityError(
+                f"blob {digest} failed content-address verification "
+                f"({len(data)} bytes on disk)")
+        return data
+
+    # -- manifests -----------------------------------------------------------
+
+    def manifest_path(self, seq: int) -> str:
+        return os.path.join(
+            self.root, "%s%08d%s" % (_MANIFEST_PREFIX, seq, _MANIFEST_SUFFIX))
+
+    def publish_manifest(self, manifest: Dict) -> str:
+        """Atomically publish a manifest (tmp + rename): the commit
+        becomes visible all-or-nothing, AFTER every blob it references
+        is durable — a crash between blob writes and this rename leaves
+        the previous manifest as the restore point, never a mixed one."""
+        manifest = dict(manifest)
+        manifest.setdefault("magic", MANIFEST_MAGIC)
+        manifest.setdefault("time", time.time())
+        path = self.manifest_path(int(manifest["seq"]))
+        os.makedirs(self.root, exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    def manifest_seqs(self) -> List[int]:
+        """Published manifest sequence numbers, ascending."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        seqs = []
+        for name in names:
+            if not (name.startswith(_MANIFEST_PREFIX)
+                    and name.endswith(_MANIFEST_SUFFIX)):
+                continue
+            body = name[len(_MANIFEST_PREFIX):-len(_MANIFEST_SUFFIX)]
+            try:
+                seqs.append(int(body))
+            except ValueError:
+                continue
+        return sorted(seqs)
+
+    def read_manifest(self, seq: int) -> Optional[Dict]:
+        """One manifest, or None when it is torn/unparsable (logged by
+        the caller walking newest→oldest)."""
+        try:
+            with open(self.manifest_path(seq), "r", encoding="utf-8") as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if m.get("magic") != MANIFEST_MAGIC or "seq" not in m:
+            return None
+        return m
+
+    def newest_manifest(self) -> Optional[Dict]:
+        for seq in reversed(self.manifest_seqs()):
+            m = self.read_manifest(seq)
+            if m is not None:
+                return m
+        return None
+
+    def newest_seq(self) -> int:
+        """Newest READABLE manifest seq, or -1 (driver incident reports)."""
+        m = self.newest_manifest()
+        return -1 if m is None else int(m["seq"])
+
+    # -- retention -----------------------------------------------------------
+
+    def referenced_digests(self, manifests: List[Dict]) -> set:
+        refs = set()
+        for m in manifests:
+            if m.get("skeleton"):
+                refs.add(m["skeleton"])
+            for entry in m.get("leaves", []):
+                refs.add(entry[0])
+        return refs
+
+    def gc(self, keep: int) -> Dict[str, int]:
+        """Retention sweep: keep the newest ``keep`` manifests, drop the
+        rest, then delete blobs no kept manifest references.
+
+        Concurrent-writer safety (ranks share the directory on a shared
+        disk): only blobs strictly OLDER than the oldest kept manifest
+        are candidates — blobs of an in-flight commit whose manifest is
+        not yet published are always newer than every published
+        manifest, so they survive the sweep.
+        """
+        keep = max(1, int(keep))
+        seqs = self.manifest_seqs()
+        stats = {"manifests_removed": 0, "blobs_removed": 0,
+                 "bytes_freed": 0}
+        if len(seqs) <= keep:
+            return stats
+        kept_seqs, dropped = seqs[-keep:], seqs[:-keep]
+        kept = [m for s in kept_seqs
+                if (m := self.read_manifest(s)) is not None]
+        if not kept:
+            return stats   # nothing readable to pin from: don't sweep
+        refs = self.referenced_digests(kept)
+        try:
+            oldest_kept_mtime = min(
+                os.path.getmtime(self.manifest_path(s)) for s in kept_seqs)
+        except OSError:
+            return stats
+        for seq in dropped:
+            try:
+                os.unlink(self.manifest_path(seq))
+                stats["manifests_removed"] += 1
+            except OSError:
+                pass
+        for dirpath, _dirs, files in os.walk(self._blob_root):
+            for name in files:
+                if name in refs or ".tmp." in name:
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(path)
+                    if st.st_mtime >= oldest_kept_mtime:
+                        continue   # possibly an in-flight commit's blob
+                    os.unlink(path)
+                    stats["blobs_removed"] += 1
+                    stats["bytes_freed"] += st.st_size
+                except OSError:
+                    continue
+        return stats
+
+
+def newest_manifest_seq(commit_dir: str, cas_subdir: str = "cas") -> int:
+    """Newest published manifest seq under an elastic commit dir, or -1 —
+    the driver stamps this into incident reports as the rollback target
+    post-mortems should name."""
+    try:
+        return BlobStore(os.path.join(commit_dir, cas_subdir)).newest_seq()
+    except Exception:   # noqa: BLE001 — observability must not raise
+        return -1
 
 
 #: scheme -> Store subclass; remote backends register here when their

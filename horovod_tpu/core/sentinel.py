@@ -329,7 +329,14 @@ class Sentinel:
     def do_rollback(self, state):
         """Apply a rollback action: in-process restore via the hook, or
         escalate to the elastic recovery path (whose ``load_latest`` only
-        ever restores a blake2b-verified commit)."""
+        ever restores a content-address-verified commit)."""
+        from . import telemetry as _telemetry
+        # Name the rollback TARGET so post-mortems can pair this event
+        # with the incident report's last_manifest (elastic states track
+        # their committed seq; None for opaque user state).
+        _telemetry.record_event(
+            "sentinel_rollback",
+            manifest_seq=getattr(state, "_commit_seq", None))
         if self.rollback_fn is not None:
             return self.rollback_fn(state)
         raise HorovodInternalError(

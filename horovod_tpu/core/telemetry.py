@@ -448,10 +448,21 @@ def assemble_incident(directory: str, failure_seq: int,
     *victim's* last-known step even though the victim never dumped).
     """
     dumps = load_flight_dumps(directory)
+    # Name the rollback target: the newest manifest any rank published
+    # before the failure. The caller's failure dict (the driver scans the
+    # commit dir) wins; otherwise fall back to the manifest_publish events
+    # in the rank dumps.
+    last_manifest = (failure or {}).get("last_manifest")
+    if last_manifest is None:
+        seqs = [ev.get("seq") for evs in dumps.values() for ev in evs
+                if ev.get("kind") == "manifest_publish"
+                and ev.get("seq") is not None]
+        last_manifest = max(seqs) if seqs else None
     report = {
         "failure_seq": int(failure_seq),
         "created": time.time(),
         "failure": failure or {},
+        "last_manifest": last_manifest,
         "ranks": {str(r): evs[-tail:] for r, evs in sorted(dumps.items())},
         "journal_tail": list(journal_tail or []),
         "coordinator_metrics": {

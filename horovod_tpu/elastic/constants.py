@@ -31,6 +31,21 @@ WORLD_VERSION_ENV = "HOROVOD_ELASTIC_WORLD_VERSION"
 #: env: directory state commits persist to across worker generations.
 COMMIT_DIR_ENV = "HOROVOD_ELASTIC_COMMIT_DIR"
 
+#: env: "0" disables the asynchronous double-buffered commit writer and
+#: persists commits inline (the pre-CAS synchronous behavior). Default on:
+#: ``commit()`` takes a cheap on-device copy and returns; the background
+#: writer overlaps device→host transfer + serialization with subsequent
+#: steps, and the step loop only blocks when the PREVIOUS commit is still
+#: in flight (back-pressure; hvd_commit_stall_seconds).
+COMMIT_ASYNC_ENV = "HOROVOD_COMMIT_ASYNC"
+
+#: env: how many published manifests the content-addressed commit store
+#: retains; older manifests are dropped and blobs no kept manifest pins
+#: are swept after every publish (checkpoint/store.py BlobStore.gc).
+#: The default mirrors the legacy latest+prev rotation depth.
+CHECKPOINT_KEEP_ENV = "HOROVOD_CHECKPOINT_KEEP"
+DEFAULT_CHECKPOINT_KEEP = 2
+
 #: env: "restart" (default, TPU-true process-restart elasticity) or
 #: "inprocess" (re-init inside the worker process; valid only when the
 #: device topology is unchanged — used by the parity tests).

@@ -343,6 +343,7 @@ class ElasticDriver:
         """The elastic job loop; returns the job's final exit code."""
         s = self._settings
         commit_dir = tempfile.mkdtemp(prefix="hvd_elastic_")
+        self._commit_dir = commit_dir
         try:
             while True:
                 try:
@@ -417,12 +418,20 @@ class ElasticDriver:
         if seq <= self._incident_seq_seen:
             return
         self._incident_seq_seen = seq
+        from ..checkpoint.store import newest_manifest_seq
+        last_manifest = newest_manifest_seq(
+            getattr(self, "_commit_dir", None) or "")
         _telemetry.assemble_incident(
             self._flight_dir, seq,
             journal_tail=self._journal_tail(),
             coordinator_metrics=self._service.metrics_snapshot(),
             failure={"generation": version,
-                     "codes": {h: int(c) for h, c in codes.items()}})
+                     "codes": {h: int(c) for h, c in codes.items()},
+                     # The rollback target post-mortems name: the newest
+                     # manifest published before this failure (None lets
+                     # assemble_incident fall back to the rank events).
+                     "last_manifest": (last_manifest if last_manifest >= 0
+                                       else None)})
 
     def _watch_membership(self, hosts: Dict[str, int], version: int,
                           stop: threading.Event) -> None:

@@ -56,6 +56,22 @@ def _reset_limit() -> int:
         return 0
 
 
+def _drain_commits(state: State, timeout: float = 30.0) -> None:
+    """Make the newest async commit durable before a restart exit: the
+    relaunched generation resumes from disk, so an in-flight background
+    write abandoned here would silently roll the world back one commit."""
+    flush = getattr(state, "flush_commits", None)
+    if flush is None:
+        return
+    try:
+        if not flush(timeout=timeout):
+            get_logger().warning(
+                "in-flight commit did not drain cleanly before restart — "
+                "resuming from the previous published manifest")
+    except Exception as err:    # noqa: BLE001 — exit path must not wedge
+        get_logger().warning("commit drain failed before restart: %s", err)
+
+
 def _reinitialize() -> None:
     """In-process re-init (topology-unchanged path)."""
     import horovod_tpu as hvd
@@ -82,7 +98,10 @@ def run(func: Callable) -> Callable:
         # Process-restart resume: adopt the newest persisted commit (no-op
         # when there is none or no commit dir is configured).
         if hasattr(state, "load_latest") and state.load_latest():
-            get_logger().info("restored persisted elastic commit")
+            latency = getattr(state, "_last_resume_latency_s", None)
+            get_logger().info(
+                "restored persisted elastic commit%s",
+                "" if latency is None else " (resume latency %.3fs)" % latency)
         # A fresh generation starts from synced state (reference: run_fn
         # syncs before the first call so late joiners match rank 0).
         state.sync()
@@ -122,6 +141,7 @@ def run(func: Callable) -> Callable:
                     # there). The driver only needs the exit code. The
                     # HostsUpdatedInterrupt path below keeps sys.exit: there
                     # every peer is alive and exiting together.
+                    _drain_commits(state)
                     sys.stdout.flush()
                     sys.stderr.flush()
                     os._exit(C.RESTART_EXIT_CODE)
@@ -138,6 +158,7 @@ def run(func: Callable) -> Callable:
                 _telemetry.record_event("generation_change",
                                         mode=_mode())
                 if _mode() == "restart":
+                    _drain_commits(state)
                     sys.exit(C.RESTART_EXIT_CODE)
                 _reinitialize()
                 if not e.skip_sync:

@@ -28,6 +28,19 @@ TPU deltas:
   in-memory because its workers survive resets; ours may not.
 - ``JaxState`` is the ``TorchState`` analog holding ``params``/``opt_state``
   pytrees plus arbitrary scalar attrs (epoch, batch, ...).
+- Commits are **pipelined and content-addressed** (PR 9): ``commit()``
+  takes a cheap on-device copy and returns; a double-buffered background
+  writer (:class:`_CommitWriter`) overlaps the device→host transfer and
+  serialization with subsequent steps, stores each pytree leaf as a
+  blake2b-addressed blob (``checkpoint/store.py`` :class:`BlobStore` —
+  unchanged leaves dedup across commits and across ranks sharing the
+  directory), and publishes one small manifest atomically LAST. The step
+  loop only ever blocks on BACK-PRESSURE — the previous commit still in
+  flight (``hvd_commit_stall_seconds``). ``HOROVOD_COMMIT_ASYNC=0``
+  restores the inline write. Legacy single-frame commits
+  (``state.latest.pkl``/``state.prev.pkl``) still restore; the
+  newest→oldest fallback walk now spans manifests first, then frames
+  (docs/checkpointing.md).
 """
 
 from __future__ import annotations
@@ -41,6 +54,7 @@ import random
 import tempfile
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -319,41 +333,454 @@ def _load_verified(path: str) -> Optional[Dict[str, Any]]:
         return None
 
 
+# ---------------------------------------------------------------------------
+# Content-addressed commits: per-leaf blobs + manifest (checkpoint/store.py)
+# ---------------------------------------------------------------------------
+
+_CAS_SUBDIR = "cas"
+
+
+class _LeafRef:
+    """Placeholder leaf inside a pickled pytree *skeleton*: an index into
+    the manifest's leaf-blob list. Pickling the skeleton (the original
+    containers with ``_LeafRef`` leaves) instead of a ``PyTreeDef`` keeps
+    the on-disk format independent of jax's treedef pickling across the
+    versions compat.py bridges."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __getstate__(self):
+        return self.index
+
+    def __setstate__(self, state):
+        self.index = state
+
+
+def _cas_store(commit_dir: str):
+    from ..checkpoint.store import BlobStore
+    return BlobStore(os.path.join(commit_dir, _CAS_SUBDIR))
+
+
+def _checkpoint_keep() -> int:
+    try:
+        return int(os.environ.get(C.CHECKPOINT_KEEP_ENV,
+                                  str(C.DEFAULT_CHECKPOINT_KEEP)))
+    except ValueError:
+        return C.DEFAULT_CHECKPOINT_KEEP
+
+
+def _commit_async_default() -> bool:
+    return os.environ.get(C.COMMIT_ASYNC_ENV, "1").lower() \
+        not in ("0", "false", "off")
+
+
+#: Live commit writers, so a same-process reader (tests; the in-process
+#: elastic mode) can drain in-flight writes before walking the store.
+_WRITERS: "weakref.WeakSet[_CommitWriter]" = weakref.WeakSet()
+
+
+def _flush_writers_for(commit_dir: str,
+                       timeout: Optional[float] = 60.0) -> None:
+    for w in list(_WRITERS):
+        if w.commit_dir == commit_dir:
+            w.flush(timeout=timeout)
+
+
+class _CommitWriter:
+    """Double-buffered background persister for one state object.
+
+    ``submit()`` is the step-path half: consult the identity cache
+    (an array leaf that is literally the SAME immutable ``jax.Array``
+    object as last commit reuses its digest — zero transfer, zero
+    serialization), take cheap on-device copies of changed array leaves
+    and start their device→host DMA, then enqueue. The only blocking the
+    step loop ever sees is back-pressure: the previous commit still in
+    flight (depth-1 double buffer). The on-device copy — not the live
+    array — is what the writer later reads, so donating the live buffer
+    to the next jitted step cannot invalidate the snapshot.
+
+    The writer half (a lazily-started daemon thread that exits when
+    idle) finishes the host transfer, pickles each leaf, stores blobs by
+    content address and publishes the manifest atomically LAST, then
+    retention-sweeps (``HOROVOD_CHECKPOINT_KEEP``). A crash anywhere
+    before the publish leaves the previous manifest as the restore point
+    — never a torn one.
+    """
+
+    _IDLE_EXIT_S = 5.0
+
+    def __init__(self, commit_dir: str, async_enabled: bool):
+        self.commit_dir = commit_dir
+        self.async_enabled = async_enabled
+        self.store = _cas_store(commit_dir)
+        self._cond = threading.Condition()
+        self._job: Optional[Dict[str, Any]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._last_error: Optional[BaseException] = None
+        self._cache_treedef = None          # identity cache validity key
+        self._cache: List[tuple] = []       # (leaf_ref|None, digest, nbytes)
+        self._last_host_leaves: List[Any] = []
+        _WRITERS.add(self)
+
+    # -- step-path half ------------------------------------------------------
+
+    @staticmethod
+    def _device_copy(leaf):
+        """Cheap asynchronous on-device copy with its host DMA started."""
+        import jax.numpy as jnp
+        try:
+            snap = jnp.copy(leaf)
+        except Exception:          # noqa: BLE001 — odd array types: live ref
+            snap = leaf
+        try:
+            snap.copy_to_host_async()
+        except Exception:          # noqa: BLE001 — optional fast path only
+            pass
+        return snap
+
+    def submit(self, seq: int, payload: Dict[str, Any],
+               on_snapshot: Optional[Callable[[Dict[str, Any]], None]] = None
+               ) -> None:
+        t0 = time.perf_counter()
+        with self._cond:
+            while self._job is not None:    # back-pressure: depth-1 buffer
+                self._cond.wait()
+        leaves, treedef = jax.tree_util.tree_flatten(payload)
+        cache_ok = (self._cache_treedef is not None
+                    and treedef == self._cache_treedef
+                    and len(self._cache) == len(leaves)
+                    and len(self._last_host_leaves) == len(leaves))
+        plans = []
+        for i, leaf in enumerate(leaves):
+            if cache_ok and isinstance(leaf, jax.Array):
+                prev_leaf, digest, nbytes = self._cache[i]
+                if prev_leaf is leaf:
+                    plans.append(("cached", digest, nbytes, leaf))
+                    continue
+            if isinstance(leaf, jax.Array):
+                plans.append(("fetch", self._device_copy(leaf), leaf))
+            else:
+                plans.append(("host", copy.deepcopy(leaf), leaf))
+        job = {"seq": int(seq), "treedef": treedef, "plans": plans,
+               "on_snapshot": on_snapshot}
+        if not self.async_enabled:
+            try:
+                self._run_job(job)
+            finally:
+                _telemetry.observe("hvd_commit_stall_seconds",
+                                   time.perf_counter() - t0)
+            return
+        with self._cond:
+            self._job = job
+            _telemetry.set_gauge("hvd_commit_inflight", 1.0)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name="hvd-commit-writer",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        _telemetry.observe("hvd_commit_stall_seconds",
+                           time.perf_counter() - t0)
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait until no commit is in flight; False on timeout or when
+        the last background write failed (already logged)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._job is not None:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            ok = self._last_error is None
+            self._last_error = None
+            return ok
+
+    # -- writer half ---------------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cond:
+                deadline = time.monotonic() + self._IDLE_EXIT_S
+                while self._job is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return      # idle: exit; the next submit restarts us
+                    self._cond.wait(timeout=remaining)
+                job = self._job
+            try:
+                self._run_job(job)
+            except BaseException as err:    # noqa: BLE001 — must not die
+                self._last_error = err
+                _telemetry.inc("hvd_commit_write_failures_total")
+                get_logger().error(
+                    "async commit write failed (seq=%s): %s — the previous "
+                    "manifest remains the restore point",
+                    job.get("seq"), err)
+            finally:
+                with self._cond:
+                    self._job = None
+                    _telemetry.set_gauge("hvd_commit_inflight", 0.0)
+                    self._cond.notify_all()
+
+    def _run_job(self, job: Dict[str, Any]) -> None:
+        import numpy as np
+        t0 = time.perf_counter()
+        host_leaves: List[Any] = []
+        entries: List[list] = []
+        new_cache: List[tuple] = []
+        bytes_written = bytes_deduped = 0
+        for i, plan in enumerate(job["plans"]):
+            kind = plan[0]
+            if kind == "cached":
+                _, digest, nbytes, orig = plan
+                host_leaves.append(self._last_host_leaves[i])
+                entries.append([digest, nbytes])
+                bytes_deduped += nbytes
+                new_cache.append((orig, digest, nbytes))
+                continue
+            if kind == "fetch":
+                _, dev, orig = plan
+                val = np.asarray(jax.device_get(dev))
+            else:
+                _, val, orig = plan
+            blob = pickle.dumps(val, protocol=4)
+            digest, wrote = self.store.put_blob(blob)
+            if wrote:
+                bytes_written += len(blob)
+            else:
+                bytes_deduped += len(blob)
+            host_leaves.append(val)
+            entries.append([digest, len(blob)])
+            # Only IMMUTABLE leaves join the identity cache — a mutated
+            # numpy buffer keeps its object id and must re-hash.
+            new_cache.append((orig if isinstance(orig, jax.Array) else None,
+                              digest, len(blob)))
+        skeleton = jax.tree_util.tree_unflatten(
+            job["treedef"], [_LeafRef(i) for i in range(len(entries))])
+        skel_blob = pickle.dumps(skeleton, protocol=4)
+        skel_digest, wrote = self.store.put_blob(skel_blob)
+        if wrote:
+            bytes_written += len(skel_blob)
+        else:
+            bytes_deduped += len(skel_blob)
+        try:
+            topo = {"process_index": jax.process_index(),
+                    "process_count": jax.process_count()}
+        except Exception:           # noqa: BLE001 — metadata only
+            topo = {}
+        # Chaos seam (testing/faults.py `torn` kind): die HERE — blobs
+        # durable, manifest not yet published — to prove restores land on
+        # the previous complete manifest, never a mixed one.
+        if os.environ.get("HOROVOD_FAULT_SPEC"):
+            from ..testing import faults as _faults
+            _faults.maybe_torn_commit()
+        self.store.publish_manifest({
+            "seq": job["seq"], "skeleton": skel_digest, "leaves": entries,
+            "topology": topo,
+        })
+        self.store.gc(_checkpoint_keep())
+        self._cache_treedef = job["treedef"]
+        self._cache = new_cache
+        self._last_host_leaves = host_leaves
+        _telemetry.inc("hvd_checkpoint_bytes_written_total", bytes_written)
+        _telemetry.inc("hvd_checkpoint_bytes_deduped_total", bytes_deduped)
+        _telemetry.set_gauge("hvd_last_manifest_seq", float(job["seq"]))
+        _telemetry.observe("hvd_commit_write_seconds",
+                           time.perf_counter() - t0)
+        _telemetry.record_event("manifest_publish", seq=job["seq"],
+                                bytes_written=bytes_written,
+                                bytes_deduped=bytes_deduped)
+        if job["on_snapshot"] is not None:
+            job["on_snapshot"](jax.tree_util.tree_unflatten(
+                job["treedef"], host_leaves))
+
+
+def _unpack_manifest(store, manifest: Dict[str, Any]) -> Dict[str, Any]:
+    """Materialize a payload from a manifest. Every blob read re-hashes
+    against its content address (verify-at-restore); a mismatch raises
+    ``BlobIntegrityError`` upward and the caller walks to an older
+    manifest."""
+    skeleton = pickle.loads(store.get_blob(manifest["skeleton"]))
+    refs, treedef = jax.tree_util.tree_flatten(skeleton)
+    entries = manifest["leaves"]
+    leaves = []
+    for ref in refs:
+        if not isinstance(ref, _LeafRef):
+            raise ValueError("manifest skeleton holds a non-ref leaf "
+                             f"({type(ref).__name__})")
+        leaves.append(pickle.loads(store.get_blob(entries[ref.index][0])))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _load_cas(commit_dir: str):
+    """Newest readable content-addressed commit: ``(payload, manifest)``
+    or ``(None, None)``. Digest mismatches and torn manifests are LOUD
+    (error log) and fall back to the previous complete manifest."""
+    from ..checkpoint.store import BlobIntegrityError
+    store = _cas_store(commit_dir)
+    for seq in reversed(store.manifest_seqs()):
+        manifest = store.read_manifest(seq)
+        if manifest is None:
+            get_logger().error(
+                "commit manifest %d in %s is torn/unreadable — falling "
+                "back to an older manifest", seq, commit_dir)
+            continue
+        try:
+            return _unpack_manifest(store, manifest), manifest
+        except BlobIntegrityError as err:
+            get_logger().error(
+                "commit manifest %d failed content-address verification "
+                "(%s) — falling back to an older manifest", seq, err)
+        except Exception as err:    # noqa: BLE001 — missing blob, bad pickle
+            get_logger().error(
+                "commit manifest %d unreadable (%s) — falling back to an "
+                "older manifest", seq, err)
+    return None, None
+
+
+#: commit dirs whose legacy-frame restore already logged the one-time
+#: migration note.
+_MIGRATION_NOTED: set = set()
+
+
+def _load_local_commit(commit_dir: str) -> Optional[Dict[str, Any]]:
+    """Newest verified LOCAL commit with its provenance:
+    ``{"payload", "seq", "manifest"}`` (``manifest`` None for legacy
+    single-frame commits), or None."""
+    _flush_writers_for(commit_dir)
+    cas_payload, manifest = _load_cas(commit_dir)
+    legacy = _load_verified(_commit_path(commit_dir))
+    if legacy is None:
+        legacy = _load_verified(_prev_commit_path(commit_dir))
+        if legacy is not None and cas_payload is None:
+            get_logger().warning(
+                "newest commit in %s unreadable — falling back to the "
+                "previous committed generation (seq=%s)", commit_dir,
+                legacy.get("seq"))
+    if cas_payload is None and legacy is None:
+        return None
+    use_legacy = cas_payload is None or (
+        legacy is not None
+        and int(legacy.get("seq", 0)) > int(cas_payload.get("seq", 0)))
+    if use_legacy:
+        if commit_dir not in _MIGRATION_NOTED:
+            _MIGRATION_NOTED.add(commit_dir)
+            get_logger().info(
+                "restored a legacy single-frame commit from %s (seq=%s); "
+                "future commits write the content-addressed store under "
+                "%s/%s — the frames stay readable but are ignored once a "
+                "newer manifest exists", commit_dir, legacy.get("seq"),
+                commit_dir, _CAS_SUBDIR)
+        return {"payload": legacy, "seq": int(legacy.get("seq", 0)),
+                "manifest": None}
+    return {"payload": cas_payload, "seq": int(cas_payload.get("seq", 0)),
+            "manifest": manifest}
+
+
 def load_persisted(commit_dir: str) -> Optional[Dict[str, Any]]:
-    """The newest VERIFIED local commit: ``state.latest.pkl`` when its
-    checksum holds, else the previous committed generation."""
-    payload = _load_verified(_commit_path(commit_dir))
-    if payload is not None:
-        return payload
-    payload = _load_verified(_prev_commit_path(commit_dir))
-    if payload is not None:
-        get_logger().warning(
-            "newest commit in %s unreadable — falling back to the previous "
-            "committed generation (seq=%s)", commit_dir, payload.get("seq"))
-    return payload
+    """The newest VERIFIED local commit: content-addressed manifests
+    preferred, legacy single-frame commits (``state.latest.pkl`` /
+    ``state.prev.pkl``) still restored via the same newest→oldest walk."""
+    local = _load_local_commit(commit_dir)
+    return None if local is None else local["payload"]
 
 
 def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:
     """The newest persisted commit across ALL processes of the (re)launched
     world. A relaunched generation may have a different process 0 whose
     disk never saw a commit (lost-host recovery); every process reports its
-    local commit sequence number and the highest one is broadcast."""
-    local = load_persisted(commit_dir) if commit_dir else None
+    local commit sequence number and the highest one wins.
+
+    Content-addressed fast resume: the winning rank ships only its small
+    MANIFEST; every rank then materializes leaves from its LOCAL blob
+    store (shared disks and peer-identical content make most blobs local
+    hits) and only the union of genuinely missing blobs moves — fetched
+    from the surviving owner's store in one broadcast. Legacy
+    single-frame owners fall back to the upstream-style whole-payload
+    broadcast-on-reset."""
+    local = _load_local_commit(commit_dir) if commit_dir else None
     if jax.process_count() == 1:
-        return local
+        return None if local is None else local["payload"]
     import numpy as np
     from jax.experimental import multihost_utils
-    from ..optimizer.functions import broadcast_object
-    seq = -1 if local is None else int(local.get("seq", 0))
+    from ..optimizer.functions import allgather_object, broadcast_object
+    seq = -1 if local is None else int(local["seq"])
     seqs = multihost_utils.process_allgather(np.asarray([seq], np.int64))
     seqs = np.asarray(seqs).reshape(-1)
     owner = int(np.argmax(seqs))
     if seqs[owner] < 0:
         return None
-    return broadcast_object(local, root_rank=owner)
+    me = jax.process_index()
+    head = broadcast_object(
+        None if local is None else {"seq": local["seq"],
+                                    "manifest": local["manifest"]},
+        root_rank=owner)
+    if head is None:
+        return None
+    manifest = head.get("manifest")
+    if manifest is None:
+        # Legacy single-frame owner: whole-payload broadcast (upstream's
+        # elastic broadcast-on-reset, PARITY.md).
+        return broadcast_object(
+            None if local is None else local["payload"], root_rank=owner)
+    store = _cas_store(commit_dir)
+    needed = [manifest["skeleton"]] + [e[0] for e in manifest["leaves"]]
+    needed = list(dict.fromkeys(needed))
+    missing = [d for d in needed if not store.has_blob(d)]
+    union = sorted(set().union(*[set(m) for m in allgather_object(missing)]))
+    if union:
+        blobs = broadcast_object(
+            {d: store.get_blob(d) for d in union} if me == owner else None,
+            root_rank=owner)
+        for digest, data in (blobs or {}).items():
+            if not store.has_blob(digest):
+                store.put_blob(data)
+    _telemetry.record_event(
+        "resume_fetch", manifest_seq=int(manifest["seq"]),
+        blobs_total=len(needed), blobs_missing=len(missing),
+        blobs_union=len(union))
+    return _unpack_manifest(store, manifest)
 
 
-class FrameworkState(State):
+class _CommitterMixin:
+    """Shared persistence plumbing for the concrete state classes:
+    lazily-built :class:`_CommitWriter` + drain/telemetry helpers."""
+
+    _commit_dir: Optional[str]
+    _commit_async: bool
+
+    def _committer(self) -> _CommitWriter:
+        if self.__dict__.get("_writer") is None:
+            self._writer = _CommitWriter(self._commit_dir,
+                                         self._commit_async)
+        return self._writer
+
+    def flush_commits(self, timeout: Optional[float] = None) -> bool:
+        """Drain the in-flight async commit (if any). run_fn calls this
+        before a restart exit so the newest commit is durable for the
+        relaunched generation."""
+        w = self.__dict__.get("_writer")
+        return True if w is None else w.flush(timeout=timeout)
+
+    def _record_commit(self, seq: int) -> None:
+        _telemetry.inc("hvd_commits_total")
+        _telemetry.record_event("checkpoint_commit", seq=seq)
+
+    def _record_restore(self, seq: int, t0: float) -> None:
+        latency = time.perf_counter() - t0
+        self._last_resume_latency_s = latency
+        _telemetry.inc("hvd_restores_total")
+        _telemetry.set_gauge("hvd_resume_latency_seconds", latency)
+        _telemetry.record_event("checkpoint_restore", seq=seq,
+                                latency_s=round(latency, 6))
+
+
+class FrameworkState(_CommitterMixin, State):
     """Shared machinery for the framework-binding states (torch / tf):
     arbitrary scalar attributes, in-memory snapshots, disk-persisted
     commits (``HOROVOD_ELASTIC_COMMIT_DIR``) with ``load_latest`` for
@@ -368,10 +795,15 @@ class FrameworkState(State):
 
     _GUARDED: tuple = ()
 
-    def __init__(self, commit_dir: Optional[str] = None, **kwargs: Any):
+    def __init__(self, commit_dir: Optional[str] = None,
+                 commit_async: Optional[bool] = None, **kwargs: Any):
         self._scalars: Dict[str, Any] = dict(kwargs)
         self._saved_scalars: Dict[str, Any] = dict(kwargs)
         self._commit_dir = commit_dir or os.environ.get(C.COMMIT_DIR_ENV)
+        self._commit_async = (_commit_async_default() if commit_async is None
+                              else bool(commit_async))
+        self._writer: Optional[_CommitWriter] = None
+        self._last_resume_latency_s: Optional[float] = None
         self._commit_seq = 0
         self._saved_fw: Any = None
         super().__init__()
@@ -416,12 +848,14 @@ class FrameworkState(State):
         self._saved_scalars = dict(self._scalars)
         if self._commit_dir:
             self._commit_seq += 1
-            _persist(self._commit_dir,
-                     {"seq": self._commit_seq, "fw": self._saved_fw,
-                      "scalars": self._saved_scalars})
-            _telemetry.inc("hvd_commits_total")
-            _telemetry.record_event("checkpoint_commit",
-                                    seq=self._commit_seq)
+            # The snapshot is already host picklables; the writer hashes
+            # and stores each leaf as a content-addressed blob off-thread
+            # (unchanged leaves dedup by digest even without identity hits).
+            self._committer().submit(
+                self._commit_seq,
+                {"seq": self._commit_seq, "fw": self._saved_fw,
+                 "scalars": self._saved_scalars})
+            self._record_commit(self._commit_seq)
 
     def restore(self) -> None:
         if self._saved_fw is not None:
@@ -433,6 +867,7 @@ class FrameworkState(State):
         world; returns True if one was found."""
         if not self._commit_dir:
             return False
+        t0 = time.perf_counter()
         payload = load_persisted_world(self._commit_dir)
         if payload is None:
             return False
@@ -440,8 +875,7 @@ class FrameworkState(State):
         self._saved_fw = payload.get("fw")
         self._saved_scalars = dict(payload.get("scalars", {}))
         self.restore()
-        _telemetry.inc("hvd_restores_total")
-        _telemetry.record_event("checkpoint_restore", seq=self._commit_seq)
+        self._record_restore(self._commit_seq, t0)
         return True
 
     def sync(self) -> None:
@@ -450,16 +884,22 @@ class FrameworkState(State):
         self.save()
 
 
-class ObjectState(State):
+class ObjectState(_CommitterMixin, State):
     """State whose attrs are arbitrary picklable objects
     (reference: common/elastic.py ObjectState)."""
 
     #: attr names excluded from snapshots.
-    _INTERNAL = ("_reset_callbacks", "_saved", "_commit_dir", "_commit_seq")
+    _INTERNAL = ("_reset_callbacks", "_saved", "_commit_dir", "_commit_seq",
+                 "_commit_async", "_writer", "_last_resume_latency_s")
 
-    def __init__(self, commit_dir: Optional[str] = None, **kwargs):
+    def __init__(self, commit_dir: Optional[str] = None,
+                 commit_async: Optional[bool] = None, **kwargs):
         super().__init__()
         self._commit_dir = commit_dir or os.environ.get(C.COMMIT_DIR_ENV)
+        self._commit_async = (_commit_async_default() if commit_async is None
+                              else bool(commit_async))
+        self._writer: Optional[_CommitWriter] = None
+        self._last_resume_latency_s: Optional[float] = None
         self._commit_seq = 0
         self._saved: Dict[str, Any] = {}
         for k, v in kwargs.items():
@@ -490,16 +930,30 @@ class ObjectState(State):
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def save(self) -> None:
-        self._saved = self._snapshot()
-        if self._commit_dir:
-            self._commit_seq += 1
-            _persist(self._commit_dir,
-                     {"seq": self._commit_seq, "attrs": self._saved})
-            _telemetry.inc("hvd_commits_total")
-            _telemetry.record_event("checkpoint_commit",
-                                    seq=self._commit_seq)
+        if not self._commit_dir:
+            self._saved = self._snapshot()
+            return
+        self._commit_seq += 1
+
+        def _adopt(host_payload: Dict[str, Any],
+                   _self: "ObjectState" = self) -> None:
+            _self._saved = host_payload["attrs"]
+
+        # LIVE attr refs, not a host snapshot: the writer takes cheap
+        # on-device copies of array leaves (identity-cache hits skip even
+        # that) and finishes the host transfer + pickle off-thread; the
+        # in-memory rollback snapshot (_saved) is adopted from the SAME
+        # host leaves once written, so async == sync bit-for-bit.
+        self._committer().submit(
+            self._commit_seq,
+            {"seq": self._commit_seq, "attrs": dict(self._public_attrs())},
+            on_snapshot=_adopt)
+        self._record_commit(self._commit_seq)
 
     def restore(self) -> None:
+        # An in-flight async commit is adopting _saved from the writer
+        # thread — drain it so we roll back to the NEWEST commit.
+        self.flush_commits()
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v) if not isinstance(v, jax.Array)
                     else v)
@@ -510,14 +964,14 @@ class ObjectState(State):
         Returns True if one was found."""
         if not self._commit_dir:
             return False
+        t0 = time.perf_counter()
         payload = load_persisted_world(self._commit_dir)
         if payload is None:
             return False
         self._commit_seq = int(payload.get("seq", 0))
         self._saved = payload.get("attrs", payload)
         self.restore()
-        _telemetry.inc("hvd_restores_total")
-        _telemetry.record_event("checkpoint_restore", seq=self._commit_seq)
+        self._record_restore(self._commit_seq, t0)
         return True
 
     def sync(self) -> None:

@@ -95,7 +95,7 @@ _RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_refuse", "rpc_garble",
               "rpc_badsig")
 
 _KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan",
-          "desync") + _RPC_KINDS
+          "desync", "torn") + _RPC_KINDS
 
 
 @dataclass
@@ -206,6 +206,7 @@ class FaultHarness:
         self._round_count = 0
         self._poison_armed: Optional[Fault] = None
         self._desync_armed: Optional[Fault] = None
+        self._torn_armed: Optional[Fault] = None
         if marker_dir is None:
             marker_dir = os.environ.get(FAULT_MARKER_DIR_ENV)
         if marker_dir is None:
@@ -267,6 +268,14 @@ class FaultHarness:
                 get_logger().warning("fault: arming eps=%s param desync "
                                      "(rank=%s step=%d)",
                                      f.params.get("eps", "1e-3"), rank, step)
+            elif f.kind == "torn":
+                with self._lock:
+                    self._torn_armed = f
+                self._mark_fired(f)
+                get_logger().warning("fault: arming torn commit — next "
+                                     "commit dies between blob write and "
+                                     "manifest publish (rank=%s step=%d)",
+                                     rank, step)
             elif f.kind == "corrupt":
                 self._mark_fired(f)
                 self._corrupt(f)
@@ -347,6 +356,21 @@ class FaultHarness:
         return jax.tree_util.tree_map(
             lambda x: x + eps if jnp.issubdtype(
                 jnp.asarray(x).dtype, jnp.inexact) else x, tree)
+
+    def maybe_torn_commit(self) -> None:
+        """If a ``torn`` fault armed this step, die RIGHT HERE — the
+        commit writer calls this after its blobs are durable but before
+        the manifest publish, so the store is left with orphan blobs and
+        no new manifest (the torn-commit crash window the tmp+rename
+        publish discipline must survive). Disarms (marker already
+        written) so the relaunched process commits normally."""
+        with self._lock:
+            f, self._torn_armed = self._torn_armed, None
+        if f is None:
+            return
+        get_logger().warning("fault: torn commit — dying before manifest "
+                             "publish")
+        os._exit(1)
 
     # -- rpc-call-axis faults (control plane) ------------------------------
 
@@ -451,6 +475,14 @@ def maybe_desync(tree: Any) -> Any:
     """Module-level convenience for the param-desync fault seam."""
     h = fault_harness()
     return tree if h is None else h.maybe_desync(tree)
+
+
+def maybe_torn_commit() -> None:
+    """Module-level convenience for the commit-writer torn-commit seam
+    (elastic/state.py ``_CommitWriter._run_job``)."""
+    h = fault_harness()
+    if h is not None:
+        h.maybe_torn_commit()
 
 
 def on_rpc_call(call: int, rank: Optional[int] = None) -> Optional[Fault]:

@@ -1,0 +1,33 @@
+"""lint-blocking-commit fixture: a step loop that fetches training
+state to the host with a bare ``jax.device_get`` before every
+``commit()`` — re-serializing the device→host stall the async commit
+writer (elastic/state.py ``_CommitWriter``) exists to overlap. Exactly
+ONE finding: the live-handoff loop and the outside-the-loop fetch below
+must stay clean.
+"""
+import jax
+
+
+def train(step_fn, state, elastic_state, batches):
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        # Synchronous fetch on the step path: blocks until the step's
+        # device work drains, every iteration.
+        elastic_state.params = jax.device_get(state.params)  # <- lint-blocking-commit
+        elastic_state.commit()
+    return state
+
+
+def train_live_handoff(step_fn, state, elastic_state, batches):
+    # Clean: commit() gets the LIVE arrays; the background writer takes
+    # an on-device copy and fetches off-thread.
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        elastic_state.params = state.params
+        elastic_state.commit()
+    return state
+
+
+def export_final(state):
+    # Clean: a one-off fetch outside any commit loop is fine.
+    return jax.device_get(state.params)

@@ -210,6 +210,7 @@ LINT_CASES = [
     ("bad_monolithic_psum.py", "lint-monolithic-psum", "warning"),
     ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
     ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
+    ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
 ]
 
 

@@ -1,0 +1,305 @@
+"""Content-addressed checkpoint subsystem tests (elastic/state.py
+``_CommitWriter`` + checkpoint/store.py ``BlobStore``).
+
+Property coverage the ISSUE names: async == sync snapshot equivalence at
+every commit cadence, dedup correctness (bit-identical restores when
+blobs are shared across commits and ranks), digest-mismatch loudness,
+GC/retention, torn-commit containment (a rank dying between blob write
+and manifest publish must leave the previous complete manifest as the
+restore point).
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import elastic
+from horovod_tpu.checkpoint.store import (BlobIntegrityError, BlobStore,
+                                          blob_digest, newest_manifest_seq)
+from horovod_tpu.elastic import state as state_mod
+
+
+# --- BlobStore unit behavior ------------------------------------------------
+
+def test_blob_put_get_roundtrip_and_dedup(tmp_path):
+    store = BlobStore(str(tmp_path / "cas"))
+    data = b"x" * 1000
+    digest, wrote = store.put_blob(data)
+    assert wrote and digest == blob_digest(data)
+    assert store.get_blob(digest) == data
+    # Idempotent: the second put of identical bytes writes nothing.
+    digest2, wrote2 = store.put_blob(data)
+    assert digest2 == digest and not wrote2
+    assert store.stats["bytes_written"] == 1000
+    assert store.stats["bytes_deduped"] == 1000
+    assert store.stats["blobs_written"] == 1
+    assert store.stats["blobs_deduped"] == 1
+
+
+def test_blob_verify_at_read_raises_loudly(tmp_path):
+    store = BlobStore(str(tmp_path / "cas"))
+    digest, _ = store.put_blob(b"hello world" * 10)
+    path = store.blob_path(digest)
+    with open(path, "r+b") as fh:
+        fh.seek(3)
+        fh.write(b"\xff")
+    with pytest.raises(BlobIntegrityError):
+        store.get_blob(digest)
+    # verify=False is the explicit escape hatch (peer-fetch re-hashing
+    # happens at the receiving rank's put_blob).
+    assert store.get_blob(digest, verify=False)
+
+
+def test_manifest_publish_atomic_and_torn_skipped(tmp_path):
+    store = BlobStore(str(tmp_path / "cas"))
+    store.publish_manifest({"seq": 1, "skeleton": "ab", "leaves": []})
+    store.publish_manifest({"seq": 2, "skeleton": "cd", "leaves": []})
+    assert store.manifest_seqs() == [1, 2]
+    # Tear manifest 2 (truncate mid-JSON): read returns None, newest
+    # readable falls back to 1.
+    with open(store.manifest_path(2), "r+b") as fh:
+        fh.truncate(9)
+    assert store.read_manifest(2) is None
+    assert store.newest_manifest()["seq"] == 1
+    assert store.newest_seq() == 1
+
+
+def test_newest_manifest_seq_never_raises(tmp_path):
+    assert newest_manifest_seq(str(tmp_path / "nope")) == -1
+    assert newest_manifest_seq("") == -1
+
+
+# --- async == sync equivalence at every cadence -----------------------------
+
+def _drive(state, cadence, steps=7):
+    """A deterministic fake training loop: mutate array + scalar attrs
+    every step, commit every ``cadence`` steps."""
+    for i in range(steps):
+        state.step = i + 1
+        state.params = {"w": state.params["w"] + 1.0,
+                        "frozen": state.params["frozen"]}
+        if (i + 1) % cadence == 0:
+            state.save()
+    assert state.flush_commits(timeout=30)
+
+
+@pytest.mark.parametrize("cadence", [1, 2, 3, 5])
+def test_async_equals_sync_snapshot_every_cadence(tmp_path, cadence):
+    payload0 = lambda: {"w": jnp.arange(8.0), "frozen": jnp.ones(16)}  # noqa: E731
+    d_async = str(tmp_path / f"async_{cadence}")
+    d_sync = str(tmp_path / f"sync_{cadence}")
+    sa = elastic.JaxState(commit_dir=d_async, commit_async=True,
+                          params=payload0(), step=0)
+    ss = elastic.JaxState(commit_dir=d_sync, commit_async=False,
+                          params=payload0(), step=0)
+    _drive(sa, cadence)
+    _drive(ss, cadence)
+    ra = elastic.JaxState(commit_dir=d_async, params=None, step=-1)
+    rs = elastic.JaxState(commit_dir=d_sync, params=None, step=-1)
+    assert ra.load_latest() and rs.load_latest()
+    assert ra.step == rs.step and ra._commit_seq == rs._commit_seq
+    for k in ("w", "frozen"):
+        a, b = np.asarray(ra.params[k]), np.asarray(rs.params[k])
+        assert a.tobytes() == b.tobytes()   # bit-identical
+    # In-memory rollback snapshots match the persisted commit too.
+    assert np.asarray(sa._saved["params"]["w"]).tobytes() \
+        == np.asarray(ra.params["w"]).tobytes()
+
+
+# --- dedup ------------------------------------------------------------------
+
+def test_frozen_leaves_dedup_across_commits(tmp_path):
+    d = str(tmp_path / "commits")
+    frozen = jnp.arange(4096.0)       # 16 KiB leaf, never touched
+    s = elastic.JaxState(commit_dir=d, params={"w": jnp.zeros(8),
+                                               "frozen": frozen}, step=0)
+    for i in range(4):
+        s.step = i
+        s.params = {"w": s.params["w"] + 1.0, "frozen": s.params["frozen"]}
+        s.save()
+    assert s.flush_commits(timeout=30)
+    stats = s._writer.store.stats
+    # The frozen leaf's bytes were written exactly once (identity cache
+    # short-circuits even the fetch after commit 1); later commits write
+    # only the small changed leaves + manifest-pinned skeleton.
+    frozen_bytes = len(pickle.dumps(np.asarray(frozen), protocol=4))
+    assert stats["bytes_written"] < 4 * frozen_bytes
+    assert stats["bytes_written"] > 0
+
+
+def test_identical_content_dedups_across_ranks(tmp_path):
+    """Two states sharing a commit dir (two ranks on a shared disk):
+    the second rank's identical leaves land on existing addresses and
+    cost zero written bytes."""
+    d = str(tmp_path / "commits")
+    mk = lambda: {"w": jnp.arange(1024.0)}  # noqa: E731
+    a = elastic.JaxState(commit_dir=d, commit_async=False, params=mk(),
+                         step=0)
+    a.save()
+    b = elastic.JaxState(commit_dir=d, commit_async=False, params=mk(),
+                         step=0)
+    b.save()
+    stats = b._writer.store.stats
+    assert stats["blobs_deduped"] >= 1          # the big leaf, at least
+    assert stats["bytes_deduped"] > stats["bytes_written"]
+    # And the shared-store restore is bit-identical.
+    r = elastic.JaxState(commit_dir=d, params=None, step=-1)
+    assert r.load_latest()
+    assert np.asarray(r.params["w"]).tobytes() \
+        == np.asarray(mk()["w"]).tobytes()
+
+
+# --- GC / retention ---------------------------------------------------------
+
+def test_gc_retention_keeps_newest_k_and_sweeps_blobs(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_KEEP", "2")
+    d = str(tmp_path / "commits")
+    s = elastic.JaxState(commit_dir=d, commit_async=False,
+                         params={"w": jnp.zeros(2048)}, step=0)
+    digests_per_commit = []
+    for i in range(5):
+        s.step = i
+        s.params = {"w": s.params["w"] + 1.0}
+        s.save()
+        store = s._writer.store
+        m = store.read_manifest(s._commit_seq)
+        digests_per_commit.append([e[0] for e in m["leaves"]])
+        time.sleep(0.02)   # distinct mtimes for the GC age guard
+    store = state_mod._cas_store(d)
+    assert store.manifest_seqs() == [4, 5]
+    # Blobs only the dropped manifests referenced are gone; kept ones stay.
+    kept_refs = store.referenced_digests(
+        [store.read_manifest(4), store.read_manifest(5)])
+    for digest in digests_per_commit[0]:
+        if digest not in kept_refs:
+            assert not store.has_blob(digest)
+    for digest in digests_per_commit[-1]:
+        assert store.has_blob(digest)
+    # Restores still work after the sweep.
+    r = elastic.JaxState(commit_dir=d, params=None, step=-1)
+    assert r.load_latest() and r._commit_seq == 5
+
+
+def test_gc_never_drops_last_manifest(tmp_path):
+    store = BlobStore(str(tmp_path / "cas"))
+    digest, _ = store.put_blob(b"payload")
+    store.publish_manifest({"seq": 1, "skeleton": digest, "leaves": []})
+    stats = store.gc(0)     # keep clamps to 1
+    assert stats["manifests_removed"] == 0
+    assert store.manifest_seqs() == [1]
+    assert store.has_blob(digest)
+
+
+# --- torn commit (crash between blob write and manifest publish) ------------
+
+_TORN_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import horovod_tpu
+    from horovod_tpu import elastic
+    from horovod_tpu.testing import faults
+
+    commit_dir = sys.argv[1]
+    s = elastic.JaxState(commit_dir=commit_dir,
+                         params={"w": jnp.arange(8.0)}, step=0)
+    faults.on_step(0, rank=0)
+    s.step = 1
+    s.params = {"w": s.params["w"] + 1.0}
+    s.save()
+    assert s.flush_commits(timeout=30)      # commit 1 fully published
+    faults.on_step(1, rank=0)               # arms the torn fault
+    s.step = 2
+    s.params = {"w": s.params["w"] + 1.0}
+    s.save()
+    # Commit 2's writer dies between blob write and manifest publish —
+    # this flush never returns.
+    s.flush_commits(timeout=30)
+    print("UNREACHABLE", flush=True)
+    sys.exit(3)
+""")
+
+
+@pytest.mark.slow
+def test_torn_commit_restores_previous_manifest(tmp_path):
+    """Kill the committing process between blob write and manifest
+    publish (``torn`` fault): the store holds commit 2's orphan blobs
+    but only commit 1's manifest, and restore lands on commit 1 — never
+    a mixed state."""
+    script = tmp_path / "torn_worker.py"
+    script.write_text(_TORN_WORKER)
+    d = str(tmp_path / "commits")
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+        "HOROVOD_FAULT_SPEC": "torn:rank=0,step=1",
+        "HOROVOD_FAULT_MARKER_DIR": str(tmp_path / "markers"),
+        "HOROVOD_RANK": "0",
+    })
+    r = subprocess.run([sys.executable, str(script), d], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    assert "torn commit" in (r.stdout + r.stderr)
+    store = state_mod._cas_store(d)
+    assert store.manifest_seqs() == [1]         # commit 2 never published
+    s2 = elastic.JaxState(commit_dir=d, params=None, step=-1)
+    assert s2.load_latest()
+    assert s2.step == 1 and s2._commit_seq == 1
+    np.testing.assert_array_equal(np.asarray(s2.params["w"]),
+                                  np.arange(8.0) + 1.0)
+
+
+# --- telemetry / incident wiring --------------------------------------------
+
+def test_commit_telemetry_counters_and_stall_metric(tmp_path):
+    from horovod_tpu.core import telemetry as _telemetry
+    sess = _telemetry.active()
+    if not sess.enabled:
+        pytest.skip("telemetry disabled in this session")
+    d = str(tmp_path / "commits")
+    s = elastic.JaxState(commit_dir=d, params={"w": jnp.zeros(512)}, step=0)
+    s.params = {"w": s.params["w"] + 1.0}
+    s.save()
+    assert s.flush_commits(timeout=30)
+    snap = sess.registry.export()
+    keys = set(snap["c"]) | set(snap["g"])
+    assert any(k.startswith("hvd_checkpoint_bytes_written_total")
+               for k in keys)
+    assert any(k.startswith("hvd_commit_stall_seconds") for k in keys)
+    assert any(k.startswith("hvd_last_manifest_seq") for k in keys)
+
+
+def test_incident_report_names_last_manifest(tmp_path):
+    from horovod_tpu.core import telemetry as _telemetry
+    path = _telemetry.assemble_incident(
+        str(tmp_path), 1, failure={"generation": 0, "last_manifest": 7})
+    assert path is not None
+    with open(path) as fh:
+        report = json.load(fh)
+    assert report["last_manifest"] == 7
+
+
+def test_incident_last_manifest_falls_back_to_rank_events(tmp_path):
+    from horovod_tpu.core import telemetry as _telemetry
+    with open(os.path.join(str(tmp_path), "flight_0.jsonl"), "w") as fh:
+        fh.write(json.dumps({"t": 0.0, "kind": "manifest_publish",
+                             "seq": 3}) + "\n")
+        fh.write(json.dumps({"t": 1.0, "kind": "manifest_publish",
+                             "seq": 5}) + "\n")
+    path = _telemetry.assemble_incident(str(tmp_path), 2,
+                                        failure={"generation": 1})
+    with open(path) as fh:
+        report = json.load(fh)
+    assert report["last_manifest"] == 5

@@ -95,10 +95,13 @@ def test_load_latest_falls_back_on_corrupt_commit(tmp_path):
     d = str(tmp_path / "commits")
     s = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.ones(3))
     s.steps = 4
-    s.commit()                      # seq 1 — rotates to state.prev.pkl
+    s.commit()                      # manifest seq 1
     s.steps = 8
     s.w = s.w * 2.0
-    s.commit()                      # seq 2 — state.latest.pkl
+    s.commit()                      # manifest seq 2
+    # Manifests publish LAST, so once drained the newest file under the
+    # commit dir (what the corrupt fault truncates) is manifest 2.
+    assert s.flush_commits(timeout=30)
     spec = FaultSpec.parse(f"corrupt:rank=0,step=2,path={d}")
     h = FaultHarness(spec, marker_dir=str(tmp_path / "markers"))
     h.on_step(2, rank=0)            # truncates the newest commit file
@@ -109,16 +112,51 @@ def test_load_latest_falls_back_on_corrupt_commit(tmp_path):
 
 
 def test_commit_checksum_detects_bitflip(tmp_path):
-    """A bit-flip that keeps the file length (so the trailer magic
-    survives) must fail the blake2b check and fall back — truncation is
-    covered by the corrupt-fault test above."""
+    """A bit-flip that keeps a blob's length (so JSON/pickle framing
+    survives) must fail content-address verification at restore and fall
+    back to the previous manifest — truncation is covered by the
+    corrupt-fault test above."""
     from horovod_tpu.elastic import state as state_mod
     d = str(tmp_path / "commits")
-    s = elastic.ObjectState(commit_dir=d, steps=0)
+    s = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.ones(3))
     s.steps = 4
     s.commit()
     s.steps = 8
+    s.w = s.w * 2.0
     s.commit()
+    assert s.flush_commits(timeout=30)
+    store = state_mod._cas_store(d)
+    m2 = store.read_manifest(2)
+    # Flip one byte mid-blob in a leaf only manifest 2 references (the
+    # changed `w`): manifest 1's blobs must stay intact for the fallback.
+    m1_digests = set(d0 for d0, _ in store.read_manifest(1)["leaves"])
+    victim = next(d0 for d0, _ in m2["leaves"] if d0 not in m1_digests)
+    path = store.blob_path(victim)
+    with open(path, "r+b") as fh:
+        blob = fh.read()
+        fh.seek(len(blob) // 2)
+        fh.write(bytes([blob[len(blob) // 2] ^ 0xFF]))
+    from horovod_tpu.checkpoint.store import BlobIntegrityError
+    with pytest.raises(BlobIntegrityError):
+        store.get_blob(victim)
+    s2 = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.zeros(3))
+    assert s2.load_latest() and s2.steps == 4 and s2._commit_seq == 1
+    np.testing.assert_allclose(np.asarray(s2.w), np.ones(3))
+
+
+def test_legacy_single_frame_commit_still_restores(tmp_path):
+    """Migration satellite: a commit dir written by the pre-CAS framed
+    pickler (``state.latest.pkl`` + blake2b trailer) restores through the
+    same ``load_latest`` walk, and its checksum still detects bit-flips
+    (falling back to ``state.prev.pkl``)."""
+    from horovod_tpu.elastic import state as state_mod
+    d = str(tmp_path / "commits")
+    state_mod._persist(d, {"seq": 1, "attrs": {"steps": 4,
+                                               "w": np.ones(3)}})
+    state_mod._persist(d, {"seq": 2, "attrs": {"steps": 8,
+                                               "w": 2 * np.ones(3)}})
+    s = elastic.ObjectState(commit_dir=d, steps=0, w=jnp.zeros(3))
+    assert s.load_latest() and s.steps == 8 and s._commit_seq == 2
     latest = os.path.join(d, "state.latest.pkl")
     with open(latest, "r+b") as fh:
         blob = fh.read()
@@ -126,7 +164,7 @@ def test_commit_checksum_detects_bitflip(tmp_path):
         fh.write(bytes([blob[len(blob) // 2] ^ 0xFF]))
     assert state_mod._load_verified(latest) is None
     s2 = elastic.ObjectState(commit_dir=d, steps=0)
-    assert s2.load_latest() and s2.steps == 4
+    assert s2.load_latest() and s2.steps == 4 and s2._commit_seq == 1
 
 
 def test_sync_single_process_identity():

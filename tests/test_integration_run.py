@@ -635,8 +635,16 @@ def train(state):
     return state.step
 
 train(state)
+from horovod_tpu.elastic import constants as C
+_cas = os.path.join(os.environ[C.COMMIT_DIR_ENV], "cas")
 print(json.dumps({"rank": hvd.rank(), "size": hvd.size(),
-                  "final_step": state.step}), flush=True)
+                  "final_step": state.step,
+                  "manifests": sorted(
+                      f for f in os.listdir(_cas)
+                      if f.startswith("manifest.")) if os.path.isdir(_cas)
+                  else [],
+                  "resume_latency_s": getattr(
+                      state, "_last_resume_latency_s", None)}), flush=True)
 """
 
 
@@ -669,6 +677,17 @@ def test_elastic_host_add_graceful_reset_two_workers(tmp_path):
     combined = r.stdout + r.stderr
     assert "hosts gained" in combined
     assert "(np=3)" in combined
+    # the regrown generation resumed from the content-addressed store —
+    # every worker (including the brand-new third rank, which fetched the
+    # blobs it lacked) saw published manifests and a SUB-SECOND restore
+    for l in lines:
+        assert l["manifests"], l
+        assert l["resume_latency_s"] is not None, l
+        assert l["resume_latency_s"] < 1.0, l
+    import re
+    lat = [float(m) for m in
+           re.findall(r"resume latency ([0-9.]+)s", combined)]
+    assert lat and max(lat) < 1.0, (lat, combined[-2000:])
 
 
 @pytest.mark.integration
@@ -1407,11 +1426,17 @@ def train(state):
 
 
 final_loss = train(state)
+from horovod_tpu.elastic import constants as C
+_cas = os.path.join(os.environ[C.COMMIT_DIR_ENV], "cas")
 print(json.dumps({
     "final_step": state.step, "size": hvd.size(),
     "final_loss": final_loss,
     "final_finite": bool(np.isfinite(final_loss)),
     "version": os.environ.get("HOROVOD_ELASTIC_WORLD_VERSION"),
+    "manifests": sorted(f for f in os.listdir(_cas)
+                        if f.startswith("manifest.")) if os.path.isdir(_cas)
+    else [],
+    "resume_latency_s": getattr(state, "_last_resume_latency_s", None),
 }), flush=True)
 """
 
@@ -1470,3 +1495,13 @@ def test_sentinel_desync_evicts_minority_and_world_resumes(tmp_path):
         return int(e.split("version=")[1])
     assert min(_ver(e) for e in resumed) > max(
         _ver(e) for e in entries if "size=3" in e), entries
+    # and the resume itself came from the content-addressed store: the
+    # survivors report published CAS manifests and a SUB-SECOND restore
+    for out in lines:
+        assert out["manifests"], out
+        assert out["resume_latency_s"] is not None, out
+        assert out["resume_latency_s"] < 1.0, out
+    import re
+    lat = [float(m) for m in
+           re.findall(r"resume latency ([0-9.]+)s", combined)]
+    assert lat and max(lat) < 1.0, (lat, combined[-2000:])

@@ -230,20 +230,30 @@ def test_default_evict_outside_driver_escalates(monkeypatch):
 
 def test_rollback_lands_on_verified_commit(tmp_path):
     """The rollback hook restores through elastic ObjectState commits —
-    blake2b-framed, so a torn newest commit falls back to the previous
-    verified one instead of loading garbage."""
+    content-addressed and blake2b-verified at read, so a torn newest
+    commit falls back to the previous verified one instead of loading
+    garbage."""
     from horovod_tpu import elastic
+    from horovod_tpu.elastic import state as state_mod
 
     st = elastic.ObjectState(commit_dir=str(tmp_path), w=jnp.ones(3),
                              steps=0)
     st.commit()                                   # verified commit #1
+    assert st.flush_commits(timeout=30)
     st.w = st.w * 5
     st.steps = 1
     st.commit()                                   # verified commit #2
-    # tear the newest commit file (truncation: the dominant real-world
-    # corruption — destroys the blake2b trailer)
-    newest = tmp_path / "state.latest.pkl"
-    newest.write_bytes(newest.read_bytes()[:10])
+    assert st.flush_commits(timeout=30)
+    # tear a blob unique to the newest commit (truncation: the dominant
+    # real-world corruption — the stored digest no longer matches)
+    store = state_mod._cas_store(str(tmp_path))
+    seqs = store.manifest_seqs()
+    m_old = store.read_manifest(min(seqs))
+    m_new = store.read_manifest(max(seqs))
+    kept = {d for d, _ in m_old["leaves"]} | {m_old["skeleton"]}
+    victim = next(d for d, _ in m_new["leaves"] if d not in kept)
+    blob = tmp_path / "cas" / "blobs" / victim[:2] / victim
+    blob.write_bytes(blob.read_bytes()[:10])
 
     def rollback_fn(_state):
         fresh = elastic.ObjectState(commit_dir=str(tmp_path),
