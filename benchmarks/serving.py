@@ -1,0 +1,424 @@
+"""Serving-plane harness: hot-swap latency vs changed-leaf fraction,
+request survival across swaps, and commit→served staleness.
+
+ISSUE 10 acceptance: the CAS delta-fetch must make a mostly-frozen
+update (one changed leaf, e.g. a fine-tuned head) STRICTLY cheaper to
+adopt than an all-leaves update of the same model — that is the whole
+point of content-addressed publishing. A/B in ONE run (CLAUDE.md:
+interleaved rounds, ratios not absolutes — never separate blocks): each
+round pair times
+
+- **all** swaps — every leaf changes between generations (worst case:
+  the registry must fetch + verify every blob), then
+- **frozen** swaps — one leaf changes, the rest are byte-identical
+  (best case: unchanged digests come from the registry's leaf cache,
+  zero-copy).
+
+Measured per round: median adopt() wall per swap, blobs fetched vs
+leaves reused, and the per-round all/frozen ratio with its noise band.
+
+Two correctness segments ride along:
+
+- **traffic** — an InferenceServer answers a steady request stream
+  while ≥2 hot-swaps land mid-traffic; EVERY request must return ok
+  (zero dropped, zero failures) and the served model_seq must advance;
+- **staleness** — a store-watch registry follows a timed commit+publish
+  cadence; commit→served latency (adopted_at − publish time) must stay
+  a small fraction of the cadence.
+
+Emits ONE JSON line (bench.py convention) and appends it — stamped with
+date + git SHA — to ``benchmarks/serving_history.jsonl`` unless
+``HOROVOD_SERVING_NO_HISTORY`` is set. ``--check`` validates the newest
+history record the way tests/test_control_plane_guardrail.py pins the
+control-plane series; ``--smoke N`` runs a shrunk round for the chaos
+tier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np                                             # noqa: E402
+
+from benchmarks import common  # noqa: E402,F401  (forces cpu backend)
+from horovod_tpu.elastic.state import ObjectState              # noqa: E402
+from horovod_tpu.serving import (InferenceServer, ModelRegistry,  # noqa: E402
+                                 Publisher)
+
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "serving_history.jsonl")
+NO_HISTORY_ENV = "HOROVOD_SERVING_NO_HISTORY"
+
+#: --check rails. The frozen arm must be STRICTLY cheaper (acceptance);
+#: the rail sits above 1.0 by less than any observed spread so only a
+#: real delta-fetch regression can cross it.
+MIN_SWAP_RATIO = 1.2
+MAX_STALENESS_S = 2.0
+
+
+def _counters_clean() -> Dict[str, int]:
+    # The bench trains nothing: the sentinel window is vacuously clean.
+    return {"steps_skipped": 0, "rollbacks": 0}
+
+
+# -- swap-latency arms --------------------------------------------------------
+
+
+def _leaves(n_leaves: int, leaf_elems: int, gen: int, mode: str) -> dict:
+    """Generation ``gen``'s attr dict. mode=all: every leaf differs per
+    gen; mode=frozen: only leaf 0 does (the rest are byte-identical, so
+    their blobs dedup to the same digests)."""
+    out = {}
+    for i in range(n_leaves):
+        # gen*1000 + i keeps every (gen, leaf) pair's content unique —
+        # a plain gen + i would alias leaf i at gen g with leaf i-1 at
+        # gen g+1 and the digest cache would defeat the "all" arm.
+        base = float(gen * 1000 if (mode == "all" or i == 0) else 0)
+        out[f"w{i}"] = np.full(leaf_elems, base + i, dtype=np.float32)
+    return out
+
+
+def run_swap_round(mode: str, *, swaps: int, n_leaves: int,
+                   leaf_elems: int) -> dict:
+    """Fresh commit dir + publisher + registry; ``swaps`` timed
+    generation adoptions under ``mode``; returns the round's metrics."""
+    with tempfile.TemporaryDirectory(prefix="hvd_serving_bench_") as d:
+        state = ObjectState(commit_dir=d, commit_async=False,
+                            **_leaves(n_leaves, leaf_elems, 0, mode))
+        pub = Publisher(d, every=1, counters=_counters_clean)
+        reg = ModelRegistry(store=pub.store)
+        state.commit()
+        rec = pub.maybe_publish(state._commit_seq)
+        assert rec is not None and reg.adopt(rec)   # warm adopt, untimed
+        adopt_s: List[float] = []
+        fetched0 = reg.stats["blobs_fetched"]
+        reused0 = reg.stats["leaves_reused"]
+        for gen in range(1, swaps + 1):
+            for k, v in _leaves(n_leaves, leaf_elems, gen, mode).items():
+                setattr(state, k, v)
+            state.commit()
+            rec = pub.maybe_publish(state._commit_seq)
+            assert rec is not None, f"publish gate blocked gen {gen}"
+            t0 = time.perf_counter()
+            ok = reg.adopt(rec)
+            adopt_s.append(time.perf_counter() - t0)
+            assert ok, f"adopt rejected gen {gen}"
+        return {
+            "mode": mode, "swaps": swaps, "n_leaves": n_leaves,
+            "leaf_kb": round(leaf_elems * 4 / 1024, 1),
+            "adopt_s_median": round(statistics.median(adopt_s), 6),
+            "blobs_fetched_per_swap": round(
+                (reg.stats["blobs_fetched"] - fetched0) / swaps, 2),
+            "leaves_reused_per_swap": round(
+                (reg.stats["leaves_reused"] - reused0) / swaps, 2),
+        }
+
+
+# -- traffic across hot-swaps -------------------------------------------------
+
+
+def run_traffic_segment(*, swaps: int, n_leaves: int, leaf_elems: int,
+                        clients: int = 4,
+                        requests_per_client: int = 25) -> dict:
+    """A steady request stream with ``swaps`` hot-swaps landing
+    mid-traffic; every request must come back ok."""
+    with tempfile.TemporaryDirectory(prefix="hvd_serving_bench_") as d:
+        state = ObjectState(commit_dir=d, commit_async=False,
+                            **_leaves(n_leaves, leaf_elems, 0, "frozen"))
+        pub = Publisher(d, every=1, counters=_counters_clean)
+        reg = ModelRegistry(store=pub.store)
+        state.commit()
+        reg.adopt(pub.maybe_publish(state._commit_seq))
+
+        def forward(payload, inputs, padded_n):
+            w0 = payload["attrs"]["w0"]
+            return [float(q["x"]) + float(w0[0]) for q in inputs]
+
+        srv = InferenceServer(reg, forward, window_s=0.002,
+                              request_timeout_s=30.0)
+        results = {"sent": 0, "ok": 0, "failed": 0}
+        lock = threading.Lock()
+        seqs_served = set()
+
+        def client_loop():
+            for i in range(requests_per_client):
+                body = json.dumps({"x": float(i)}).encode()
+                req = urllib.request.Request(
+                    f"http://{srv.addr()}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        out = json.loads(r.read())
+                    good = bool(out.get("ok"))
+                    seq = out.get("model_seq")
+                except (OSError, ValueError):
+                    good, seq = False, None
+                with lock:
+                    results["sent"] += 1
+                    results["ok" if good else "failed"] += 1
+                    if seq is not None:
+                        seqs_served.add(seq)
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=client_loop, daemon=True)
+                   for _ in range(clients)]
+        try:
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            # Land the swaps while the stream is in flight.
+            for gen in range(1, swaps + 1):
+                time.sleep(0.15)
+                for k, v in _leaves(n_leaves, leaf_elems, gen,
+                                    "frozen").items():
+                    setattr(state, k, v)
+                state.commit()
+                assert reg.adopt(pub.maybe_publish(state._commit_seq))
+            for t in threads:
+                t.join(timeout=120)
+            elapsed = time.perf_counter() - t0
+        finally:
+            srv.close()
+        expected = clients * requests_per_client
+        return {
+            "requests": results["sent"], "ok": results["ok"],
+            "failed": results["failed"],
+            "dropped": expected - results["sent"],
+            "swaps_during": swaps,
+            "model_seqs_served": sorted(seqs_served),
+            "reqs_per_s": round(results["sent"] / elapsed, 1),
+        }
+
+
+# -- commit→served staleness under a cadence ----------------------------------
+
+
+def run_staleness_segment(*, commits: int, cadence_s: float,
+                          n_leaves: int, leaf_elems: int) -> dict:
+    """Timed commit+publish cadence on one side, a store-watch registry
+    polling on the other; staleness = adopted_at − publish time."""
+    with tempfile.TemporaryDirectory(prefix="hvd_serving_bench_") as d:
+        state = ObjectState(commit_dir=d, commit_async=False,
+                            **_leaves(n_leaves, leaf_elems, 0, "frozen"))
+        pub = Publisher(d, every=1, counters=_counters_clean)
+        reg = ModelRegistry(store=pub.store)
+        stop = threading.Event()
+        staleness: List[float] = []
+        seen = set()
+
+        def watch():
+            while not stop.is_set():
+                if reg.poll_store(pub.store):
+                    cur = reg.current()
+                    if cur.manifest_seq not in seen:
+                        seen.add(cur.manifest_seq)
+                        staleness.append(
+                            cur.adopted_at - cur.record["time"])
+                time.sleep(0.01)
+
+        w = threading.Thread(target=watch, daemon=True)
+        w.start()
+        for gen in range(1, commits + 1):
+            for k, v in _leaves(n_leaves, leaf_elems, gen,
+                                "frozen").items():
+                setattr(state, k, v)
+            state.commit()
+            pub.maybe_publish(state._commit_seq)
+            time.sleep(cadence_s)
+        # One cadence of grace for the last adoption, then stop.
+        deadline = time.time() + max(2 * cadence_s, 1.0)
+        while len(seen) < commits and time.time() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        w.join(timeout=10)
+        return {
+            "commits": commits, "cadence_s": cadence_s,
+            "adopted": len(seen),
+            "staleness_p50_s": round(statistics.median(staleness), 4)
+            if staleness else None,
+            "staleness_max_s": round(max(staleness), 4)
+            if staleness else None,
+        }
+
+
+# -- aggregation --------------------------------------------------------------
+
+
+def _noise(ratios: List[float]) -> dict:
+    rs = sorted(ratios)
+    return {"rounds": len(rs),
+            "ratio_min": round(rs[0], 4),
+            "ratio_max": round(rs[-1], 4),
+            "spread": round(rs[-1] - rs[0], 4)}
+
+
+def run_harness(*, rounds: int, swaps: int, n_leaves: int,
+                leaf_elems: int) -> dict:
+    arms: Dict[str, List[dict]] = {"all": [], "frozen": []}
+    pair_ratios: List[float] = []
+    for _ in range(rounds):
+        # Interleaved: all then frozen inside every round pair, so drift
+        # (CPU load, page cache) hits both arms alike.
+        a = run_swap_round("all", swaps=swaps, n_leaves=n_leaves,
+                           leaf_elems=leaf_elems)
+        f = run_swap_round("frozen", swaps=swaps, n_leaves=n_leaves,
+                           leaf_elems=leaf_elems)
+        arms["all"].append(a)
+        arms["frozen"].append(f)
+        pair_ratios.append(a["adopt_s_median"]
+                           / max(f["adopt_s_median"], 1e-9))
+    traffic = run_traffic_segment(swaps=2, n_leaves=n_leaves,
+                                  leaf_elems=leaf_elems)
+    stale = run_staleness_segment(commits=5, cadence_s=0.2,
+                                  n_leaves=n_leaves, leaf_elems=leaf_elems)
+
+    def med(mode: str, field: str) -> float:
+        return round(statistics.median(
+            r[field] for r in arms[mode]), 6)
+
+    return {
+        "bench": "serving",
+        "rounds": rounds, "swaps": swaps, "n_leaves": n_leaves,
+        "leaf_kb": arms["all"][0]["leaf_kb"],
+        "adopt_s": {m: med(m, "adopt_s_median") for m in ("all", "frozen")},
+        # Headline: all/frozen adopt-wall ratio, median over interleaved
+        # round pairs — the delta-fetch advantage.
+        "swap_ratio": round(statistics.median(pair_ratios), 4),
+        "noise": _noise(pair_ratios),
+        "blobs_fetched_per_swap": {
+            m: med(m, "blobs_fetched_per_swap") for m in ("all", "frozen")},
+        "leaves_reused_per_swap": {
+            m: med(m, "leaves_reused_per_swap") for m in ("all", "frozen")},
+        "traffic": traffic,
+        "staleness": stale,
+    }
+
+
+def _append_history(rec: dict) -> None:
+    import datetime
+    import subprocess
+    try:
+        sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             capture_output=True, text=True,
+                             cwd=os.path.dirname(HISTORY_PATH)
+                             ).stdout.strip() or None
+    except OSError:
+        sha = None
+    stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    with open(HISTORY_PATH, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps({"date": stamp, "git": sha, **rec}) + "\n")
+
+
+# -- --check: guardrail over the recorded series ------------------------------
+
+
+def check_history(path: str = HISTORY_PATH) -> dict:
+    """Validate the NEWEST history record: the keys the guardrail test
+    pins must exist and sit inside the rails."""
+    with open(path, "r", encoding="utf-8") as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    recs = [r for r in recs if r.get("bench") == "serving"]
+    if not recs:
+        raise ValueError(f"no serving records in {path}")
+    rec = recs[-1]
+    problems: List[str] = []
+
+    def need(cond: bool, what: str) -> None:
+        if not cond:
+            problems.append(what)
+
+    ratio = rec.get("swap_ratio")
+    need(isinstance(ratio, (int, float)) and ratio >= MIN_SWAP_RATIO,
+         f"swap_ratio={ratio} < {MIN_SWAP_RATIO}x (frozen-leaf swap not "
+         f"strictly cheaper than all-leaves)")
+    noise = rec.get("noise") or {}
+    need(noise.get("rounds", 0) >= 2
+         and all(k in noise for k in ("ratio_min", "ratio_max", "spread")),
+         f"noise band incomplete: {noise}")
+    fetched = rec.get("blobs_fetched_per_swap") or {}
+    need(isinstance(fetched.get("frozen"), (int, float))
+         and isinstance(fetched.get("all"), (int, float))
+         and fetched["frozen"] < fetched["all"],
+         f"frozen arm did not fetch fewer blobs per swap: {fetched}")
+    traffic = rec.get("traffic") or {}
+    need(traffic.get("requests", 0) > 0 and traffic.get("dropped") == 0
+         and traffic.get("failed") == 0,
+         f"traffic lost requests across swaps: {traffic}")
+    need(traffic.get("swaps_during", 0) >= 2
+         and len(traffic.get("model_seqs_served") or []) >= 2,
+         f"traffic did not span >=2 hot-swaps: {traffic}")
+    stale = rec.get("staleness") or {}
+    need(stale.get("adopted") == stale.get("commits"),
+         f"staleness segment missed publishes: {stale}")
+    smax = stale.get("staleness_max_s")
+    need(isinstance(smax, (int, float)) and 0 < smax < MAX_STALENESS_S,
+         f"staleness_max_s={smax} outside (0, {MAX_STALENESS_S})")
+    return {"check": "serving", "ok": not problems,
+            "record_date": rec.get("date"), "record_git": rec.get("git"),
+            "problems": problems}
+
+
+# -- entry points -------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="interleaved all/frozen round pairs")
+    ap.add_argument("--swaps", type=int, default=4,
+                    help="timed hot-swaps per round")
+    ap.add_argument("--leaves", type=int, default=16,
+                    help="model leaves (one changes in the frozen arm)")
+    ap.add_argument("--leaf-elems", type=int, default=65536,
+                    help="float32 elements per leaf (256 KiB default)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the newest history record and exit")
+    ap.add_argument("--smoke", type=int, default=0, metavar="N",
+                    help="one shrunk round pair with N leaves (chaos "
+                         "tier); prints that pair's JSON")
+    a = ap.parse_args(argv)
+
+    if a.check:
+        verdict = check_history()
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else 1
+
+    if a.smoke:
+        res_all = run_swap_round("all", swaps=2, n_leaves=a.smoke,
+                                 leaf_elems=4096)
+        res_frz = run_swap_round("frozen", swaps=2, n_leaves=a.smoke,
+                                 leaf_elems=4096)
+        traffic = run_traffic_segment(swaps=2, n_leaves=a.smoke,
+                                      leaf_elems=4096,
+                                      clients=2, requests_per_client=10)
+        print(json.dumps({"bench": "serving_smoke", "all": res_all,
+                          "frozen": res_frz, "traffic": traffic}))
+        ok = (traffic["dropped"] == 0 and traffic["failed"] == 0
+              and res_frz["blobs_fetched_per_swap"]
+              < res_all["blobs_fetched_per_swap"])
+        return 0 if ok else 1
+
+    rec = run_harness(rounds=a.rounds, swaps=a.swaps, n_leaves=a.leaves,
+                      leaf_elems=a.leaf_elems)
+    print(json.dumps(rec))
+    if os.environ.get(NO_HISTORY_ENV, "").lower() not in ("1", "true"):
+        _append_history(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,16 @@ CLAUDE.md "Environment traps"):
   already fetched (the watchdog span / Keras logs), or fetch OUTSIDE
   the telemetry call at a point that must synchronize anyway.
 
+- ``lint-recompile-in-request-path`` (WARNING): a serve loop — one that
+  drains requests from a queue/socket — feeding a jitted callable
+  directly with request-shaped inputs, with no padding/bucketing call
+  anywhere in the loop.  jit caches compiled programs BY SHAPE, so every
+  distinct request/batch size compiles a fresh program on the request
+  path (seconds of latency, unbounded compile cache).  Coalesce into a
+  fixed set of bucket sizes with padding
+  (``serving/server.py::pad_to_bucket``, ``HOROVOD_SERVING_BUCKETS``) so
+  compiles are bounded by configuration, not traffic — docs/serving.md.
+
 - ``lint-blocking-commit`` (WARNING): a bare ``jax.device_get`` inside
   a step/commit loop — a loop that also calls ``.commit()``.  The
   elastic commit path is pipelined (elastic/state.py
@@ -138,6 +148,30 @@ FETCH_CALL_NAMES = frozenset({"block_until_ready", "asarray",
 # many host-side uses) to keep the rule precise.
 COMMIT_CALL_NAMES = frozenset({"commit"})
 COMMIT_FETCH_NAMES = frozenset({"device_get"})
+
+# lint-recompile-in-request-path vocabulary: calls that mark a loop as
+# draining requests (distinctive names count bare; the generic ``get``
+# needs a queue-ish receiver so dict.get stays clean), and the
+# pad/bucket call names whose presence marks the loop as batching.
+REQUEST_DRAIN_NAMES = frozenset({"get_nowait", "recv", "recv_json",
+                                 "accept"})
+REQUEST_DRAIN_GENERIC = frozenset({"get"})
+REQUEST_RECEIVER_TOKENS = ("queue", "request", "req", "inbox", "pending")
+
+
+def _is_request_drain(name: str) -> bool:
+    parts = name.split(".")
+    if parts[-1] in REQUEST_DRAIN_NAMES:
+        return True
+    if parts[-1] in REQUEST_DRAIN_GENERIC:
+        prefix = ".".join(parts[:-1]).lower()
+        return any(t in prefix for t in REQUEST_RECEIVER_TOKENS)
+    return False
+
+
+def _is_batching_call(name: str) -> bool:
+    last = name.split(".")[-1].lower()
+    return "pad" in last or "bucket" in last
 
 
 def _is_telemetry_record(name: str) -> bool:
@@ -230,6 +264,11 @@ class _Lint(ast.NodeVisitor):
         # lint-blocking-commit: fetch sites already attributed to an
         # enclosing (outermost) commit loop.
         self._commit_fetch_handled: set = set()
+        # lint-recompile-in-request-path: names bound to jit(...) results
+        # in this file (prescanned in visit_Module), and jit call sites
+        # already attributed to an enclosing serve loop.
+        self._jit_names: set = set()
+        self._recompile_handled: set = set()
         # lint-blocking-telemetry: loop nesting (a "step loop" is any
         # for/while the record call sits inside).
         self._loop_depth = 0
@@ -288,6 +327,24 @@ class _Lint(ast.NodeVisitor):
                     self.sets_jax_platforms_cpu = stmt.lineno
 
     # -- visitors ------------------------------------------------------
+
+    def visit_Module(self, node):
+        # Prescan for jit-bound names (assignment order vs use order is
+        # irrelevant to the serve-loop check, so collect them all first):
+        # ``f = jax.jit(...)`` / ``f = jit(...)`` and ``@jax.jit`` defs.
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and _dotted(sub.value.func).split(".")[-1] == "jit":
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._jit_names.add(tgt.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in sub.decorator_list:
+                    d = dec.func if isinstance(dec, ast.Call) else dec
+                    if _dotted(d).split(".")[-1] == "jit":
+                        self._jit_names.add(sub.name)
+        self.generic_visit(node)
 
     def visit_If(self, node):
         guarded = any(
@@ -410,8 +467,40 @@ class _Lint(ast.NodeVisitor):
                 "arrays; fetch host copies only outside the step loop "
                 "(docs/checkpointing.md)")
 
+    def _check_recompile_request_path(self, node):
+        """lint-recompile-in-request-path: a request-draining loop calls
+        a jit-bound name with no padding/bucketing call anywhere in the
+        loop — every distinct request shape compiles a fresh program on
+        the serve path. Outer loop visited first; nested loops skip
+        already-attributed call sites."""
+        calls = [sub for sub in ast.walk(node) if isinstance(sub, ast.Call)]
+        if not any(_is_request_drain(_dotted(c.func)) for c in calls):
+            return
+        if any(_is_batching_call(_dotted(c.func)) for c in calls):
+            return
+        for c in calls:
+            if not (isinstance(c.func, ast.Name)
+                    and c.func.id in self._jit_names):
+                continue
+            if not c.args and not c.keywords:
+                continue    # no inputs fed: a thunk relay, not a forward
+            if id(c) in self._recompile_handled:
+                continue
+            self._recompile_handled.add(id(c))
+            self._add(
+                "lint-recompile-in-request-path", Severity.WARNING, c,
+                f"jitted callable {c.func.id!r} fed request-shaped inputs "
+                "inside a serve loop with no padding/bucketing: jit "
+                "caches programs BY SHAPE, so every distinct batch size "
+                "compiles a fresh program on the request path (seconds of "
+                "tail latency, unbounded compile cache); coalesce into "
+                "fixed buckets with padding (serving/server.py "
+                "pad_to_bucket, HOROVOD_SERVING_BUCKETS) so compiles are "
+                "bounded by configuration, not traffic — docs/serving.md")
+
     def visit_For(self, node):
         self._check_blocking_commit(node)
+        self._check_recompile_request_path(node)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1
@@ -449,6 +538,7 @@ class _Lint(ast.NodeVisitor):
                     "HOROVOD_ELASTIC_POLL_JITTER, or park server-side via "
                     "get_world(wait=...) (see benchmarks/control_plane.py)")
         self._check_blocking_commit(node)
+        self._check_recompile_request_path(node)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1

@@ -280,6 +280,73 @@ class BlobStore:
         m = self.newest_manifest()
         return -1 if m is None else int(m["seq"])
 
+    # -- publish pins --------------------------------------------------------
+    #
+    # A pin marks a manifest as externally referenced — a serving pointer
+    # (serving/registry.py) may be mid-delta-fetch against it long after
+    # the HOROVOD_CHECKPOINT_KEEP window moved past it. gc() keeps every
+    # pinned manifest AND its blobs regardless of the retention depth.
+    # Pins are atomic single files so the publisher (training side) and a
+    # reader (serving side) never see a torn pin.
+
+    def _pin_root(self) -> str:
+        return os.path.join(self.root, "pins")
+
+    def pin_path(self, seq: int) -> str:
+        return os.path.join(self._pin_root(), "%08d.json" % int(seq))
+
+    def pin_manifest(self, seq: int, meta: Optional[Dict] = None) -> str:
+        """Pin a manifest against GC, attaching ``meta`` (the publish
+        record — serving processes without a coordinator read it via
+        :meth:`read_pin`). Atomic tmp+rename, idempotent (re-pinning
+        overwrites the meta)."""
+        path = self.pin_path(seq)
+        os.makedirs(self._pin_root(), exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"seq": int(seq), "time": time.time(),
+                           **(meta or {})}, f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    def unpin_manifest(self, seq: int) -> bool:
+        try:
+            os.unlink(self.pin_path(seq))
+            return True
+        except OSError:
+            return False
+
+    def pinned_seqs(self) -> List[int]:
+        try:
+            names = os.listdir(self._pin_root())
+        except OSError:
+            return []
+        seqs = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                seqs.append(int(name[:-len(".json")]))
+            except ValueError:
+                continue
+        return sorted(seqs)
+
+    def read_pin(self, seq: int) -> Optional[Dict]:
+        """One pin's metadata (the publish record), or None when the pin
+        is absent/torn."""
+        try:
+            with open(self.pin_path(seq), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     # -- retention -----------------------------------------------------------
 
     def referenced_digests(self, manifests: List[Dict]) -> set:
@@ -300,6 +367,11 @@ class BlobStore:
         are candidates — blobs of an in-flight commit whose manifest is
         not yet published are always newer than every published
         manifest, so they survive the sweep.
+
+        Publish pins (:meth:`pin_manifest`) extend the kept set: a
+        pinned manifest and its blobs are NEVER swept, no matter how far
+        the retention window has moved past it — a serving process may
+        still be delta-fetching against it (docs/serving.md).
         """
         keep = max(1, int(keep))
         seqs = self.manifest_seqs()
@@ -307,7 +379,11 @@ class BlobStore:
                  "bytes_freed": 0}
         if len(seqs) <= keep:
             return stats
-        kept_seqs, dropped = seqs[-keep:], seqs[:-keep]
+        pinned = set(self.pinned_seqs())
+        kept_seqs = sorted(set(seqs[-keep:]) | (pinned & set(seqs)))
+        dropped = [s for s in seqs if s not in set(kept_seqs)]
+        if not dropped:
+            return stats
         kept = [m for s in kept_seqs
                 if (m := self.read_manifest(s)) is not None]
         if not kept:

@@ -54,6 +54,7 @@ def empty_state() -> Dict[str, Any]:
         "version": 0, "hosts": {}, "np": 0,
         "failures": [], "failure_seq": 0, "registrations": {},
         "metrics": {},
+        "publish": None, "publish_seq": 0,
     }
 
 
@@ -89,6 +90,14 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
             str(rec["rank"]), {"c": {}, "g": {}})
         per_rank["c"].update(rec.get("c", {}))
         per_rank["g"].update(rec.get("g", {}))
+    elif op == "publish":
+        # Serving-plane announcement (serving/publisher.py): the newest
+        # known-good published weights. Deliberately does NOT touch
+        # version/failure_seq — publishing weights is not a membership
+        # event, so training workers' delta cursors never move for it.
+        # publish_seq is the serving processes' own long-poll cursor.
+        state["publish"] = dict(rec["record"])
+        state["publish_seq"] = int(state.get("publish_seq", 0)) + 1
     elif op == "snapshot":
         # Compaction marker: reset to the embedded live state.
         snap = rec["state"]
@@ -104,6 +113,9 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         state["metrics"] = {str(k): {"c": dict(v.get("c", {})),
                                      "g": dict(v.get("g", {}))}
                             for k, v in snap.get("metrics", {}).items()}
+        pub = snap.get("publish")
+        state["publish"] = dict(pub) if pub is not None else None
+        state["publish_seq"] = int(snap.get("publish_seq", 0))
     else:
         return False
     return True

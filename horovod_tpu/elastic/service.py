@@ -218,6 +218,15 @@ class CoordinatorService:
         # part of the /world payload (WORLD_KEYS is frozen) and never
         # enters the delta window — served separately at GET /metrics.
         self._metrics: Dict[str, dict] = {}
+        # Serving-plane publish pointer (serving/publisher.py): the newest
+        # known-good published weights record, plus its own monotonic
+        # cursor. Like metrics it never bumps version/failure_seq and
+        # never enters the delta window (WORLD_KEYS stays frozen) — it
+        # rides on /world replies as extra keys only for clients that ask
+        # (``since_p``), and has its own long-poll wake so a serving
+        # process learns of a publish immediately without new RPCs.
+        self._publish: Optional[dict] = None
+        self._publish_seq = 0
         self._journal = CoordinatorJournal(journal_path) if journal_path \
             else None
         if restore and journal_path:
@@ -231,6 +240,8 @@ class CoordinatorService:
                 self._started = {int(k): v for k, v
                                  in state["registrations"].items()}
                 self._metrics = state.get("metrics", {})
+                self._publish = state.get("publish")
+                self._publish_seq = int(state.get("publish_seq", 0))
                 get_logger().info(
                     "coordinator state restored from journal %s "
                     "(version=%d failure_seq=%d hosts=%s)", journal_path,
@@ -303,17 +314,28 @@ class CoordinatorService:
 
                 since_v = _qnum("since_v", int)
                 since_s = _qnum("since_s", int)
+                since_p = _qnum("since_p", int)
                 wait_s = min(max(_qnum("wait", float) or 0.0, 0.0),
                              C.LONG_POLL_CAP_S)
                 cursor = (since_v + since_s) \
                     if since_v is not None and since_s is not None else None
                 with svc._cond:
-                    if cursor is not None and wait_s > 0:
+                    if (cursor is not None or since_p is not None) \
+                            and wait_s > 0:
                         svc._cond.wait_for(
                             lambda: svc._closing or
-                            svc._version + svc._failure_seq != cursor,
+                            (cursor is not None and
+                             svc._version + svc._failure_seq != cursor) or
+                            (since_p is not None and
+                             svc._publish_seq != since_p),
                             timeout=wait_s)
                     reply = svc._world_reply_locked(since_v, since_s)
+                    if since_p is not None:
+                        # Publish extras ride as reply-level keys the
+                        # canonical-world extraction strips (same channel
+                        # poll_s uses) — only for clients that asked.
+                        reply["publish_seq"] = svc._publish_seq
+                        reply["publish"] = svc._publish
                 self._reply(reply)
 
             def do_POST(self):
@@ -341,6 +363,12 @@ class CoordinatorService:
                     # poll cadence (watchdog watcher / commit-time check).
                     svc._record_metrics(msg)
                     self._reply({"ok": True})
+                elif self.path == "/publish":
+                    # Training-side publish announcement
+                    # (serving/publisher.py): journaled, wakes publish
+                    # long-pollers, never bumps version/failure_seq.
+                    ok = svc._record_publish(msg)
+                    self._reply({"ok": ok})
                 else:
                     get_logger().debug(
                         "coordinator: unknown POST path %s from %s",
@@ -416,6 +444,9 @@ class CoordinatorService:
             state["metrics"] = {k: {"c": dict(v.get("c", {})),
                                     "g": dict(v.get("g", {}))}
                                 for k, v in self._metrics.items()}
+            state["publish"] = dict(self._publish) \
+                if self._publish is not None else None
+            state["publish_seq"] = self._publish_seq
             self._journal.compact(state)
 
     def _record_register(self, process_id: int, ts: float) -> None:
@@ -460,6 +491,40 @@ class CoordinatorService:
                 self._journal.append({"op": "metrics", "rank": rank,
                                       "c": c, "g": g})
                 self._maybe_compact_locked()
+
+    def _record_publish(self, msg: dict) -> bool:
+        """Adopt one publish record (serving/publisher.py wire shape:
+        ``{"record": {...}}`` with at least ``manifest_seq`` and
+        ``commit_dir``), journal it so serving discovery survives a
+        coordinator crash-restart, and wake publish long-pollers. Like
+        metrics, does NOT bump ``version``/``failure_seq`` or enter the
+        delta window — a publish is not a membership event."""
+        try:
+            record = dict(msg["record"])
+            int(record["manifest_seq"])
+            str(record["commit_dir"])
+        except (KeyError, TypeError, ValueError):
+            get_logger().debug("coordinator: malformed publish "
+                               "announcement ignored: %r", msg)
+            return False
+        with self._lock:
+            self._publish = record
+            self._publish_seq += 1
+            if self._journal:
+                self._journal.append({"op": "publish", "record": record})
+                self._maybe_compact_locked()
+            self._cond.notify_all()
+        get_logger().info(
+            "coordinator: publish #%d adopted (manifest_seq=%s step=%s)",
+            self._publish_seq, record.get("manifest_seq"),
+            record.get("step"))
+        return True
+
+    def publish_snapshot(self) -> tuple:
+        """``(publish_seq, record-or-None)`` — driver/test observability."""
+        with self._lock:
+            rec = dict(self._publish) if self._publish is not None else None
+            return self._publish_seq, rec
 
     def metrics_snapshot(self) -> Dict[str, dict]:
         """Per-rank compact snapshots (deep-copied) — the incident
@@ -581,13 +646,23 @@ class CoordinatorClient:
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[random.Random] = None,
-                 delta: bool = True):
+                 delta: bool = True, watch_publish: bool = False):
         self._base = f"http://{addr}"
         self._key = secret_key
         #: False = never send a cursor: every /world is a full fetch (the
         #: pre-delta wire protocol — the A/B baseline arm of
         #: benchmarks/control_plane.py; no production caller sets this).
         self._delta = delta
+        #: True = subscribe to serving-plane publish announcements: every
+        #: /world carries ``since_p`` so the server attaches the newest
+        #: publish record and wakes this client's long-poll when it moves
+        #: (serving/registry.py). Training workers leave this off — their
+        #: replies and wake conditions are unchanged.
+        self._watch_publish = watch_publish
+        #: Newest publish record seen (None until one arrives) + its
+        #: server-side cursor. Only maintained when ``watch_publish``.
+        self.last_publish: Optional[dict] = None
+        self.publish_seq = 0
         self._policy = policy or RetryPolicy.from_env()
         if timeout_s is not None:
             self._policy.timeout_s = timeout_s
@@ -806,6 +881,13 @@ class CoordinatorClient:
                 self.advertised_poll_s = float(poll)
             except (TypeError, ValueError):
                 pass
+        if "publish_seq" in reply:
+            try:
+                self.publish_seq = int(reply["publish_seq"])
+                pub = reply.get("publish")
+                self.last_publish = dict(pub) if pub is not None else None
+            except (TypeError, ValueError):
+                pass
         try:
             if reply.get("nm"):
                 with self._lock:
@@ -877,17 +959,20 @@ class CoordinatorClient:
         a first world has been fetched (the cursor is what the server
         parks on); the per-attempt HTTP timeout is extended by the bound
         so a full park does not read as a transport failure."""
-        path = "/world"
         timeout_s: Optional[float] = None
         with self._lock:
             w = self._world
+        params = []
         if w is not None and self._delta:
-            path = (f"/world?since_v={w['version']}"
-                    f"&since_s={w['failure_seq']}")
-            if wait is not None and wait > 0:
-                bound = min(float(wait), C.LONG_POLL_CAP_S)
-                path += f"&wait={bound:g}"
-                timeout_s = self._policy.timeout_s + bound
+            params += [f"since_v={w['version']}",
+                       f"since_s={w['failure_seq']}"]
+        if self._watch_publish:
+            params.append(f"since_p={self.publish_seq}")
+        if params and wait is not None and wait > 0:
+            bound = min(float(wait), C.LONG_POLL_CAP_S)
+            params.append(f"wait={bound:g}")
+            timeout_s = self._policy.timeout_s + bound
+        path = "/world" + ("?" + "&".join(params) if params else "")
         reply = self._call(path, timeout_s=timeout_s)
         if reply is None:
             return None
@@ -911,6 +996,15 @@ class CoordinatorClient:
                            "c": delta.get("c", {}),
                            "g": delta.get("g", {})}).encode()
         reply = self._call("/metrics", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def announce_publish(self, record: dict) -> bool:
+        """Announce one published-weights record (training side,
+        serving/publisher.py). Best-effort under the usual retry policy:
+        a dropped announcement is healed by the pin file in the CAS dir
+        (store-watch discovery) and by the next publish."""
+        body = json.dumps({"record": dict(record)}).encode()
+        reply = self._call("/publish", data=body)
         return bool(reply and reply.get("ok"))
 
     def register_batch(self, process_ids: Iterable[int]) -> bool:

@@ -389,6 +389,38 @@ def _flush_writers_for(commit_dir: str,
             w.flush(timeout=timeout)
 
 
+#: Post-commit hooks: ``fn(commit_dir, seq)`` called on the WRITER thread
+#: after each manifest publish + retention sweep. The serving publisher
+#: (serving/publisher.py) attaches its publish gate here so gate work
+#: (manifest read-back, blob re-hash) runs off the step loop. Hook
+#: exceptions are logged and swallowed — a broken hook must never kill
+#: the commit writer.
+_COMMIT_HOOKS: List[Callable[[str, int], None]] = []
+
+
+def register_commit_hook(fn: Callable[[str, int], None]):
+    """Register a post-commit hook; returns ``fn`` (decorator-friendly)."""
+    _COMMIT_HOOKS.append(fn)
+    return fn
+
+
+def unregister_commit_hook(fn: Callable[[str, int], None]) -> bool:
+    try:
+        _COMMIT_HOOKS.remove(fn)
+        return True
+    except ValueError:
+        return False
+
+
+def _fire_commit_hooks(commit_dir: str, seq: int) -> None:
+    for fn in list(_COMMIT_HOOKS):
+        try:
+            fn(commit_dir, seq)
+        except Exception as err:    # noqa: BLE001 — must not kill the writer
+            get_logger().error(
+                "post-commit hook %r failed (seq=%s): %s", fn, seq, err)
+
+
 class _CommitWriter:
     """Double-buffered background persister for one state object.
 
@@ -598,6 +630,7 @@ class _CommitWriter:
         if job["on_snapshot"] is not None:
             job["on_snapshot"](jax.tree_util.tree_unflatten(
                 job["treedef"], host_leaves))
+        _fire_commit_hooks(self.commit_dir, int(job["seq"]))
 
 
 def _unpack_manifest(store, manifest: Dict[str, Any]) -> Dict[str, Any]:

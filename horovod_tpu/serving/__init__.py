@@ -1,0 +1,16 @@
+"""Serving plane: continuous-training → inference (docs/serving.md).
+
+Three parts, layered on the elastic/CAS infrastructure:
+
+- :mod:`~horovod_tpu.serving.publisher` — training-side publish gate
+  (cadence + sentinel-clean window + blob integrity) announcing
+  known-good generations;
+- :mod:`~horovod_tpu.serving.registry` — serving-side discovery,
+  delta-fetch and RCU hot-swap of the served param pytree;
+- :mod:`~horovod_tpu.serving.server` — HTTP inference frontend with
+  bucketed dynamic batching and ``hvd_serving_*`` telemetry.
+"""
+
+from .publisher import Publisher, attach, detach, leaves_digest  # noqa: F401
+from .registry import ModelRegistry, ServedModel                 # noqa: F401
+from .server import InferenceServer, pad_to_bucket               # noqa: F401

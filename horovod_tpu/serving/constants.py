@@ -1,0 +1,105 @@
+"""Serving-plane configuration knobs (docs/serving.md "Env knobs").
+
+Same env-naming conventions as elastic/constants.py: every knob is
+``HOROVOD_*``, read lazily at use so tests can flip them per-case.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Publish cadence: every Nth committed generation that passes the gate
+#: is published (0 disables publishing entirely).
+PUBLISH_EVERY_ENV = "HOROVOD_PUBLISH_EVERY"
+DEFAULT_PUBLISH_EVERY = 1
+
+#: How many published manifests stay pinned against GC. Must be >= 2 so
+#: the previously-served manifest survives while a swap to the newest is
+#: in flight (the registry may still delta-fetch against it).
+PUBLISH_KEEP_ENV = "HOROVOD_PUBLISH_KEEP"
+DEFAULT_PUBLISH_KEEP = 2
+
+#: Serving-side discovery cadence (seconds) when NOT long-polling (the
+#: store-watch mode's pin scan, and the floor between long-poll rounds).
+SERVING_POLL_ENV = "HOROVOD_SERVING_POLL_SECONDS"
+DEFAULT_SERVING_POLL_S = 1.0
+
+#: Long-poll bound (seconds) the registry's coordinator watcher parks
+#: for (clamped server-side to elastic LONG_POLL_CAP_S).
+SERVING_LONG_POLL_ENV = "HOROVOD_SERVING_LONG_POLL_SECONDS"
+DEFAULT_SERVING_LONG_POLL_S = 30.0
+
+#: Dynamic-batching window (milliseconds): how long the batcher waits to
+#: coalesce queued requests into one bucketed device call.
+BATCH_WINDOW_ENV = "HOROVOD_SERVING_BATCH_WINDOW_MS"
+DEFAULT_BATCH_WINDOW_MS = 2.0
+
+#: Comma-separated ascending bucket sizes the batcher pads into — the
+#: complete set of batch shapes the jitted forward will ever see, so
+#: compiles are bounded by len(buckets), not by traffic.
+BUCKETS_ENV = "HOROVOD_SERVING_BUCKETS"
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+#: Rank label serving metrics are pushed/rendered under — far above any
+#: real training rank so fleet rollups keep serving separable.
+SERVING_RANK_ENV = "HOROVOD_SERVING_RANK"
+DEFAULT_SERVING_RANK = 900
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def publish_every() -> int:
+    return _env_int(PUBLISH_EVERY_ENV, DEFAULT_PUBLISH_EVERY)
+
+
+def publish_keep() -> int:
+    # >= 2 by contract: the previous publish must stay fetchable during
+    # a swap to the newest one.
+    return max(2, _env_int(PUBLISH_KEEP_ENV, DEFAULT_PUBLISH_KEEP))
+
+
+def serving_poll_s() -> float:
+    return max(0.01, _env_float(SERVING_POLL_ENV, DEFAULT_SERVING_POLL_S))
+
+
+def serving_long_poll_s() -> float:
+    return max(0.0, _env_float(SERVING_LONG_POLL_ENV,
+                               DEFAULT_SERVING_LONG_POLL_S))
+
+
+def batch_window_s() -> float:
+    return max(0.0, _env_float(BATCH_WINDOW_ENV,
+                               DEFAULT_BATCH_WINDOW_MS)) / 1e3
+
+
+def buckets() -> tuple:
+    raw = os.environ.get(BUCKETS_ENV, "")
+    if not raw:
+        return DEFAULT_BUCKETS
+    try:
+        sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return DEFAULT_BUCKETS
+    return tuple(s for s in sizes if s > 0) or DEFAULT_BUCKETS
+
+
+def serving_rank() -> int:
+    return _env_int(SERVING_RANK_ENV, DEFAULT_SERVING_RANK)

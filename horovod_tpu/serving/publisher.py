@@ -1,0 +1,217 @@
+"""Training-side publisher: gate committed generations into the serving
+plane.
+
+Reference analog: upstream Horovod's elastic state broadcast
+(``horovod/common/elastic``, SURVEY.md §2) re-broadcasts known-good state
+to WORKERS on reset; here the same "known-good weights" predicate —
+an atomically-published manifest whose blobs verify against their
+content addresses, over a sentinel-clean window — pushes state OUT to
+serving processes instead (docs/serving.md).
+
+The gate, per candidate commit ``seq``:
+
+1. **Cadence** — only every Nth committed generation is a candidate
+   (``HOROVOD_PUBLISH_EVERY``).
+2. **Sentinel-clean window** — zero ``steps_skipped``/``rollbacks``
+   since the last candidate (core/sentinel.py counters): a window that
+   contained a numeric-containment event never reaches users.
+3. **Integrity** — the manifest must read back complete and EVERY blob
+   it references must re-hash to its content address
+   (checkpoint/store.py verify-at-read), so a publish can never point at
+   torn or bit-flipped bytes.
+
+A passing commit is pinned against GC FIRST (``BlobStore.pin_manifest``
+— the pin file carries the publish record, doubling as coordinator-less
+discovery for store-watch registries), then announced to the coordinator
+via the journaled ``op:"publish"`` record (elastic/service.py), which is
+best-effort: a dropped announcement is healed by the pin.
+
+Wire this off the step loop with :func:`attach`: the gate's blob re-hash
+runs on the commit writer's thread via ``elastic/state.py`` post-commit
+hooks, so the training step never blocks on publishing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Callable, Dict, Optional
+
+from ..checkpoint.store import BLOB_DIGEST_SIZE, BlobIntegrityError
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from ..core import sentinel as _sentinel
+from ..elastic.state import _CAS_SUBDIR, _cas_store, register_commit_hook, \
+    unregister_commit_hook
+from . import constants as SC
+
+
+def leaves_digest(manifest: Dict) -> str:
+    """One digest over every content address a manifest references, in
+    manifest order — the served-weights identity both ends compare: the
+    publisher stamps it into the publish record, the registry recomputes
+    it from what it actually swapped in (tests/test_serving_e2e.py
+    asserts equality after every swap)."""
+    h = hashlib.blake2b(digest_size=BLOB_DIGEST_SIZE)
+    h.update(str(manifest.get("skeleton", "")).encode())
+    for entry in manifest.get("leaves", []):
+        h.update(str(entry[0]).encode())
+    return h.hexdigest()
+
+
+class Publisher:
+    """Gate + announce published weights for one commit dir.
+
+    ``counters``/``clock`` are injectable (tests run the gate with a fake
+    sentinel and no real time); ``client`` is an optional
+    ``CoordinatorClient`` — without one, publishes are discoverable only
+    through the pin files (store-watch mode).
+    """
+
+    def __init__(self, commit_dir: str, client=None,
+                 every: Optional[int] = None, keep: Optional[int] = None,
+                 counters: Callable[[], Dict] = _sentinel.counters,
+                 clock: Callable[[], float] = time.time, rank: int = 0):
+        self.commit_dir = commit_dir
+        self.store = _cas_store(commit_dir)
+        self.client = client
+        self._every = every
+        self._keep = keep
+        self._counters = counters
+        self._clock = clock
+        self._rank = int(rank)
+        self._seen = 0
+        # Sentinel window baseline: counters at the LAST candidate commit
+        # (cadence hit), so "zero skips/rollbacks in the window" means
+        # since the previous publish decision, not since process start.
+        self._window_base = self._clean_counters()
+        self.last_published: Optional[Dict] = None
+
+    def _clean_counters(self) -> Dict[str, float]:
+        try:
+            c = self._counters() or {}
+        except Exception:       # noqa: BLE001 — a broken probe blocks, below
+            return {}
+        return {k: float(v) for k, v in c.items()
+                if isinstance(v, (int, float))}
+
+    def _cadence(self) -> int:
+        return SC.publish_every() if self._every is None else self._every
+
+    def _pin_keep(self) -> int:
+        return SC.publish_keep() if self._keep is None \
+            else max(2, int(self._keep))
+
+    # -- the gate ------------------------------------------------------------
+
+    def _blocked(self, cause: str, seq: int) -> None:
+        _telemetry.inc("hvd_serving_publish_gate_blocked_total")
+        _telemetry.record_event("publish_gate_blocked", cause=cause, seq=seq)
+        get_logger().warning(
+            "publish gate blocked commit seq=%d: %s", seq, cause)
+
+    def _sentinel_dirty(self) -> Optional[str]:
+        now = self._clean_counters()
+        base, self._window_base = self._window_base, now
+        for key in ("steps_skipped", "rollbacks"):
+            delta = now.get(key, 0.0) - base.get(key, 0.0)
+            if delta > 0:
+                return f"sentinel window dirty: {key} +{delta:g}"
+        return None
+
+    def _verify_manifest(self, seq: int) -> Optional[Dict]:
+        manifest = self.store.read_manifest(seq)
+        if manifest is None:
+            return None
+        try:
+            self.store.get_blob(manifest["skeleton"], verify=True)
+            for entry in manifest.get("leaves", []):
+                self.store.get_blob(entry[0], verify=True)
+        except (OSError, KeyError, BlobIntegrityError):
+            return None
+        return manifest
+
+    # -- publishing ----------------------------------------------------------
+
+    def maybe_publish(self, seq: int) -> Optional[Dict]:
+        """Run the gate on commit ``seq``; returns the publish record
+        when it published, None otherwise (not a candidate / blocked)."""
+        every = self._cadence()
+        if every <= 0:
+            return None
+        self._seen += 1
+        if self._seen % every != 0:
+            return None
+        dirty = self._sentinel_dirty()
+        if dirty is not None:
+            self._blocked(dirty, seq)
+            return None
+        manifest = self._verify_manifest(seq)
+        if manifest is None:
+            self._blocked("manifest unreadable or blob integrity "
+                          "verification failed", seq)
+            return None
+        record = {
+            "manifest_seq": int(seq),
+            "step": int(seq),
+            "commit_dir": self.commit_dir,
+            "cas": os.path.join(self.commit_dir, _CAS_SUBDIR),
+            "time": float(self._clock()),
+            "leaves_digest": leaves_digest(manifest),
+            "rank": self._rank,
+            "published": True,
+        }
+        # Pin BEFORE announcing: once a serving process can learn of this
+        # manifest, GC must already be unable to sweep it.
+        self.store.pin_manifest(seq, meta=record)
+        self._trim_pins()
+        if self.client is not None:
+            try:
+                self.client.announce_publish(record)
+            except Exception as err:    # noqa: BLE001 — pin already heals
+                get_logger().warning(
+                    "publish announcement for seq=%d failed (%s) — "
+                    "store-watch discovery via the pin file still works",
+                    seq, err)
+        self.last_published = record
+        _telemetry.inc("hvd_serving_published_total")
+        _telemetry.set_gauge("hvd_serving_last_published_seq", float(seq))
+        _telemetry.record_event("publish", seq=seq,
+                                leaves_digest=record["leaves_digest"])
+        get_logger().info("published commit seq=%d (leaves_digest=%s)",
+                          seq, record["leaves_digest"])
+        return record
+
+    def _trim_pins(self) -> None:
+        """Unpin all but the newest ``HOROVOD_PUBLISH_KEEP`` publish pins
+        (>= 2, so the previously-served manifest stays fetchable during a
+        swap). Only pins carrying a publish record are touched — foreign
+        pins are left alone."""
+        keep = self._pin_keep()
+        published = [s for s in self.store.pinned_seqs()
+                     if (self.store.read_pin(s) or {}).get("published")]
+        for seq in published[:-keep]:
+            self.store.unpin_manifest(seq)
+
+
+def attach(commit_dir: str, **kwargs) -> Publisher:
+    """Create a :class:`Publisher` and hook it onto the commit writer's
+    post-commit seam (elastic/state.py): the gate runs on the WRITER
+    thread after every manifest publish for this ``commit_dir``, keeping
+    blob re-hash work off the training step loop. Detach with
+    :func:`detach`."""
+    pub = Publisher(commit_dir, **kwargs)
+
+    def _hook(cd: str, seq: int) -> None:
+        if cd == commit_dir:
+            pub.maybe_publish(seq)
+
+    pub._hook = _hook           # keep the callable for detach()
+    register_commit_hook(_hook)
+    return pub
+
+
+def detach(pub: Publisher) -> bool:
+    hook = getattr(pub, "_hook", None)
+    return unregister_commit_hook(hook) if hook is not None else False

@@ -1,0 +1,236 @@
+"""Serving-side model registry: discover publishes, delta-fetch, hot-swap.
+
+Reference analog: upstream Horovod's elastic reset re-broadcasts the
+whole state object to every worker (``horovod/common/elastic``,
+SURVEY.md §2); the registry is the same state-movement contract pointed
+at inference — but content-addressed, so only CHANGED leaves move
+(checkpoint/store.py delta-fetch) and every byte is verified against its
+blake2b address before it can reach a user request.
+
+Swap semantics (RCU): the served model is one attribute assignment.
+:meth:`current` hands out a reference; an in-flight request keeps using
+the exact pytree object it grabbed — old weights, consistent across
+every leaf — while requests that arrive after the swap see the new one.
+No lock on the request path, no recompile (leaf shapes are unchanged, so
+the jitted forward's cache keys are too), and swap cost is bounded by
+changed-blob bytes: unchanged digests are served from the leaf cache,
+reusing the previously prepared (typically on-device) leaf object.
+
+Rejection: a publish whose manifest is unreadable, whose blobs are
+missing or fail digest verification, or whose ``leaves_digest`` does not
+match the announced record is NEVER swapped in — the previous served
+model stays current and ``hvd_serving_rejected_total`` increments
+(the publish-path chaos row in docs/failure_model.md).
+
+Discovery runs in either mode, same adoption path:
+
+- **coordinator watch**: a ``CoordinatorClient(watch_publish=True)``
+  long-polls ``/world`` with its publish cursor (elastic/service.py) and
+  :meth:`poll_coordinator` adopts whatever new record arrives;
+- **store watch**: :meth:`poll_store` scans the CAS pin files
+  (``BlobStore.pinned_seqs``) — the publisher writes the publish record
+  into the pin, so a serving process needs only the shared filesystem.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..checkpoint.store import BlobIntegrityError, BlobStore
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from .publisher import leaves_digest as _leaves_digest
+
+
+class ServedModel:
+    """One immutable served generation (the RCU payload)."""
+
+    __slots__ = ("payload", "record", "manifest_seq", "leaves_digest",
+                 "adopted_at")
+
+    def __init__(self, payload: Any, record: Dict, manifest_seq: int,
+                 digest: str, adopted_at: float):
+        self.payload = payload
+        self.record = record
+        self.manifest_seq = manifest_seq
+        self.leaves_digest = digest
+        self.adopted_at = adopted_at
+
+
+class ModelRegistry:
+    """Holds the served-model pointer for one serving process.
+
+    ``prepare_leaf`` is applied to every NEWLY fetched leaf (e.g.
+    ``jax.device_put`` onto the serving mesh); cache hits skip it, so an
+    unchanged leaf keeps its already-prepared (on-device) object across
+    swaps — that is the zero-copy half of the hot-swap. ``clock`` is
+    injectable for the staleness math in tests.
+    """
+
+    def __init__(self, store: Optional[BlobStore] = None,
+                 prepare_leaf: Optional[Callable[[Any], Any]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self._prepare = prepare_leaf
+        self._clock = clock
+        self._current: Optional[ServedModel] = None
+        self._leaf_cache: Dict[str, Any] = {}
+        #: adoption accounting, asserted by the delta-fetch unit tests
+        self.stats: Dict[str, int] = {
+            "blobs_fetched": 0, "leaves_reused": 0,
+            "swaps": 0, "rejected": 0,
+        }
+
+    # -- the request-path surface -------------------------------------------
+
+    def current(self) -> Optional[ServedModel]:
+        """The served model — one attribute read, never a lock. Callers
+        hold the returned reference for the whole request so a concurrent
+        swap cannot mix generations within it."""
+        return self._current
+
+    def staleness_s(self) -> Optional[float]:
+        """now − publish time of the served model (the
+        ``hvd_serving_staleness_seconds`` gauge), or None pre-first-swap."""
+        cur = self._current
+        if cur is None:
+            return None
+        return max(0.0, self._clock() - float(cur.record.get("time", 0.0)))
+
+    # -- adoption ------------------------------------------------------------
+
+    def _reject(self, record: Dict, cause: str) -> bool:
+        self.stats["rejected"] += 1
+        _telemetry.inc("hvd_serving_rejected_total")
+        _telemetry.record_event(
+            "publish_rejected", cause=cause,
+            manifest_seq=record.get("manifest_seq"))
+        get_logger().error(
+            "publish manifest_seq=%s REJECTED (%s) — previous served "
+            "model stays current", record.get("manifest_seq"), cause)
+        return False
+
+    def _store_for(self, record: Dict) -> Optional[BlobStore]:
+        if self.store is not None:
+            return self.store
+        cas = record.get("cas")
+        return BlobStore(cas) if cas else None
+
+    def adopt(self, record: Dict) -> bool:
+        """Fetch + verify + swap one announced publish. Returns True on
+        swap; False leaves the previous served model in place."""
+        t0 = time.perf_counter()
+        store = self._store_for(record)
+        if store is None:
+            return self._reject(record, "record names no CAS location")
+        try:
+            seq = int(record["manifest_seq"])
+        except (KeyError, TypeError, ValueError):
+            return self._reject(record, "malformed record")
+        cur = self._current
+        if cur is not None and cur.manifest_seq == seq:
+            return False                # already serving it
+        manifest = store.read_manifest(seq)
+        if manifest is None:
+            return self._reject(record, "manifest unreadable/torn")
+        digest = _leaves_digest(manifest)
+        want = record.get("leaves_digest")
+        if want is not None and want != digest:
+            return self._reject(
+                record, f"leaves_digest mismatch (announced {want}, "
+                        f"manifest has {digest})")
+        try:
+            payload, fetched, reused = self._materialize(store, manifest)
+        except (OSError, BlobIntegrityError, KeyError, ValueError,
+                pickle.UnpicklingError) as err:
+            return self._reject(record, f"blob fetch/verify failed: {err}")
+        now = self._clock()
+        self._current = ServedModel(payload, dict(record), seq, digest, now)
+        self._prune_cache(manifest)
+        dt = time.perf_counter() - t0
+        self.stats["blobs_fetched"] += fetched
+        self.stats["leaves_reused"] += reused
+        self.stats["swaps"] += 1
+        _telemetry.inc("hvd_serving_swaps_total")
+        _telemetry.observe("hvd_serving_swap_seconds", dt)
+        _telemetry.set_gauge("hvd_serving_model_seq", float(seq))
+        stale = self.staleness_s()
+        if stale is not None:
+            _telemetry.set_gauge("hvd_serving_staleness_seconds", stale)
+        _telemetry.record_event("model_swap", manifest_seq=seq,
+                                blobs_fetched=fetched, leaves_reused=reused,
+                                swap_seconds=round(dt, 6))
+        get_logger().info(
+            "hot-swapped to manifest_seq=%d (%d blobs fetched, %d leaves "
+            "reused, %.1f ms)", seq, fetched, reused, dt * 1e3)
+        return True
+
+    def _materialize(self, store: BlobStore, manifest: Dict):
+        """Payload pytree from a manifest, fetching only digests the leaf
+        cache does not hold (mirrors elastic/state.py::_unpack_manifest,
+        plus the cache). Verification happens inside ``get_blob``."""
+        import jax
+        from ..elastic.state import _LeafRef
+        skeleton = pickle.loads(store.get_blob(manifest["skeleton"]))
+        refs, treedef = jax.tree_util.tree_flatten(skeleton)
+        entries = manifest["leaves"]
+        leaves, fetched, reused = [], 0, 0
+        for ref in refs:
+            if not isinstance(ref, _LeafRef):
+                raise ValueError("manifest skeleton holds a non-ref leaf "
+                                 f"({type(ref).__name__})")
+            digest = entries[ref.index][0]
+            if digest in self._leaf_cache:
+                leaves.append(self._leaf_cache[digest])
+                reused += 1
+                continue
+            leaf = pickle.loads(store.get_blob(digest))
+            if self._prepare is not None:
+                leaf = self._prepare(leaf)
+            self._leaf_cache[digest] = leaf
+            leaves.append(leaf)
+            fetched += 1
+        return jax.tree_util.tree_unflatten(treedef, leaves), fetched, reused
+
+    def _prune_cache(self, manifest: Dict) -> None:
+        """Keep only digests the NEW manifest references — older leaves
+        stay alive exactly as long as an in-flight request holds the old
+        ``ServedModel``, then the GC takes them."""
+        live = {entry[0] for entry in manifest.get("leaves", [])}
+        for digest in [d for d in self._leaf_cache if d not in live]:
+            del self._leaf_cache[digest]
+
+    # -- discovery -----------------------------------------------------------
+
+    def poll_coordinator(self, client, wait: Optional[float] = None) -> bool:
+        """One coordinator round: long-poll ``/world`` (the client was
+        constructed with ``watch_publish=True``) and adopt a newly
+        announced record. Returns True when a swap happened."""
+        before = client.publish_seq
+        client.get_world(wait=wait)
+        rec = client.last_publish
+        if rec is None or client.publish_seq == before:
+            return False
+        return self.adopt(rec)
+
+    def poll_store(self, store: Optional[BlobStore] = None) -> bool:
+        """One store-watch round: adopt the newest publish pin
+        (coordinator-less mode — the pin file IS the publish record).
+        Returns True when a swap happened."""
+        store = store or self.store
+        if store is None:
+            return False
+        for seq in reversed(store.pinned_seqs()):
+            rec = store.read_pin(seq)
+            if not rec or not rec.get("published"):
+                continue
+            cur = self._current
+            if cur is not None and int(rec.get("manifest_seq", seq)) \
+                    <= cur.manifest_seq:
+                return False
+            if self.store is None:
+                self.store = store
+            return self.adopt(rec)
+        return False

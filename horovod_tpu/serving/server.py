@@ -1,0 +1,292 @@
+"""HTTP inference server: dynamic batching over a hot-swappable model.
+
+The serve path is built around two invariants:
+
+- **No per-request recompiles**: requests are coalesced within a short
+  window (``HOROVOD_SERVING_BATCH_WINDOW_MS``) and padded up to one of a
+  fixed set of bucket sizes (``HOROVOD_SERVING_BUCKETS``), so the jitted
+  forward only ever sees ``len(buckets)`` batch shapes — compiles are
+  bounded by configuration, not traffic (the
+  ``lint-recompile-in-request-path`` trap in hvd-analyze flags serve
+  loops that feed request-shaped inputs to a jitted callable instead).
+- **No dropped requests across swaps**: the batcher grabs ONE
+  ``registry.current()`` reference per batch (RCU — serving/registry.py)
+  and uses it for the whole device call; a swap landing mid-batch
+  affects only the next batch.
+
+The model-specific half (stacking request dicts, padding to ``n``,
+calling the jitted program, unstacking) lives in the ``forward``
+callable — ``forward(payload, inputs, padded_n) -> list of per-request
+results`` (see examples/online_dlrm.py) — so this server stays
+workload-agnostic.
+
+Surfaces: ``POST /predict`` (JSON request in, JSON result out),
+``GET /healthz``, and ``GET /metrics`` — the same Prometheus text
+exposition the coordinator serves (core/telemetry.py), carrying the
+``hvd_serving_*`` swap/staleness/queue/latency series under this
+process's serving rank label.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from . import constants as SC
+from .registry import ModelRegistry
+
+
+def pad_to_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest configured bucket >= ``n`` (the largest bucket caps the
+    batch size the batcher assembles, so ``n`` always fits)."""
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    return int(buckets[-1])
+
+
+def jsonable(value: Any) -> Any:
+    """Best-effort JSON coercion for forward outputs (numpy / jax
+    scalars and arrays)."""
+    if hasattr(value, "tolist"):
+        return value.tolist()
+    if hasattr(value, "item"):
+        return value.item()
+    return value
+
+
+class _Pending:
+    __slots__ = ("inputs", "event", "result", "error", "model_seq", "t0")
+
+    def __init__(self, inputs: Any, t0: float):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[str] = None
+        self.model_seq: Optional[int] = None
+        self.t0 = t0
+
+
+class InferenceServer:
+    """One serving process: HTTP frontend + batcher + publish watcher."""
+
+    def __init__(self, registry: ModelRegistry,
+                 forward: Callable[[Any, List[Any], int], List[Any]],
+                 bind_host: str = "127.0.0.1",
+                 buckets: Optional[Sequence[int]] = None,
+                 window_s: Optional[float] = None,
+                 request_timeout_s: float = 30.0,
+                 rank: Optional[int] = None):
+        self.registry = registry
+        self._forward = forward
+        self._buckets = tuple(sorted(int(b) for b in (buckets
+                                                      or SC.buckets())))
+        self._window_s = SC.batch_window_s() if window_s is None \
+            else float(window_s)
+        self._request_timeout_s = float(request_timeout_s)
+        self._rank = SC.serving_rank() if rank is None else int(rank)
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._closing = False
+        self._watch_thread: Optional[threading.Thread] = None
+
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (OSError, ValueError):
+                    pass
+
+            def _reply_text(self, text: str, code=200):
+                body = text.encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (OSError, ValueError):
+                    pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._reply_text(srv.metrics_text())
+                    return
+                if self.path == "/healthz":
+                    cur = srv.registry.current()
+                    self._reply({"ok": cur is not None,
+                                 "model_seq": None if cur is None
+                                 else cur.manifest_seq})
+                    return
+                self._reply({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply({"error": "not found"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    inputs = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": "bad json"}, 400)
+                    return
+                pending = srv._enqueue(inputs)
+                if not pending.event.wait(srv._request_timeout_s):
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": "timeout"}, 504)
+                    return
+                if pending.error is not None:
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": pending.error}, 503)
+                    return
+                _telemetry.inc("hvd_serving_requests_total")
+                _telemetry.observe("hvd_serving_request_seconds",
+                                   time.perf_counter() - pending.t0)
+                self._reply({"ok": True,
+                             "result": jsonable(pending.result),
+                             "model_seq": pending.model_seq})
+
+        self._server = ThreadingHTTPServer((bind_host, 0), Handler)
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever, name="hvd-serve-http",
+            daemon=True)
+        self._http_thread.start()
+        self._batch_thread = threading.Thread(
+            target=self._batch_loop, name="hvd-serve-batcher", daemon=True)
+        self._batch_thread.start()
+
+    # -- frontend helpers ----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def addr(self) -> str:
+        return f"{self._server.server_address[0]}:{self.port}"
+
+    def metrics_text(self) -> str:
+        snap = _telemetry.active().registry.export()
+        return _telemetry.render_prometheus({self._rank: snap})
+
+    def _enqueue(self, inputs: Any) -> _Pending:
+        pending = _Pending(inputs, time.perf_counter())
+        self._queue.put(pending)
+        _telemetry.set_gauge("hvd_serving_queue_depth",
+                             float(self._queue.qsize()))
+        return pending
+
+    # -- the batcher ---------------------------------------------------------
+
+    def _collect(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then coalesce arrivals within the
+        batching window, capped at the largest bucket."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return None
+        batch = [first]
+        cap = self._buckets[-1]
+        deadline = time.monotonic() + self._window_s
+        while len(batch) < cap:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _batch_loop(self) -> None:
+        while not self._closing:
+            batch = self._collect()
+            if batch is None:
+                continue
+            # One bucketed shape per batch: the jitted forward only ever
+            # compiles len(buckets) programs, whatever the traffic does.
+            padded = pad_to_bucket(len(batch), self._buckets)
+            cur = self.registry.current()
+            try:
+                if cur is None:
+                    raise RuntimeError("no model published yet")
+                outs = self._forward(cur.payload,
+                                     [p.inputs for p in batch], padded)
+                if len(outs) != len(batch):
+                    raise RuntimeError(
+                        f"forward returned {len(outs)} results for "
+                        f"{len(batch)} requests")
+            except Exception as err:    # noqa: BLE001 — per-batch containment
+                get_logger().error("serving batch failed: %s", err)
+                for p in batch:
+                    p.error = str(err)
+                    p.event.set()
+                continue
+            _telemetry.inc("hvd_serving_batches_total")
+            _telemetry.inc("hvd_serving_padded_examples_total",
+                           float(padded - len(batch)))
+            _telemetry.set_gauge("hvd_serving_queue_depth",
+                                 float(self._queue.qsize()))
+            stale = self.registry.staleness_s()
+            if stale is not None:
+                _telemetry.set_gauge("hvd_serving_staleness_seconds", stale)
+            for p, out in zip(batch, outs):
+                p.result = out
+                p.model_seq = cur.manifest_seq
+                p.event.set()
+
+    # -- publish watching ----------------------------------------------------
+
+    def start_watch(self, client=None, store=None,
+                    poll_s: Optional[float] = None) -> None:
+        """Spawn the discovery thread: coordinator long-poll when a
+        ``client`` (constructed with ``watch_publish=True``) is given,
+        pin-file store watch otherwise."""
+        poll = SC.serving_poll_s() if poll_s is None else float(poll_s)
+        long_poll = SC.serving_long_poll_s()
+
+        def _watch() -> None:
+            while not self._closing:
+                try:
+                    if client is not None:
+                        self.registry.poll_coordinator(client,
+                                                       wait=long_poll)
+                    else:
+                        self.registry.poll_store(store)
+                except Exception as err:  # noqa: BLE001 — keep watching
+                    get_logger().warning("publish watch round failed: %s",
+                                         err)
+                stale = self.registry.staleness_s()
+                if stale is not None:
+                    _telemetry.set_gauge("hvd_serving_staleness_seconds",
+                                         stale)
+                if client is None:
+                    time.sleep(poll)    # store watch has no long-poll park
+
+        self._watch_thread = threading.Thread(
+            target=_watch, name="hvd-serve-watch", daemon=True)
+        self._watch_thread.start()
+
+    def close(self) -> None:
+        self._closing = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._batch_thread.join(timeout=5)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)

@@ -1,0 +1,40 @@
+"""lint-recompile-in-request-path fixture: a serve loop draining a
+request queue and feeding the jitted forward whatever batch size
+happened to arrive — jit caches programs BY SHAPE, so every distinct
+size compiles a fresh program on the request path. Exactly ONE finding:
+the bucketed loop and the offline batch call below must stay clean.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def forward(params, batch):
+    return jnp.dot(batch, params)
+
+
+def pad_to_bucket(batch, buckets):
+    return batch  # stand-in for serving/server.py::pad_to_bucket
+
+
+def serve_unbucketed(params, request_queue):
+    while True:
+        batch = request_queue.get()
+        # Request-shaped input straight into jit: a new compile per
+        # distinct arrival count.
+        yield forward(params, batch)  # <- lint-recompile-in-request-path
+
+
+def serve_bucketed(params, request_queue, buckets):
+    # Clean: arrivals are padded into a fixed set of bucket shapes, so
+    # compiles are bounded by len(buckets).
+    while True:
+        batch = request_queue.get()
+        padded = pad_to_bucket(batch, buckets)
+        yield forward(params, padded)
+
+
+def evaluate_offline(params, batches):
+    # Clean: a fixed-shape offline loop is not a request path — nothing
+    # is drained from a queue.
+    return [forward(params, b) for b in batches]

@@ -211,6 +211,8 @@ LINT_CASES = [
     ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
     ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
     ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
+    ("bad_recompile_request_path.py", "lint-recompile-in-request-path",
+     "warning"),
 ]
 
 

@@ -198,6 +198,43 @@ def test_gc_never_drops_last_manifest(tmp_path):
     assert store.has_blob(digest)
 
 
+def test_gc_keeps_pinned_manifest_and_blobs_past_retention(tmp_path):
+    """Publish pins (serving plane) hold a manifest + its blobs no matter
+    how far HOROVOD_CHECKPOINT_KEEP has moved past it; unpinning releases
+    it to the next sweep."""
+    store = BlobStore(str(tmp_path / "cas"))
+    digests = []
+    for seq in range(1, 6):
+        digest, _ = store.put_blob(b"gen-%d" % seq)
+        digests.append(digest)
+        store.publish_manifest({"seq": seq, "skeleton": digest,
+                                "leaves": [[digest, 6]]})
+        time.sleep(0.02)    # distinct mtimes for the GC age guard
+    pin_path = store.pin_manifest(2, meta={"published": True,
+                                           "leaves_digest": "ab"})
+    assert os.path.exists(pin_path)
+    assert store.pinned_seqs() == [2]
+    assert store.read_pin(2)["leaves_digest"] == "ab"
+    stats = store.gc(1)
+    # pinned seq 2 + newest seq 5 survive; 1, 3, 4 are swept
+    assert store.manifest_seqs() == [2, 5]
+    assert stats["manifests_removed"] == 3
+    assert store.has_blob(digests[1]) and store.has_blob(digests[4])
+    # gen-1's blob predates every kept manifest: swept. gen-3/4 blobs are
+    # NEWER than pinned manifest 2, so the concurrent-writer age guard
+    # retains them (they go once the retention window moves on).
+    assert not store.has_blob(digests[0])
+    # pinned content is still verifiably readable (a serving process may
+    # be mid-delta-fetch against it)
+    assert store.get_blob(digests[1], verify=True) == b"gen-2"
+    # unpin -> swept by the next pass; double-unpin reports False
+    assert store.unpin_manifest(2) is True
+    assert store.unpin_manifest(2) is False
+    store.gc(1)
+    assert store.manifest_seqs() == [5]
+    assert not store.has_blob(digests[1])
+
+
 # --- torn commit (crash between blob write and manifest publish) ------------
 
 _TORN_WORKER = textwrap.dedent("""

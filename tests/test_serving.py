@@ -1,0 +1,434 @@
+"""Serving-plane unit tests (tier-1: injectable clocks, fake
+coordinators, no real sleeps, no jax device work on the hot assertions).
+
+Covers the publish gate (cadence, sentinel-dirty window, blob
+integrity), the registry (delta-fetch only changed digests, RCU swap
+leaving a concurrent reader on old weights, corrupt-publish rejection),
+the ``op:"publish"`` coordinator record (journal replay, crash-restart,
+frozen ``/world`` payload for training clients, long-poll wake), and the
+server's bucketed batching.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.checkpoint.store import BlobStore, blob_digest
+from horovod_tpu.core import telemetry as _telemetry
+from horovod_tpu.elastic import journal as _journal
+from horovod_tpu.elastic.service import (CoordinatorClient,
+                                         CoordinatorService, WORLD_KEYS)
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.runner import secret
+from horovod_tpu.serving import (InferenceServer, ModelRegistry, Publisher,
+                                 pad_to_bucket)
+from horovod_tpu.serving.publisher import leaves_digest
+
+
+class _Trainer:
+    """One reusable ObjectState (the commit seq is per-instance, and the
+    commit writer auto-GCs to HOROVOD_CHECKPOINT_KEEP=2 — tests publish
+    right after each commit, exactly like the attach() hook does)."""
+
+    def __init__(self, d, **attrs):
+        self.state = ObjectState(commit_dir=d, commit_async=False, **attrs)
+
+    def commit(self, **attrs):
+        for k, v in attrs.items():
+            setattr(self.state, k, v)
+        self.state.commit()
+        return self.state._commit_seq
+
+
+def _store(d):
+    return BlobStore(os.path.join(d, "cas"))
+
+
+# ------------------------------------------------------------ publisher
+
+
+def test_publish_gate_cadence(tmp_path):
+    d = str(tmp_path)
+    counters = {"steps_skipped": 0, "rollbacks": 0}
+    pub = Publisher(d, every=2, counters=lambda: dict(counters),
+                    clock=lambda: 1000.0)
+    trainer = _Trainer(d, w=np.float32(0))
+    recs = [pub.maybe_publish(trainer.commit(w=np.float32(seq)))
+            for seq in (1, 2, 3, 4)]
+    assert recs[0] is None                        # 1st of every-2: skip
+    assert recs[1] is not None and recs[1]["manifest_seq"] == 2
+    assert recs[1]["leaves_digest"] == leaves_digest(
+        pub.store.read_manifest(2))
+    assert recs[1]["time"] == 1000.0              # injectable clock
+    assert recs[2] is None
+    assert recs[3]["manifest_seq"] == 4
+    # pins: newest publish_keep (2) publish pins retained
+    assert pub.store.pinned_seqs() == [2, 4]
+
+
+def test_publish_gate_sentinel_dirty_window_blocks(tmp_path):
+    d = str(tmp_path)
+    counters = {"steps_skipped": 0, "rollbacks": 0}
+    pub = Publisher(d, every=1, counters=lambda: dict(counters))
+    trainer = _Trainer(d, w=np.float32(0))
+    assert pub.maybe_publish(
+        trainer.commit(w=np.float32(1))) is not None   # clean window
+    counters["steps_skipped"] += 1                # containment event
+    blocked_before = _telemetry.active().registry.counter_value(
+        "hvd_serving_publish_gate_blocked_total")
+    assert pub.maybe_publish(
+        trainer.commit(w=np.float32(2))) is None  # dirty window: blocked
+    assert _telemetry.active().registry.counter_value(
+        "hvd_serving_publish_gate_blocked_total") == blocked_before + 1
+    # window resets at the blocked candidate: next one is clean again
+    assert pub.maybe_publish(
+        trainer.commit(w=np.float32(3))) is not None
+    assert pub.last_published["manifest_seq"] == 3
+
+
+def test_publish_gate_blocks_on_corrupt_blob(tmp_path):
+    d = str(tmp_path)
+    _Trainer(d, w=np.float32(0)).commit(w=np.arange(4, dtype=np.float32))
+    store = _store(d)
+    manifest = store.read_manifest(1)
+    victim = store.blob_path(manifest["leaves"][0][0])
+    with open(victim, "r+b") as f:
+        f.write(b"\xff\xff")
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    assert pub.maybe_publish(1) is None           # integrity gate
+    assert store.pinned_seqs() == []              # nothing pinned
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_delta_fetch_only_changed_digests(tmp_path):
+    d = str(tmp_path)
+    frozen = np.arange(64, dtype=np.float32)      # unchanged across commits
+    trainer = _Trainer(d, w=np.float32(1), frozen=frozen)
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    reg = ModelRegistry(store=pub.store)
+    assert reg.adopt(pub.maybe_publish(trainer.commit())) is True
+    first_fetched = reg.stats["blobs_fetched"]
+    assert first_fetched > 0 and reg.stats["leaves_reused"] == 0
+    rec2 = pub.maybe_publish(trainer.commit(w=np.float32(2)))
+    m1, m2 = pub.store.read_manifest(1), pub.store.read_manifest(2)
+    changed = {e[0] for e in m2["leaves"]} - {e[0] for e in m1["leaves"]}
+    assert reg.adopt(rec2) is True
+    # only the CHANGED digests were fetched; the frozen leaf came from
+    # the leaf cache (the zero-copy half of the swap)
+    assert reg.stats["blobs_fetched"] - first_fetched == len(changed)
+    assert reg.stats["leaves_reused"] > 0
+    assert reg.current().manifest_seq == 2
+    assert reg.current().leaves_digest == rec2["leaves_digest"]
+
+
+def test_registry_rcu_swap_keeps_concurrent_reader_on_old_weights(tmp_path):
+    d = str(tmp_path)
+    trainer = _Trainer(d, w=np.float32(1.0))
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    reg = ModelRegistry(store=pub.store)
+    reg.adopt(pub.maybe_publish(trainer.commit()))
+    in_flight = reg.current()                     # request grabs a ref
+    old_payload = in_flight.payload
+    assert reg.adopt(
+        pub.maybe_publish(trainer.commit(w=np.float32(2.0)))) is True
+    # the in-flight request still sees generation 1, object-identical
+    assert in_flight.manifest_seq == 1
+    assert in_flight.payload is old_payload
+    assert float(in_flight.payload["attrs"]["w"]) == 1.0
+    # new requests see generation 2
+    assert reg.current().manifest_seq == 2
+    assert float(reg.current().payload["attrs"]["w"]) == 2.0
+
+
+def test_registry_rejects_corrupt_publish_and_keeps_previous(tmp_path):
+    d = str(tmp_path)
+    trainer = _Trainer(d, w=np.arange(8, dtype=np.float32))
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    reg = ModelRegistry(store=pub.store)
+    reg.adopt(pub.maybe_publish(trainer.commit()))
+    rec2 = pub.maybe_publish(                     # gate passes pre-corruption
+        trainer.commit(w=np.arange(8, dtype=np.float32) * 3))
+    m1 = pub.store.read_manifest(1)
+    m2 = pub.store.read_manifest(2)
+    changed = {e[0] for e in m2["leaves"]} - {e[0] for e in m1["leaves"]}
+    victim = pub.store.blob_path(sorted(changed)[0])
+    with open(victim, "r+b") as f:
+        f.write(b"\x00\x00\x00")                  # bit-flip AFTER publish
+    rejected_before = _telemetry.active().registry.counter_value(
+        "hvd_serving_rejected_total")
+    assert reg.adopt(rec2) is False
+    assert reg.current().manifest_seq == 1        # fallback: previous model
+    assert reg.stats["rejected"] == 1
+    assert _telemetry.active().registry.counter_value(
+        "hvd_serving_rejected_total") == rejected_before + 1
+
+
+def test_registry_rejects_leaves_digest_mismatch(tmp_path):
+    d = str(tmp_path)
+    _Trainer(d, w=np.float32(1.0)).commit()
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec = pub.maybe_publish(1)
+    rec["leaves_digest"] = "0" * 32               # tampered announcement
+    reg = ModelRegistry(store=pub.store)
+    assert reg.adopt(rec) is False
+    assert reg.current() is None
+    assert reg.stats["rejected"] == 1
+
+
+def test_registry_poll_coordinator_with_fake_client(tmp_path):
+    d = str(tmp_path)
+    _Trainer(d, w=np.float32(7.0)).commit()
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec = pub.maybe_publish(1)
+
+    class FakeClient:                              # no HTTP, no sleeps
+        def __init__(self):
+            self.publish_seq = 0
+            self.last_publish = None
+            self.waits = []
+
+        def get_world(self, wait=None):
+            self.waits.append(wait)
+            self.publish_seq = 1
+            self.last_publish = dict(rec)
+            return {}
+
+    client = FakeClient()
+    reg = ModelRegistry()
+    assert reg.poll_coordinator(client, wait=5.0) is True
+    assert client.waits == [5.0]
+    assert reg.current().manifest_seq == 1
+    # unchanged publish_seq on the next round: no re-adoption
+    assert reg.poll_coordinator(client) is False
+
+
+def test_registry_staleness_uses_injected_clock(tmp_path):
+    d = str(tmp_path)
+    _Trainer(d, w=np.float32(1.0)).commit()
+    pub = Publisher(d, every=1, clock=lambda: 100.0,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec = pub.maybe_publish(1)
+    now = {"t": 130.0}
+    reg = ModelRegistry(store=pub.store, clock=lambda: now["t"])
+    assert reg.staleness_s() is None              # pre-first-swap
+    reg.adopt(rec)
+    assert reg.staleness_s() == pytest.approx(30.0)
+    now["t"] = 145.0
+    assert reg.staleness_s() == pytest.approx(45.0)
+
+
+# -------------------------------------------- op:"publish" in the journal
+
+
+def test_journal_publish_record_replay_and_snapshot(tmp_path):
+    state = _journal.empty_state()
+    assert state["publish"] is None and state["publish_seq"] == 0
+    rec = {"manifest_seq": 5, "commit_dir": "/c", "leaves_digest": "ab"}
+    assert _journal.apply_record(state, {"op": "publish", "record": rec})
+    assert _journal.apply_record(
+        state, {"op": "publish",
+                "record": {**rec, "manifest_seq": 7}})
+    assert state["publish"]["manifest_seq"] == 7
+    assert state["publish_seq"] == 2
+    # snapshot roundtrip preserves both
+    snap = dict(state)
+    fresh = _journal.empty_state()
+    assert _journal.apply_record(fresh, {"op": "snapshot", "state": snap})
+    assert fresh["publish"]["manifest_seq"] == 7
+    assert fresh["publish_seq"] == 2
+
+
+def test_coordinator_publish_journaled_across_crash_restart(tmp_path):
+    key = secret.make_secret_key()
+    jp = str(tmp_path / "coord.journal")
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=jp)
+    try:
+        svc.update_world({"localhost": 1}, 1)
+        client = CoordinatorClient(svc.addr("127.0.0.1"), key)
+        rec = {"manifest_seq": 3, "step": 3, "commit_dir": "/c",
+               "cas": "/c/cas", "time": 1.0, "leaves_digest": "ff",
+               "published": True}
+        assert client.announce_publish(rec) is True
+        assert svc.publish_snapshot() == (1, rec)
+    finally:
+        svc.simulate_crash()
+    svc2 = CoordinatorService(key, bind_host="127.0.0.1",
+                              journal_path=jp, restore=True)
+    try:
+        seq, restored = svc2.publish_snapshot()
+        assert seq == 1 and restored["manifest_seq"] == 3
+        # version/failure_seq untouched by the publish
+        assert svc2.version == 1 and svc2.failure_seq == 0
+    finally:
+        svc2.close()
+
+
+def test_world_payload_frozen_for_training_clients():
+    key = secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"localhost": 2}, 2)
+        trainer = CoordinatorClient(svc.addr("127.0.0.1"), key)
+        svc._record_publish({"record": {"manifest_seq": 1,
+                                        "commit_dir": "/c"}})
+        world = trainer.get_world()
+        assert sorted(world.keys()) == sorted(WORLD_KEYS)
+        # a publish does not move the training delta cursor: next poll is
+        # a not-modified, not a delta
+        again = trainer.get_world()
+        assert again == world
+    finally:
+        svc.close()
+
+
+def test_publish_wakes_parked_long_poll():
+    key = secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"localhost": 1}, 1)
+        watcher = CoordinatorClient(svc.addr("127.0.0.1"), key,
+                                    watch_publish=True)
+        assert watcher.get_world() is not None    # cursor established
+        assert watcher.last_publish is None
+        woke = threading.Event()
+
+        def park():
+            watcher.get_world(wait=30)
+            woke.set()
+
+        t = threading.Thread(target=park, daemon=True)
+        t.start()
+        rec = {"manifest_seq": 9, "commit_dir": "/c", "published": True}
+        svc._record_publish({"record": rec})
+        assert woke.wait(timeout=10), \
+            "publish did not wake the parked long-poll"
+        t.join(timeout=5)
+        assert watcher.publish_seq == 1
+        assert watcher.last_publish["manifest_seq"] == 9
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------- server
+
+
+def test_pad_to_bucket():
+    buckets = (1, 2, 4, 8)
+    assert pad_to_bucket(1, buckets) == 1
+    assert pad_to_bucket(3, buckets) == 4
+    assert pad_to_bucket(8, buckets) == 8
+    assert pad_to_bucket(99, buckets) == 8        # capped at largest
+
+
+def test_server_buckets_batches_and_serves_hot_swap(tmp_path):
+    d = str(tmp_path)
+    trainer = _Trainer(d, w=np.float32(10.0))
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec1 = pub.maybe_publish(trainer.commit())
+    rec2 = pub.maybe_publish(trainer.commit(w=np.float32(20.0)))
+    reg = ModelRegistry(store=pub.store)
+    reg.adopt(rec1)
+    seen_batches = []
+
+    def forward(payload, inputs, padded_n):
+        seen_batches.append((len(inputs), padded_n))
+        w = float(payload["attrs"]["w"])
+        return [float(q["x"]) * w for q in inputs]
+
+    srv = InferenceServer(reg, forward, buckets=(1, 2, 4),
+                          window_s=0.01, request_timeout_s=10.0)
+    try:
+        def predict(x):
+            body = json.dumps({"x": x}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://{srv.addr()}/predict", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=10) as r:
+                return json.loads(r.read())
+
+        out = predict(3.0)
+        assert out["ok"] and out["result"] == 30.0 and out["model_seq"] == 1
+        # hot swap mid-serve: no restart, next request sees new weights
+        assert reg.adopt(rec2) is True
+        out = predict(3.0)
+        assert out["ok"] and out["result"] == 60.0 and out["model_seq"] == 2
+        # every batch the forward saw was padded to a configured bucket
+        assert all(p in (1, 2, 4) and n <= p for n, p in seen_batches)
+        # health + metrics surfaces
+        with urllib.request.urlopen(f"http://{srv.addr()}/healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["ok"] and health["model_seq"] == 2
+        with urllib.request.urlopen(f"http://{srv.addr()}/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert "hvd_serving_requests_total" in text
+        assert "hvd_serving_swaps_total" in text
+    finally:
+        srv.close()
+
+
+def test_server_errors_contained_when_no_model_published():
+    reg = ModelRegistry()
+    srv = InferenceServer(reg, lambda payload, inputs, n: [],
+                          window_s=0.0, request_timeout_s=10.0)
+    try:
+        body = json.dumps({"x": 1.0}).encode()
+        req = urllib.request.Request(
+            f"http://{srv.addr()}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["ok"] is False
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------- GC pin interaction
+
+
+def test_gc_respects_publish_pins(tmp_path):
+    d = str(tmp_path)
+    store = _store(d)
+    trainer = _Trainer(d, w=np.arange(16, dtype=np.float32))
+    pub = Publisher(d, every=1, keep=2,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    assert pub.maybe_publish(trainer.commit()) is not None   # pins seq 1
+    m1_digests = {e[0] for e in store.read_manifest(1)["leaves"]}
+    # the commit writer auto-GCs to HOROVOD_CHECKPOINT_KEEP=2 after every
+    # commit: four more commits push the retention window far past seq 1
+    for seq in range(2, 6):
+        trainer.commit(w=np.arange(16, dtype=np.float32) + seq)
+    # ... but the publish pin holds manifest 1 and its blobs
+    assert store.read_manifest(1) is not None
+    for digest in m1_digests:
+        assert store.has_blob(digest)
+    # unpinned mid-history manifests WERE swept by the same passes
+    assert store.read_manifest(2) is None
+    assert store.read_manifest(3) is None
+    # an explicit deep sweep still honors the pin
+    store.gc(1)
+    assert store.read_manifest(1) is not None
+    assert store.read_manifest(4) is None
+    # unpin -> the next sweep takes it
+    assert store.unpin_manifest(1) is True
+    store.gc(1)
+    assert store.read_manifest(1) is None
+    assert store.read_manifest(5) is not None     # newest always kept

@@ -1,0 +1,73 @@
+"""Serving-plane guardrails over benchmarks/serving.py.
+
+Same contract as tests/test_control_plane_guardrail.py: the COMMITTED
+history record (benchmarks/serving_history.jsonl) must stay inside the
+rails — a frozen-leaf hot-swap strictly cheaper than an all-leaves swap
+(the CAS delta-fetch acceptance), zero requests dropped across ≥2 swaps,
+and commit→served staleness bounded under the commit cadence — so a
+regression in the publisher, registry delta-fetch, or RCU swap fails
+tier-1 without re-running the harness. The harness itself runs in the
+chaos tier via the slow-marked smoke below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "serving.py")
+HISTORY = os.path.join(REPO, "benchmarks", "serving_history.jsonl")
+
+
+def _run(args, timeout):
+    env = dict(os.environ, HOROVOD_SERVING_NO_HISTORY="1")
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    return subprocess.run([sys.executable, BENCH, *args],
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+
+
+def test_history_record_is_complete():
+    """The committed record carries everything --check pins, with the
+    noise band STATED (CLAUDE.md: a ratio without its spread is noise)."""
+    with open(HISTORY, encoding="utf-8") as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    recs = [r for r in recs if r.get("bench") == "serving"]
+    assert recs, "no serving records committed"
+    rec = recs[-1]
+    assert rec["noise"]["rounds"] >= 2
+    for k in ("ratio_min", "ratio_max", "spread"):
+        assert k in rec["noise"]
+    for k in ("swap_ratio", "adopt_s", "blobs_fetched_per_swap",
+              "leaves_reused_per_swap", "traffic", "staleness"):
+        assert k in rec, f"history record missing {k}"
+    assert rec["traffic"]["dropped"] == 0
+    assert rec["traffic"]["failed"] == 0
+    assert rec["traffic"]["swaps_during"] >= 2
+    assert rec.get("date") and rec.get("git")
+
+
+def test_recorded_series_inside_rails():
+    """Fast tier-1 guardrail: run the harness's own --check validator
+    against the committed series."""
+    p = _run(["--check"], timeout=60)
+    out = (p.stdout.strip().splitlines() or ["{}"])[-1]
+    verdict = json.loads(out)
+    assert p.returncode == 0 and verdict.get("ok"), (verdict, p.stderr)
+
+
+@pytest.mark.slow
+def test_swap_smoke_in_budget():
+    """Chaos tier: one shrunk all/frozen round pair plus live traffic
+    across 2 hot-swaps, all inside a fixed budget (subprocess timeout is
+    the budget); the frozen arm must fetch fewer blobs."""
+    p = _run(["--smoke", "8"], timeout=180)
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    res = json.loads(p.stdout.strip().splitlines()[-1])
+    assert res["traffic"]["dropped"] == 0
+    assert res["traffic"]["failed"] == 0
+    assert res["frozen"]["blobs_fetched_per_swap"] \
+        < res["all"]["blobs_fetched_per_swap"]
