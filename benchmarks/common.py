@@ -42,22 +42,13 @@ def on_tpu() -> bool:
 
 
 def peak_flops(device=None) -> float:
-    """Per-chip bf16 peak FLOP/s by device kind (public TPU spec sheet);
-    NaN when unknown (CPU, unrecognized kinds) — callers omit MFU then."""
+    """Per-chip bf16 peak FLOP/s by device kind; NaN when unknown (CPU,
+    unrecognized kinds) — callers omit MFU then. Delegates to the ONE
+    spec table in ``horovod_tpu.tools.perf`` (shared with the live
+    ``hvd_step_mfu_proxy`` gauge and the attribution records)."""
+    from horovod_tpu.tools.perf import device_peak_flops
     device = device if device is not None else jax.devices()[0]
-    kind = getattr(device, "device_kind", "").lower()
-    table = [
-        ("v6", 918e12), ("trillium", 918e12),
-        ("v5p", 459e12),
-        ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
-        ("v4", 275e12),
-        ("v3", 123e12),
-        ("v2", 45e12),
-    ]
-    for key, val in table:
-        if key in kind:
-            return val
-    return float("nan")
+    return device_peak_flops(device)
 
 
 def sync(x) -> None:

@@ -2,6 +2,12 @@
 with XLA cost-analysis FLOPs and MFU. Not part of the bench contract —
 exploration tool behind VERDICT r1 "report and raise ResNet-50 MFU".
 
+ISSUE 11: FLOPs route through the shared ``horovod_tpu.tools.perf``
+helper (same accounting as the live ``hvd_step_mfu_proxy`` gauge) and
+each batch point appends a ``perf_probe`` record to
+``benchmarks/perf_history.jsonl`` so `tools.perf show` sees probe MFU
+next to the attribution budgets.
+
 Usage (real chip): python benchmarks/mfu_probe.py [batch ...]
 """
 
@@ -23,6 +29,7 @@ def main():
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet50
     from horovod_tpu.optimizer import distributed
+    from horovod_tpu.tools import perf
     from horovod_tpu.train import create_train_state, make_train_step
 
     hvd.init()
@@ -54,12 +61,14 @@ def main():
                 if not hasattr(fn, "lower") else fn.lower(state0, images, labels)
             compiled = lowered.compile()
             if k == S_LONG:
-                try:
-                    ca = compiled.cost_analysis()
-                    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-                    flops_per_step = float(ca.get("flops", float("nan"))) / k
-                except Exception as e:
-                    print("  cost_analysis unavailable:", e, flush=True)
+                # shared FLOPs accounting (feeds the hvd_step_mfu_proxy
+                # gauge when a monitored step runs this program)
+                flops_per_step = perf.step_flops(compiled, steps=k)
+                if flops_per_step is None:
+                    print("  cost_analysis unavailable", flush=True)
+                else:
+                    perf.register_step_flops(flops_per_step,
+                                             what="train_step")
             steps[k] = compiled
 
         def run(k, _s=steps, _st=state0, _x=images, _y=labels):
@@ -70,11 +79,25 @@ def main():
                                    return_rounds=True)
         ips = batch / sec["m"]
         line = f"batch {batch:4d}: {ips:8.1f} img/s  step {sec['m']*1e3:7.2f} ms"
+        record = {"kind": "perf_probe", "metric": "resnet50_mfu_probe",
+                  "model": "resnet50", "batch": batch,
+                  "img_per_s": round(ips, 1),
+                  "wall_s_per_step": round(sec["m"], 6)}
         if flops_per_step and np.isfinite(flops_per_step):
-            mfu = flops_per_step / sec["m"] / peak_flops(dev)
-            line += (f"  xla_flops/img {flops_per_step/batch/1e9:.2f} G"
-                     f"  MFU {100*mfu:.1f}%")
+            peak = peak_flops(dev)
+            record["flops_per_step"] = flops_per_step
+            record["achieved_tflops"] = round(
+                flops_per_step / sec["m"] / 1e12, 3)
+            if np.isfinite(peak):
+                mfu = flops_per_step / sec["m"] / peak
+                record["mfu"] = round(mfu, 4)
+                record["peak_tflops"] = round(peak / 1e12, 1)
+                line += (f"  xla_flops/img {flops_per_step/batch/1e9:.2f} G"
+                         f"  MFU {100*mfu:.1f}%")
         print(line, flush=True)
+        path = perf.append_history(record)
+        if path:
+            print(f"  appended probe record to {path}", flush=True)
 
 
 if __name__ == "__main__":

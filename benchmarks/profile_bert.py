@@ -5,7 +5,9 @@ Mixtral/DLRM/Llama r4, BERT r4): attributes leaf-op time for the
 `benchmarks/bert.py` TPU config — flash-attention kernels vs matmul
 fusions vs the vocab-table (embedding + AdamW) traffic vs the MLM
 head/loss path, with the bf16-compressed fused gradient allreduce
-machinery active exactly as the bench runs it.
+machinery active exactly as the bench runs it. Harness boilerplate lives
+in ``profiling_common`` (ISSUE 11), which also appends the step-time
+budget record to ``benchmarks/perf_history.jsonl``.
 
 Usage (real chip):  python benchmarks/profile_bert.py [per_chip_batch]
 """
@@ -13,20 +15,19 @@ Usage (real chip):  python benchmarks/profile_bert.py [per_chip_batch]
 import os
 import re
 import sys
-import tempfile
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import (collective_overlap, make_categorize,  # noqa: E402
-                   parse_xplane, report)
+from profiling_common import (STEPS, compiled_step_flops,  # noqa: E402
+                              ensure_cpu_op_events, profile_and_report)
 
-STEPS = 8  # one scan: enough occurrences to average per-op time
+ensure_cpu_op_events()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 
 def main():
@@ -65,19 +66,11 @@ def main():
     # donate (like profile_llama): two resident 24L AdamW states OOM the chip
     step = make_train_step(model, dopt, loss_fn, scan_steps=STEPS,
                            donate=True)
+    flops = compiled_step_flops(step, STEPS, state, tokens, labels)
     # warm/compile outside the trace
     state, loss = step(state, tokens, labels)
     np.asarray(loss)
 
-    logdir = tempfile.mkdtemp(prefix="bert_xplane_")
-    with jax.profiler.trace(logdir):
-        state, loss = step(state, tokens, labels)
-        np.asarray(loss)
-
-    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
-    if not totals:
-        print(f"no device events; planes seen: {planes}")
-        return
     V, D = cfg.vocab_size, cfg.dim
     extra = [
         ("flash-attn(pallas)", re.compile(r"_fa_call|_fa_bwd|_fa_fwd")),
@@ -89,11 +82,15 @@ def main():
             rf"\[{V},{D}\]|\[{D},{V}\]")),
         ("mlm-head/loss", re.compile(rf",{V}\]|\[{V},")),
     ]
-    report(f"bert_profile_b{per_chip}", totals, counts, wall_ps,
-           async_ps, STEPS,
-           categorize=make_categorize(extra),
-           extra_json={"batch": batch, "seq": seq},
-           overlap=collective_overlap(logdir))
+
+    def traced():
+        out_state, loss = step(state, tokens, labels)
+        np.asarray(loss)
+
+    profile_and_report(f"bert_profile_b{per_chip}", "bert_large", traced,
+                       steps=STEPS, extra_categories=extra,
+                       extra_json={"batch": batch, "seq": seq},
+                       flops_per_step=flops)
 
 
 if __name__ == "__main__":

@@ -4,7 +4,9 @@ VERDICT r3 #9: the "embedding-bound by design" claim behind DLRM's
 examples/sec lens (docs/benchmarks.md) was profile-free. This captures
 an xplane trace of the exact `benchmarks/dlrm.py` TPU config's step and
 attributes leaf-op time: embedding gathers/scatter-grads vs dense MLPs
-vs the pairwise interaction vs the Adagrad update.
+vs the pairwise interaction vs the Adagrad update. Harness boilerplate
+lives in ``profiling_common`` (ISSUE 11), which also appends the
+step-time budget record to ``benchmarks/perf_history.jsonl``.
 
 Usage (real chip):  python benchmarks/profile_dlrm.py [per_chip_batch]
 """
@@ -12,20 +14,19 @@ Usage (real chip):  python benchmarks/profile_dlrm.py [per_chip_batch]
 import os
 import re
 import sys
-import tempfile
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import (collective_overlap, make_categorize,  # noqa: E402
-                   parse_xplane, report)
+from profiling_common import (STEPS, ensure_cpu_op_events,  # noqa: E402
+                              profile_and_report)
 
-STEPS = 8
+ensure_cpu_op_events()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 
 def main():
@@ -36,6 +37,7 @@ def main():
     from horovod_tpu.models.dlrm import DLRM, bce_loss, dlrm_criteo
     from horovod_tpu.models.llama import LOGICAL_RULES
     from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.tools import perf
     from horovod_tpu.train import rules_for_mesh
 
     hvd.init()
@@ -97,18 +99,15 @@ def main():
             return out[2]
 
     np.asarray(once())  # compile outside the trace
+    # One step == one jitted call on both paths; cost analysis straight
+    # off the already-compiled executable (no .lower handle on `once`).
+    flops = None
+    try:
+        lowered = jitted.lower(*state, dense, sparse, labels)
+        flops = perf.step_flops(lowered.compile(), steps=1)
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e}", flush=True)
 
-    logdir = tempfile.mkdtemp(prefix="dlrm_xplane_")
-    with jax.profiler.trace(logdir):
-        loss = None
-        for _ in range(STEPS):
-            loss = once()
-        np.asarray(loss)
-
-    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
-    if not totals:
-        print(f"no device events; planes seen: {planes}")
-        return
     # Shape-based attribution: embedding tables are [rows_per_table, dim]
     # (gather fwd / scatter-add grads / adagrad over table-shaped state);
     # the interaction output is [B, F*F or F*(F-1)/2]-ish; MLPs are
@@ -121,12 +120,19 @@ def main():
                                                rf"\[{flat},{Dm}\]")),
         ("mlp(batch-dots)", re.compile(rf"convolution|^%?dot")),
     ]
-    report(f"dlrm_profile_b{per_chip}", totals, counts, wall_ps,
-           async_ps, STEPS,
-           categorize=make_categorize(extra),
-           extra_json={"batch": B, "tables": cfg.num_tables,
-                       "rows": R, "embed_dim": Dm},
-           overlap=collective_overlap(logdir))
+
+    def traced():
+        loss = None
+        for _ in range(STEPS):
+            loss = once()
+        np.asarray(loss)
+
+    model_name = "dlrm_criteo" if sparse_path else "dlrm_criteo_dense"
+    profile_and_report(f"dlrm_profile_b{per_chip}", model_name, traced,
+                       steps=STEPS, extra_categories=extra,
+                       extra_json={"batch": B, "tables": cfg.num_tables,
+                                   "rows": R, "embed_dim": Dm},
+                       flops_per_step=flops)
 
 
 if __name__ == "__main__":

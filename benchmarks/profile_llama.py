@@ -3,7 +3,9 @@
 Completes the per-BASELINE-config profiler set (ResNet r3, Mixtral/DLRM
 r4): attributes leaf-op time for the `benchmarks/llama.py` TPU config —
 flash-attention kernels vs matmul fusions vs the AdamW update vs the
-LM-head/loss path.
+LM-head/loss path. Harness boilerplate lives in ``profiling_common``
+(ISSUE 11), which also appends the step-time budget record to
+``benchmarks/perf_history.jsonl``.
 
 Usage (real chip):  python benchmarks/profile_llama.py [per_chip_batch]
 """
@@ -11,20 +13,20 @@ Usage (real chip):  python benchmarks/profile_llama.py [per_chip_batch]
 import os
 import re
 import sys
-import tempfile
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-from xprof import (collective_overlap, make_categorize,  # noqa: E402
-                   parse_xplane, report)
+from profiling_common import (STEPS, compiled_step_flops,  # noqa: E402
+                              ensure_cpu_op_events, make_categorize,
+                              profile_and_report)
 
-STEPS = 8
+ensure_cpu_op_events()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 
 def main():
@@ -60,19 +62,10 @@ def main():
     # donate (unlike profile_resnet): two resident 24L states OOM the chip
     step = make_gspmd_train_step(model, opt, mesh, LOGICAL_RULES,
                                  donate=True)
+    flops = compiled_step_flops(step, 1, state, tokens)
     state, loss = step(state, tokens)
     np.asarray(loss)
 
-    logdir = tempfile.mkdtemp(prefix="llama_xplane_")
-    with jax.profiler.trace(logdir):
-        for _ in range(STEPS):
-            state, loss = step(state, tokens)
-        np.asarray(loss)
-
-    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
-    if not totals:
-        print(f"no device events; planes seen: {planes}")
-        return
     V, D = cfg.vocab_size, cfg.dim
     extra = [
         ("flash-attn(pallas)", re.compile(r"_fa_call|_fa_bwd|_fa_fwd")),
@@ -85,10 +78,21 @@ def main():
         ("lm-head/loss", re.compile(rf",{V}\]|\[{V},")),
     ]
     cat = make_categorize(extra)
-    report(f"llama_profile_b{per_chip}", totals, counts, wall_ps,
-           async_ps, STEPS, categorize=cat,
-           extra_json={"batch": batch, "seq": seq},
-           overlap=collective_overlap(logdir))
+
+    def traced():
+        nonlocal state
+        loss = None
+        for _ in range(STEPS):
+            state, loss = step(state, tokens)
+        np.asarray(loss)
+
+    res = profile_and_report(f"llama_profile_b{per_chip}", "llama_1b",
+                             traced, steps=STEPS, extra_categories=extra,
+                             extra_json={"batch": batch, "seq": seq},
+                             flops_per_step=flops)
+    totals, counts = res["totals"], res["counts"]
+    if not totals:
+        return
 
     # r5 (VERDICT r4 #3): NAME the gather/scatter slice — dump the top
     # instructions in that category with enough of the instruction text

@@ -12,6 +12,9 @@ output shapes in the HLO instruction text:
   [E, C, M]   = expert w1/w3/w2 matmuls           (M=1792)
   [T, E] / [T, E*k] = router logits/probs
 
+Harness boilerplate lives in ``profiling_common`` (ISSUE 11), which also
+appends the step-time budget record to ``benchmarks/perf_history.jsonl``.
+
 Usage (real chip):  python benchmarks/profile_mixtral.py [per_chip_batch]
 Artifacts: the docs/benchmarks.md Mixtral table comes from this output.
 """
@@ -19,20 +22,19 @@ Artifacts: the docs/benchmarks.md Mixtral table comes from this output.
 import os
 import re
 import sys
-import tempfile
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))  # repo root (horovod_tpu pkg)
 sys.path.insert(0, _here)
-from xprof import (collective_overlap, make_categorize,  # noqa: E402
-                   parse_xplane, report)
+from profiling_common import (STEPS, compiled_step_flops,  # noqa: E402
+                              ensure_cpu_op_events, profile_and_report)
 
-STEPS = 8
+ensure_cpu_op_events()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
 
 def main():
@@ -88,6 +90,11 @@ def main():
     else:
         raise SystemExit(f"unknown MIXTRAL_PROFILE_OPT={variant!r} "
                          "(use 'adamw' or 'deferred2')")
+    # FLOPs for the plain (apply) program — for deferred2 the per-step
+    # average differs; skip cost analysis there rather than overstate.
+    flops = None
+    if variant == "adamw":
+        flops = compiled_step_flops(step, 1, state, tokens)
     if variant == "deferred2":
         state, loss = step(state, tokens)   # warm both programs
         for _ in range(3):
@@ -97,19 +104,6 @@ def main():
         _, loss = step(state, tokens)  # warm/compile outside the trace
         np.asarray(loss)
 
-    logdir = tempfile.mkdtemp(prefix="mixtral_xplane_")
-    with jax.profiler.trace(logdir):
-        for _ in range(STEPS):
-            if variant == "deferred2":
-                state, loss = step(state, tokens)
-            else:
-                state2, loss = step(state, tokens)
-        np.asarray(loss)
-
-    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
-    if not totals:
-        print(f"no device events; planes seen: {planes}")
-        return
     # Shape-based attribution for the MoE layer at THIS config:
     # C = capacity, M = hidden. Matched against full instruction text.
     C = max(1, int(cfg.capacity_factor * cfg.top_k * batch * seq
@@ -121,11 +115,24 @@ def main():
         ("moe:dispatch/combine", re.compile(
             rf"\[{E},{C},{D}\]|\[{C},{D}\]|,{E},{C}\]")),
     ]
-    report(f"mixtral_profile_b{per_chip}", totals, counts, wall_ps,
-           async_ps, STEPS,
-           categorize=make_categorize(extra),
-           extra_json={"batch": batch, "seq": seq, "capacity": C},
-           overlap=collective_overlap(logdir))
+
+    def traced():
+        nonlocal state
+        loss = None
+        for _ in range(STEPS):
+            if variant == "deferred2":
+                state, loss = step(state, tokens)
+            else:
+                state2, loss = step(state, tokens)
+        np.asarray(loss)
+
+    model_name = ("mixtral_bench_deferred2" if variant == "deferred2"
+                  else "mixtral_bench")
+    profile_and_report(f"mixtral_profile_b{per_chip}", model_name, traced,
+                       steps=STEPS, extra_categories=extra,
+                       extra_json={"batch": batch, "seq": seq,
+                                   "capacity": C},
+                       flops_per_step=flops)
 
 
 if __name__ == "__main__":

@@ -2,14 +2,10 @@
 
 VERDICT r2 weak #1 / next #3: the "conv-shape bound" MFU claim needs an
 op-level time breakdown, not an assertion. This captures a jax.profiler
-xplane trace of the jitted train step, parses it with the xplane proto
-TF ships (``tensorflow.tsl.profiler.protobuf.xplane_pb2``), aggregates
-device-plane event durations by HLO op category, and prints:
-
-  - the top-K ops by total device time (name, category, time, share)
-  - a category rollup (convolution / fusion / all-reduce / copy / other)
-  - the overlap fraction: share of collective time hidden behind compute
-    (``xprof.collective_overlap`` — the ISSUE 6 metric)
+xplane trace of the jitted train step and hands it to the shared
+profiling harness (``profiling_common.profile_and_report``): top-K op
+table, category rollup, overlap fraction, and the ISSUE 11 step-time
+budget record appended to ``benchmarks/perf_history.jsonl``.
 
 Usage (real chip):  python benchmarks/profile_resnet.py [batch]
 
@@ -17,7 +13,10 @@ On the 8-device CPU mesh the script instead runs the bucketed-vs-
 monolithic overlap A/B (docs/fusion.md): the same DP train step traced
 twice — once with one uncapped fused gradient allreduce, once with
 reverse-layer buckets via ``fusion_threshold_override`` — printing both
-overlap fractions. Scheduled bucketing must RAISE the fraction:
+overlap fractions. Scheduled bucketing must RAISE the fraction. The
+bucketed arm's trace also yields the CPU-mesh attribution record that
+``tests/test_perf_guardrail.py`` rails (categories sum to host-lane wall
+within 5%) without a real TPU:
 
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
     python benchmarks/profile_resnet.py [batch]
@@ -25,20 +24,18 @@ overlap fractions. Scheduled bucketing must RAISE the fraction:
 Artifacts: docs/benchmarks.md table is generated from this output.
 """
 
-import collections
 import json
 import os
 import sys
-import tempfile
 
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)
-# Shared xplane parsing (r4): one parser for all profilers — the
-# device-plane layout notes live in xprof.py's docstring. CPU op events
-# need the thunk-runtime flag armed BEFORE jax parses XLA_FLAGS.
-from xprof import (collective_overlap, ensure_cpu_op_events,  # noqa: E402
-                   make_categorize, parse_xplane, short_name)
+# Shared harness (r4 parser + ISSUE 11 budgets). CPU op events need the
+# thunk-runtime flag armed BEFORE jax parses XLA_FLAGS.
+from profiling_common import (STEPS, collective_overlap,  # noqa: E402
+                              compiled_step_flops, ensure_cpu_op_events,
+                              profile_and_report, step_budget)
 
 ensure_cpu_op_events()
 
@@ -46,9 +43,7 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
-from common import peak_flops  # noqa: E402  (pins jax_platforms=cpu too)
-
-STEPS = 8  # one scan: enough occurrences to average per-op time
+import tempfile  # noqa: E402
 
 #: Bucket size for the CPU-mesh A/B's bucketed arm. ResNet-50 carries
 #: ~100 MB of f32 grads; 4 MB → ~25 reverse-layer buckets, enough for the
@@ -56,7 +51,9 @@ STEPS = 8  # one scan: enough occurrences to average per-op time
 #: 8-process rendezvous in tiny collectives.
 CPU_AB_BUCKET_BYTES = 4 * 1024 * 1024
 
-categorize = make_categorize()
+#: Steps traced per arm in the CPU A/B (kept small: 8 concurrent device
+#: programs on shared host cores).
+CPU_AB_STEPS = 2
 
 
 def _build(batch):
@@ -80,13 +77,21 @@ def _build(batch):
 
 
 def _cpu_overlap_ab(batch):
-    """Bucketed-vs-monolithic overlap A/B on the virtual-device CPU mesh."""
+    """Bucketed-vs-monolithic overlap A/B on the virtual-device CPU mesh.
+
+    The bucketed arm's trace doubles as the CPU-mesh attribution record
+    (tests/test_perf_guardrail.py): budget categories summed over the
+    host thunk lanes, flops from cost analysis, appended to the perf
+    history unless HOROVOD_PERF_NO_HISTORY."""
     from horovod_tpu.collectives.ops import fusion_threshold_override
+    from horovod_tpu.tools import perf
     from horovod_tpu.train import make_train_step
 
     model, dopt, loss_fn, state0, images, labels = _build(batch)
     arms = [("monolithic", 1 << 62), ("bucketed", CPU_AB_BUCKET_BYTES)]
     results = {}
+    bucketed_logdir = None
+    bucketed_step = None
     for name, thr in arms:
         # Fresh step per arm: the threshold is baked in at trace time.
         step = make_train_step(model, dopt, loss_fn, donate=False)
@@ -95,11 +100,13 @@ def _cpu_overlap_ab(batch):
             np.asarray(loss)
             logdir = tempfile.mkdtemp(prefix=f"resnet_ovl_{name}_")
             with jax.profiler.trace(logdir):
-                for _ in range(2):
+                for _ in range(CPU_AB_STEPS):
                     _, loss = step(state0, images, labels)
                     np.asarray(loss)
         ovl = collective_overlap(logdir)
         results[name] = ovl
+        if name == "bucketed":
+            bucketed_logdir, bucketed_step = logdir, step
         print(f"{name:11s} overlap_fraction="
               f"{ovl['overlap_fraction']}  "
               f"(hidden {ovl['hidden_ms']:.1f} / "
@@ -115,6 +122,19 @@ def _cpu_overlap_ab(batch):
         out["overlap_gain"] = round(buck - mono, 4)
         print(f"overlap gain (bucketed - monolithic): {buck - mono:+.3f}")
     print("\n" + json.dumps(out))
+
+    # ISSUE 11: attribution record from the bucketed (bench-config) arm.
+    flops = compiled_step_flops(bucketed_step, 1, state0, images, labels)
+    record = step_budget(bucketed_logdir, CPU_AB_STEPS,
+                         model="resnet50_cpu8",
+                         metric="resnet50_cpu_budget",
+                         flops_per_step=flops,
+                         extra={"batch": batch,
+                                "bucket_bytes": CPU_AB_BUCKET_BYTES})
+    perf.print_budget(record)
+    path = perf.append_history(record)
+    if path:
+        print(f"appended budget record to {path}")
 
 
 def main():
@@ -140,53 +160,15 @@ def main():
     # warm/compile outside the trace
     _, loss = step(state0, images, labels)
     np.asarray(loss)
+    flops = compiled_step_flops(step, STEPS, state0, images, labels)
 
-    logdir = tempfile.mkdtemp(prefix="resnet_xplane_")
-    with jax.profiler.trace(logdir):
+    def traced():
         _, loss = step(state0, images, labels)
         np.asarray(loss)
 
-    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
-    if not totals:
-        print(f"no device events; planes seen: {planes}")
-        return
-    overlap = collective_overlap(logdir)
-    grand = sum(totals.values())
-    print(f"module wall: {wall_ps/1e9:.1f} ms / {STEPS} steps = "
-          f"{wall_ps/1e9/STEPS:.2f} ms/step; leaf-op occupancy "
-          f"{grand/1e9:.1f} ms ({grand/max(wall_ps,1):.0%}); async DMA "
-          f"span-sum {async_ps/1e9:.1f} ms (overlap, not occupancy)")
-    if overlap["overlap_fraction"] is not None:
-        print(f"overlap fraction: {overlap['overlap_fraction']:.3f} "
-              f"({overlap['hidden_ms']:.1f} of "
-              f"{overlap['collective_ms']:.1f} ms collective hidden)")
-    print(f"\n{'op':<52} {'category':<20} {'ms':>8} {'share':>7} {'n':>5}")
-    rows = []
-    for name, ps in totals.most_common(25):
-        cat = categorize(name)
-        sn = short_name(name)
-        rows.append({"op": sn, "category": cat,
-                     "ms": round(ps / 1e9, 3),
-                     "share": round(ps / grand, 4),
-                     "n": counts[name]})
-        print(f"{sn[:52]:<52} {cat:<20} {ps/1e9:>8.3f} {ps/grand:>6.1%} "
-              f"{counts[name]:>5}")
-    roll = collections.Counter()
-    for name, ps in totals.items():
-        roll[categorize(name)] += ps
-    print("\ncategory rollup:")
-    for cat, ps in roll.most_common():
-        print(f"  {cat:<20} {ps/1e9:>9.3f} ms  {ps/grand:>6.1%}")
-    peak = peak_flops()
-    out = {"metric": "resnet50_profile", "batch": batch,
-           "wall_ms_per_step": round(wall_ps / 1e9 / STEPS, 3),
-           "occupancy_ms_per_step": round(grand / 1e9 / STEPS, 3),
-           "categories": {c: round(p / grand, 4) for c, p in roll.items()},
-           "overlap": overlap,
-           "top": rows[:10]}
-    if np.isfinite(peak):
-        out["peak_tflops"] = round(peak / 1e12, 1)
-    print("\n" + json.dumps(out))
+    profile_and_report("resnet50_profile", "resnet50", traced,
+                       steps=STEPS, extra_json={"batch": batch},
+                       flops_per_step=flops)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Shared harness for the per-BASELINE-config profilers (ISSUE 11).
+
+Every ``profile_*.py`` used to repeat the same boilerplate: trace-dir
+setup, ``jax.profiler.trace``, plane walk, report call. That lives here
+now — each profile script keeps only its model-specific setup and hands
+:func:`profile_and_report` a thunk that runs the traced steps. On top of
+the r4 op-occupancy table, every profile also emits the ISSUE 11
+step-time budget record (``horovod_tpu.tools.perf``) and appends it to
+``benchmarks/perf_history.jsonl`` — the series ``tools.perf check``
+ratchets (docs/profiling.md).
+
+Import order matters (CLAUDE.md): call :func:`ensure_cpu_op_events`
+before the first jax backend touch so CPU-mesh runs carry per-op thunk
+events.
+"""
+
+import os
+import sys
+import tempfile
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+from xprof import (collective_overlap, ensure_cpu_op_events,  # noqa: E402,F401
+                   make_categorize, parse_xplane, report, short_name,
+                   step_budget)
+
+#: One scan/trace window: enough op occurrences to average per-op time.
+STEPS = 8
+
+
+def profile_and_report(metric, model, trace_fn, *, steps=STEPS,
+                       extra_categories=(), extra_json=None,
+                       flops_per_step=None, append_history=True):
+    """Trace ``trace_fn`` into a fresh logdir, print the op table +
+    budget, append the attribution record to the perf history.
+
+    ``trace_fn()`` must run exactly ``steps`` already-compiled train
+    steps and end in a host sync (compile BEFORE calling — compilation
+    inside the trace would be attributed as step time). Returns
+    ``{"record", "totals", "counts", "planes", "wall_ps", "async_ps",
+    "overlap", "logdir"}``; ``totals`` is empty off-TPU (the op table is
+    device-plane only) while the budget record also understands the CPU
+    host plane's thunk lanes.
+    """
+    import jax
+    from horovod_tpu.tools import perf
+
+    logdir = tempfile.mkdtemp(prefix=f"{metric}_xplane_")
+    with jax.profiler.trace(logdir):
+        trace_fn()
+
+    totals, counts, planes, wall_ps, async_ps = parse_xplane(logdir)
+    overlap = collective_overlap(logdir)
+    if totals:
+        report(metric, totals, counts, wall_ps, async_ps, steps,
+               categorize=make_categorize(extra_categories),
+               extra_json=extra_json, overlap=overlap)
+    else:
+        print(f"no TPU device events (op table skipped); planes seen: "
+              f"{planes}")
+
+    record = step_budget(logdir, steps, model=model, metric=f"{metric}_budget",
+                         flops_per_step=flops_per_step, extra=extra_json)
+    if record["wall_s_per_step"] > 0:
+        perf.print_budget(record)
+        if append_history:
+            path = perf.append_history(record)
+            if path:
+                print(f"appended budget record to {path}")
+    else:
+        print("no device/host op lanes in the trace — budget record "
+              "not recorded")
+    return {"record": record, "totals": totals, "counts": counts,
+            "planes": planes, "wall_ps": wall_ps, "async_ps": async_ps,
+            "overlap": overlap, "logdir": logdir}
+
+
+def compiled_step_flops(step, steps, *args, **kwargs):
+    """FLOPs/step via the shared cost-analysis helper, from a step
+    factory product carrying ``.lower`` (make_train_step & friends) or a
+    plain jittable. None when the backend has no cost analysis."""
+    import jax
+
+    from horovod_tpu.tools import perf
+    try:
+        lowered = step.lower(*args, **kwargs) if hasattr(step, "lower") \
+            else jax.jit(step).lower(*args, **kwargs)
+        return perf.step_flops(lowered.compile(), steps=steps)
+    except Exception as e:  # cost analysis is best-effort everywhere
+        print(f"cost_analysis unavailable: {e}", flush=True)
+        return None

@@ -16,7 +16,6 @@ regex) pairs matched against the instruction text, e.g. to tell a
 expert matmul.
 """
 
-import bisect
 import collections
 import glob
 import json
@@ -83,10 +82,15 @@ def parse_xplane(logdir):
         meta = plane.event_metadata
         for line in plane.lines:
             if line.name == "Async XLA Ops":
-                async_total += sum(ev.duration_ps for ev in line.events)
+                # Overlapped DMA windows tallied SEPARATELY — reported as
+                # overlap, never added into occupancy (CLAUDE.md trap).
+                async_total += sum(  # hvd-analyze: ok — overlap, not occupancy
+                    ev.duration_ps for ev in line.events)
                 continue
             if line.name == "XLA Modules":
-                wall_ps += sum(ev.duration_ps for ev in line.events)
+                # Module wall, not occupancy — umbrella filtering is moot.
+                wall_ps += sum(  # hvd-analyze: ok — wall, not occupancy
+                    ev.duration_ps for ev in line.events)
             if line.name != "XLA Ops":
                 continue
             for ev in line.events:
@@ -110,28 +114,25 @@ _UMBRELLAS = ("while", "tuple.", "jit_")
 
 
 def _merge(intervals):
-    """Sorted union of (start, end) intervals."""
-    intervals.sort()
-    merged = []
-    for s, e in intervals:
-        if merged and s <= merged[-1][1]:
-            merged[-1][1] = max(merged[-1][1], e)
-        else:
-            merged.append([s, e])
-    return merged
+    """Sorted union of (start, end) intervals (shared attribution core —
+    lazy import keeps this module importable before the backend is up)."""
+    from horovod_tpu.tools.perf import merge_intervals
+    return merge_intervals(intervals)
 
 
 def _hidden_ps(collective, compute_union):
     """Σ over collective intervals of their intersection with the union."""
-    starts = [m[0] for m in compute_union]
-    hidden = 0
-    for s, e in collective:
-        i = max(bisect.bisect_right(starts, s) - 1, 0)
-        while i < len(compute_union) and compute_union[i][0] < e:
-            hidden += max(
-                0, min(e, compute_union[i][1]) - max(s, compute_union[i][0]))
-            i += 1
-    return hidden
+    from horovod_tpu.tools.perf import intersect_ps
+    return intersect_ps(collective, compute_union)
+
+
+def step_budget(logdir, steps, **kw):
+    """Step-time budget record for the newest trace under ``logdir`` —
+    the ISSUE 11 attribution core (``horovod_tpu.tools.perf``): disjoint
+    occupancy categories + host gap that sum to device wall, per-category
+    top ops, optional MFU. See docs/profiling.md."""
+    from horovod_tpu.tools.perf import attribute_logdir
+    return attribute_logdir(logdir, steps, **kw)
 
 
 def _plane_op_intervals(plane):

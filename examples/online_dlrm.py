@@ -68,7 +68,8 @@ def train(args):
             return bce_loss(model.apply({"params": p}, d, s), y)
         loss, grads = jax.value_and_grad(loss_of)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        return optax.apply_updates(  # hvd-analyze: ok — demo loop
+            params, updates), opt_state, loss
 
     state = ObjectState(commit_dir=args.commit_dir, params=params,
                         opt_state=opt_state, step=0)

@@ -88,6 +88,17 @@ CLAUDE.md "Environment traps"):
   LIVE arrays and let the writer fetch them off-thread; fetch host
   copies yourself only outside the step loop.
 
+- ``lint-xplane-umbrella`` (WARNING): an xplane walk that accumulates
+  ``ev.duration_ps`` over a ``.events`` line with no umbrella filtering
+  in sight.  Two traps hide here (CLAUDE.md): ``%while``/``tuple.``/
+  ``jit_`` events are scan/module *umbrellas* whose spans cover their
+  leaf children — summing them double counts the step; and the "Async
+  XLA Ops" line carries overlapped DMA *windows*, not occupancy — adding
+  it to device-busy time invents throughput.  Route xplane parsing
+  through the vetted parsers (``benchmarks/xprof.py``,
+  ``horovod_tpu.tools.perf``), filter on ``UMBRELLA_PREFIXES``, or
+  pragma a span-sum that is deliberately a wall/overlap figure.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -157,6 +168,40 @@ REQUEST_DRAIN_NAMES = frozenset({"get_nowait", "recv", "recv_json",
                                  "accept"})
 REQUEST_DRAIN_GENERIC = frozenset({"get"})
 REQUEST_RECEIVER_TOKENS = ("queue", "request", "req", "inbox", "pending")
+
+
+# lint-xplane-umbrella vocabulary: the umbrella prefixes whose presence
+# as string constants counts as filtering evidence (mirrors
+# tools/perf.py UMBRELLA_PREFIXES — kept literal here so the lint stays
+# import-free), plus the attribute accumulated.
+XPLANE_UMBRELLA_STRINGS = frozenset({"while", "tuple.", "jit_"})
+XPLANE_DURATION_ATTR = "duration_ps"
+
+
+def _xplane_filter_evidence(node) -> bool:
+    """True when a subtree shows awareness of the umbrella trap: an
+    umbrella-prefix string constant, or any name/attribute mentioning
+    'umbrella' (the shared ``UMBRELLA_PREFIXES`` table)."""
+    for sub in ast.walk(node):
+        s = _const_str(sub)
+        if s is not None and s in XPLANE_UMBRELLA_STRINGS:
+            return True
+        tok = sub.attr if isinstance(sub, ast.Attribute) else (
+            sub.id if isinstance(sub, ast.Name) else None)
+        if tok is not None and "umbrella" in tok.lower():
+            return True
+    return False
+
+
+def _iters_events(node) -> bool:
+    name = _dotted(node)
+    return name == "events" or name.endswith(".events")
+
+
+def _has_duration_attr(node) -> bool:
+    return any(isinstance(sub, ast.Attribute)
+               and sub.attr == XPLANE_DURATION_ATTR
+               for sub in ast.walk(node))
 
 
 def _is_request_drain(name: str) -> bool:
@@ -272,6 +317,9 @@ class _Lint(ast.NodeVisitor):
         # lint-blocking-telemetry: loop nesting (a "step loop" is any
         # for/while the record call sits inside).
         self._loop_depth = 0
+        # lint-xplane-umbrella: duration accumulations already attributed
+        # to an enclosing events loop (nested walks must not re-flag).
+        self._xplane_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -424,6 +472,27 @@ class _Lint(ast.NodeVisitor):
                     "anyway (docs/telemetry.md overhead contract)",
                     {"fetches": fetches})
 
+        # lint-xplane-umbrella (genexp form): sum(ev.duration_ps for ev
+        # in line.events) with no umbrella-filter evidence inside the
+        # comprehension — counts scan/module umbrella spans (and the
+        # Async-ops overlap windows) as occupancy.
+        if name == "sum" and node.args \
+                and isinstance(node.args[0], ast.GeneratorExp):
+            gen = node.args[0]
+            if gen.generators and _iters_events(gen.generators[0].iter) \
+                    and _has_duration_attr(gen) \
+                    and not _xplane_filter_evidence(gen):
+                self._add(
+                    "lint-xplane-umbrella", Severity.WARNING, node,
+                    "xplane duration_ps summed over a raw .events line "
+                    "with no umbrella filtering: %while/tuple./jit_ "
+                    "events are scan/module umbrellas covering their "
+                    "children (double counts the step), and 'Async XLA "
+                    "Ops' spans are overlap windows, not occupancy — "
+                    "use the vetted parsers (benchmarks/xprof.py, "
+                    "tools/perf.py), filter on UMBRELLA_PREFIXES, or "
+                    "pragma a deliberate wall/overlap sum")
+
         if name.endswith("slope_time_paired"):
             windows = []
             for arg in node.args[1:3]:
@@ -498,9 +567,40 @@ class _Lint(ast.NodeVisitor):
                 "pad_to_bucket, HOROVOD_SERVING_BUCKETS) so compiles are "
                 "bounded by configuration, not traffic — docs/serving.md")
 
+    def _check_xplane_umbrella(self, node):
+        """lint-xplane-umbrella (loop form): ``for ev in <line>.events``
+        accumulating ``ev.duration_ps`` (AugAssign +=) with no umbrella
+        filtering anywhere in the loop. Plain Assigns stay clean so the
+        interval-building idiom (``iv = (ev.offset_ps, ...)``) is not
+        flagged — intervals feed overlap math, not occupancy."""
+        if not _iters_events(node.iter):
+            return
+        sites = [sub for sub in ast.walk(node)
+                 if isinstance(sub, ast.AugAssign)
+                 and isinstance(sub.op, ast.Add)
+                 and _has_duration_attr(sub.value)
+                 and id(sub) not in self._xplane_handled]
+        if not sites:
+            return
+        evidence = _xplane_filter_evidence(node)
+        for sub in sites:
+            self._xplane_handled.add(id(sub))
+            if not evidence:
+                self._add(
+                    "lint-xplane-umbrella", Severity.WARNING, sub,
+                    "xplane duration_ps accumulated over a raw .events "
+                    "loop with no umbrella filtering: %while/tuple./jit_ "
+                    "events are scan/module umbrellas covering their "
+                    "children (double counts the step), and 'Async XLA "
+                    "Ops' spans are overlap windows, not occupancy — "
+                    "use the vetted parsers (benchmarks/xprof.py, "
+                    "tools/perf.py), filter on UMBRELLA_PREFIXES, or "
+                    "pragma a deliberate wall/overlap sum")
+
     def visit_For(self, node):
         self._check_blocking_commit(node)
         self._check_recompile_request_path(node)
+        self._check_xplane_umbrella(node)
         self._loop_depth += 1
         self.generic_visit(node)
         self._loop_depth -= 1

@@ -222,12 +222,17 @@ class Registry:
 def render_prometheus(per_rank: Dict[Any, Dict[str, Dict[str, float]]]) -> str:
     """Prometheus text exposition from per-rank compact snapshots.
 
-    Per-rank samples get a ``rank`` label injected; the fleet rollup
-    (counters summed across ranks) is emitted with no ``rank`` label.
+    Per-rank samples get a ``rank`` label injected; the fleet rollup is
+    emitted with no ``rank`` label — counters SUMMED across ranks, gauges
+    AVERAGED (a sum of per-rank ``hvd_step_mfu_proxy``/wall gauges would
+    be meaningless; the across-rank mean is the fleet MFU-proxy the
+    coordinator dashboard wants — ISSUE 11).
     """
     lines: List[str] = []
     typed: set = set()
     rollup: Dict[str, float] = {}
+    g_sum: Dict[str, float] = {}
+    g_n: Dict[str, int] = {}
 
     def _emit(sid: str, value: float, kind: str) -> None:
         name = sid.partition("{")[0]
@@ -246,8 +251,12 @@ def render_prometheus(per_rank: Dict[Any, Dict[str, Dict[str, float]]]) -> str:
             rollup[sid] = rollup.get(sid, 0.0) + v
         for sid, v in sorted(snap.get("g", {}).items()):
             _emit(inject_label(sid, "rank", rank), v, "gauge")
+            g_sum[sid] = g_sum.get(sid, 0.0) + v
+            g_n[sid] = g_n.get(sid, 0) + 1
     for sid, v in sorted(rollup.items()):
         _emit(sid, v, "counter")
+    for sid, v in sorted(g_sum.items()):
+        _emit(sid, v / g_n[sid], "gauge")
     return "\n".join(lines) + "\n"
 
 

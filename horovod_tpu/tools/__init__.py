@@ -2,6 +2,9 @@
 
 - :class:`Timeline` — host-side Chrome-trace writer (HOROVOD_TIMELINE).
 - :mod:`profiler` — device-side xplane traces (jax.profiler wrappers).
+- :mod:`perf` — step-time budgets from xplane traces, the per-model MFU
+  ratchet over ``benchmarks/perf_history.jsonl``, and regression diffs
+  (``python -m horovod_tpu.tools.perf`` — docs/profiling.md).
 - :class:`StallInspector` — step-progress watchdog (HOROVOD_STALL_CHECK_*).
 - :class:`MismatchDetector` — debug cross-process collective-signature
   check (HOROVOD_MISMATCH_CHECK).
@@ -9,7 +12,7 @@
   knobs (HOROVOD_AUTOTUNE_LOG), reference parameter_manager parity.
 """
 
-from . import profiler
+from . import perf, profiler
 from .autotune import (Autotuner, CatDim, Dim, GaussianProcess, IntDim,
                        LogIntDim, StepAutotuner, expected_improvement)
 from .mismatch import MismatchDetector, MismatchError, detector, maybe_record
@@ -20,4 +23,4 @@ __all__ = ["Autotuner", "CatDim", "Dim", "GaussianProcess", "IntDim", "StepAutot
            "LogIntDim", "MismatchDetector", "MismatchError",
            "StallInspector", "Timeline", "detector",
            "expected_improvement", "maybe_record", "merge_chrome_traces",
-           "profiler"]
+           "perf", "profiler"]

@@ -13,6 +13,7 @@ as optax transforms wrapped by ``horovod_tpu.optimizer.distributed``.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -32,6 +33,37 @@ from .core.watchdog import monitored_step
 from .collectives import ops as _ops
 from .collectives.ops import effective_axis_size, force_axis_size1
 from .optimizer import broadcast_parameters
+
+
+#: Opt-in: AOT-compile the step once on first call to read XLA
+#: cost-analysis FLOPs and feed the live ``hvd_step_mfu_proxy`` gauge.
+#: Off by default — the extra compile costs minutes on big models;
+#: benches register FLOPs explicitly via ``tools.perf``.
+STEP_COST_ANALYSIS_ENV = "HOROVOD_STEP_COST_ANALYSIS"
+
+
+def _maybe_register_step_flops(lower, what, steps, args, kwargs):
+    """First-call hook behind ``HOROVOD_STEP_COST_ANALYSIS``: compile the
+    step's AOT lowering, read cost-analysis FLOPs via the shared
+    ``tools.perf`` accounting, and register them so the watchdog's
+    ``_note_step_done`` can export the MFU proxy every step. Best-effort:
+    any failure (no cost analysis on this backend, donation/lowering
+    mismatch) is logged and skipped, never raised into the step."""
+    if os.environ.get(STEP_COST_ANALYSIS_ENV, "").lower() \
+            not in ("1", "true"):
+        return
+    from .core.logging import get_logger
+    from .tools import perf
+    try:
+        compiled = lower(*args, **kwargs).compile()
+        flops = perf.step_flops(compiled, steps=steps)
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        get_logger().debug("step cost analysis unavailable: %s", e)
+        return
+    if flops:
+        perf.register_step_flops(flops, what=what)
+        get_logger().info("registered %s cost-analysis FLOPs/step: %.3e",
+                          what, flops)
 
 
 class TrainState(NamedTuple):
@@ -232,7 +264,13 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
         probe = make_sharded_step(apply_update=False)
         dispatch = _sentinel_dispatch(sentinel, jitted, probe)
 
+    _flops_hook = []  # once-latch for the opt-in cost-analysis hook
+
     def marked(*args, **kwargs):
+        if not _flops_hook:
+            _flops_hook.append(True)
+            _maybe_register_step_flops(jitted.lower, "train_step",
+                                       scan_steps or 1, args, kwargs)
         # Per-step host-side timeline record (the reference's MARK_CYCLES):
         # dispatch span + cycle marker; device phases live in the
         # jax.profiler xplane (tools/profiler.py merges both views). The
@@ -554,7 +592,13 @@ def make_gspmd_train_step(model, optimizer, mesh, rules, *,
         probe = make_step(apply_update=False)
         inner = _sentinel_dispatch(sentinel, jitted, probe)
 
+    _flops_hook = []  # once-latch for the opt-in cost-analysis hook
+
     def run(state, tokens):
+        if not _flops_hook:
+            _flops_hook.append(True)
+            _maybe_register_step_flops(lower, "gspmd_train_step", 1,
+                                       (state, tokens), {})
         with jax.sharding.set_mesh(mesh):
             return inner(state, tokens)
 

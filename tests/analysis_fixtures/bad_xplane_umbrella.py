@@ -1,0 +1,28 @@
+"""lint-xplane-umbrella fixture: a naive xplane walk that sums every
+event's duration_ps straight off the line — %while/tuple./jit_ umbrella
+spans cover their leaf children, so the total double counts the step
+(and an "Async XLA Ops" line summed this way books overlap windows as
+occupancy). Exactly ONE finding: the vetted walk below, which filters on
+the shared umbrella-prefix table, must stay clean.
+"""
+
+
+def naive_device_seconds(plane):
+    total = 0
+    for line in plane.lines:
+        for ev in line.events:
+            total += ev.duration_ps  # <- lint-xplane-umbrella
+    return total / 1e12
+
+
+def vetted_device_seconds(plane, meta, umbrella_prefixes):
+    total = 0
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            name = meta[ev.metadata_id].lstrip("%")
+            if name.startswith(umbrella_prefixes):
+                continue
+            total += ev.duration_ps
+    return total / 1e12

@@ -213,6 +213,7 @@ LINT_CASES = [
     ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
     ("bad_recompile_request_path.py", "lint-recompile-in-request-path",
      "warning"),
+    ("bad_xplane_umbrella.py", "lint-xplane-umbrella", "warning"),
 ]
 
 

@@ -26,6 +26,7 @@ def test_scaling_guardrail_emits_sane_efficiency():
     # CI runs must not pollute the committed round-over-round series —
     # the driver's per-round invocation (no env) is the one that records.
     env["HOROVOD_SCALING_NO_HISTORY"] = "1"
+    env["HOROVOD_PERF_NO_HISTORY"] = "1"
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "scaling.py")],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
@@ -69,3 +70,11 @@ def test_scaling_guardrail_emits_sane_efficiency():
     frac = recs["dp8_overlap_fraction"]["value"]
     assert frac is None or 0.0 <= frac <= 1.0, frac
     assert "overlap" in recs["dp8_overlap_fraction"]
+    # The step-time budget record (ISSUE 11, docs/profiling.md) rides the
+    # overlap trace: categories must sum to the host-lane wall.
+    from horovod_tpu.tools import perf
+    budget = recs.get("dp8_step_budget")
+    assert budget is not None and budget["kind"] == "perf_budget"
+    assert budget["sum_check"]["rel_err"] <= perf.SUM_TOLERANCE, budget
+    for key in perf.BUDGET_KEYS:
+        assert key in budget["budget_s_per_step"], key
