@@ -34,6 +34,12 @@ Arms:
 - ``gspmd8`` tiny-Llama ``make_gspmd_train_step`` on a dp=8 GSPMD mesh
              (the path all r4 perf work rides; XLA inserts the grad
              allreduce from shardings) vs a plain local-grad Llama step.
+- ``accum8`` the dp8 step with ``accum_steps=4`` (ISSUE 12: in-graph
+             microbatch gradient accumulation, one allreduce per applied
+             step) vs the plain dp8 step — guards the accumulation
+             loop's sequencing overhead round-over-round. Emitted as
+             ``dp8_accum4_step_ratio`` (NOT an efficiency: its ideal is
+             not 1.0, so the efficiency hard rails don't apply).
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      python benchmarks/scaling.py
@@ -90,7 +96,7 @@ def _resnet_arms(hvd, rng, loss_fn):
     images = jnp.asarray(rng.randn(batch, 32, 32, 3).astype(np.float32))
     labels = jnp.asarray(rng.randint(0, 100, size=(batch,)))
 
-    def build_dist(mesh, axis_name):
+    def build_dist(mesh, axis_name, accum_steps=1):
         model = ResNetTiny(num_classes=100, dtype=jnp.float32,
                            axis_name=axis_name)
         # axis_name EXPLICIT everywhere: the jitted steps trace lazily at
@@ -102,7 +108,8 @@ def _resnet_arms(hvd, rng, loss_fn):
                                    dopt)
         steps = {k: make_train_step(model, dopt, loss_fn, mesh=mesh,
                                     axis_name=axis_name,
-                                    scan_steps=k, donate=False)
+                                    scan_steps=k, donate=False,
+                                    accum_steps=accum_steps)
                  for k in (S_SHORT, S_LONG)}
 
         def run(k):
@@ -157,6 +164,12 @@ def _resnet_arms(hvd, rng, loss_fn):
 
     mesh8 = hvd.mesh()
     run_dp = build_dist(mesh8, hvd.RANK_AXIS)
+    # ISSUE 12 accum arm: the SAME dp8 step with accum_steps=4 — the
+    # per-device batch of 8 is walked as 4 microbatches of 2 with grads
+    # accumulated in-graph and ONE allreduce per applied step
+    # (train/step_builder.py::accumulate_gradients). Ratio vs the plain
+    # dp8 arm guards the accumulation loop's sequencing overhead.
+    run_accum = build_dist(mesh8, hvd.RANK_AXIS, accum_steps=4)
     run_plain = build_plain(mesh8)
 
     # Hierarchical variant: same step over a 2x4 cross/intra mesh with
@@ -168,7 +181,7 @@ def _resnet_arms(hvd, rng, loss_fn):
         np.asarray(jax.devices()).reshape(2, n // 2), ("cross", "intra"))
     hvd.init(mesh=mesh_h, config=Config(hierarchical_allreduce=True))
     run_hier = build_dist(mesh_h, ("cross", "intra"))
-    return run_dp, run_hier, run_plain
+    return run_dp, run_hier, run_accum, run_plain
 
 
 def _llama_arms(rng):
@@ -242,19 +255,20 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 
-    run_dp, run_hier, run_plain = _resnet_arms(hvd, rng, loss_fn)
+    run_dp, run_hier, run_accum, run_plain = _resnet_arms(hvd, rng, loss_fn)
     run_gspmd, run_lplain = _llama_arms(rng)
 
     # Interleaved per-round ratios (common.py): every arm runs both scan
     # lengths each round, so host drift and contention land on all arms
     # equally; plain/dist on the SAME mesh makes ideal exactly 1.0.
     sec, rounds = slope_time_paired(
-        {"dp8": run_dp, "hier8": run_hier, "plain8": run_plain,
-         "gspmd8": run_gspmd, "lplain8": run_lplain},
+        {"dp8": run_dp, "hier8": run_hier, "accum8": run_accum,
+         "plain8": run_plain, "gspmd8": run_gspmd, "lplain8": run_lplain},
         S_SHORT, S_LONG, return_rounds=True)
     eff = median_ratio(rounds, "plain8", "dp8")
     eff_h = median_ratio(rounds, "plain8", "hier8")
     eff_g = median_ratio(rounds, "lplain8", "gspmd8")
+    eff_a = median_ratio(rounds, "dp8", "accum8")
 
     rec = {
         "metric": "dp8_virtual_scaling_efficiency",
@@ -280,6 +294,19 @@ def main():
         "vs_baseline": round(eff_g, 4),
         "noise": _ratio_stats(rounds, "lplain8", "gspmd8"),
     }
+    # NOT named *_scaling_efficiency on purpose: the accum arm walks the
+    # batch as 4 sequential microbatches, so its ideal is NOT 1.0 and the
+    # efficiency hard rails don't apply — the guardrail pins presence and
+    # a loose sanity band instead (tests/test_scaling_guardrail.py).
+    rec_a = {
+        "metric": "dp8_accum4_step_ratio",
+        "value": round(eff_a, 4),
+        "unit": f"t_dp8/t_accum4, same mesh/model/batch, accum_steps=4 "
+                f"microbatches of {LOCAL_BATCH // 4}/dev; <1 = "
+                "accumulation sequencing overhead",
+        "vs_baseline": round(eff_a, 4),
+        "noise": _ratio_stats(rounds, "dp8", "accum8"),
+    }
     # Overlap fraction of the dp8 arm's collectives (the ISSUE 6 metric,
     # docs/fusion.md): recorded alongside the efficiency series so a
     # scheduling regression (bucketed overlap collapsing toward 0) is
@@ -298,11 +325,11 @@ def main():
                 "dp8 scan; docs/fusion.md",
         "overlap": ovl,
     }
-    for r in (rec, rec_h, rec_g, rec_o):
+    for r in (rec, rec_h, rec_g, rec_a, rec_o):
         print(json.dumps(r))
     if os.environ.get("HOROVOD_SCALING_NO_HISTORY", "").lower() \
             not in ("1", "true"):
-        _append_history([rec, rec_h, rec_g, rec_o])
+        _append_history([rec, rec_h, rec_g, rec_a, rec_o])
 
     # ISSUE 11: the same dp8 trace also yields a step-time budget record
     # (categories summed over the host thunk lanes; sums to wall by

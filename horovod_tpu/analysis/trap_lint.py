@@ -99,6 +99,17 @@ CLAUDE.md "Environment traps"):
   ``horovod_tpu.tools.perf``), filter on ``UMBRELLA_PREFIXES``, or
   pragma a span-sum that is deliberately a wall/overlap figure.
 
+- ``lint-accum-psum-order`` (WARNING): a ``lax.scan``/``lax.fori_loop``
+  body that both computes gradients (``value_and_grad``/``grad``) and
+  reduces them across the mesh (``psum``/``pmean``) — the microbatch
+  accumulation loop reducing INSIDE the loop body.  With
+  ``accum_steps=n`` that is n collectives per step instead of one: n×
+  the wire bytes for a mathematically identical result (psum is linear,
+  so summing locally and reducing once after the loop commutes).
+  Accumulate on-replica and let the single post-loop update carry the
+  one allreduce — ``train/step_builder.py::accumulate_gradients`` is
+  the reference shape.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -135,6 +146,11 @@ GUARD_TOKENS = frozenset({
 # lint-monolithic-psum vocabulary: the per-leaf mesh reductions whose
 # tree-mapped form forfeits the fused/bucketed collective path.
 LEAF_REDUCE_NAMES = frozenset({"psum", "pmean"})
+
+# lint-accum-psum-order vocabulary: the loop combinators whose body is a
+# candidate microbatch accumulation loop (positional index of the body
+# callable in each call's args).
+ACCUM_LOOP_BODY_ARG = {"scan": 0, "fori_loop": 2}
 
 # lint-unbounded-poll vocabulary: the coordinator poll, and the calls
 # that count as pacing a poll loop (a sleep, a condition/event wait, or
@@ -314,6 +330,11 @@ class _Lint(ast.NodeVisitor):
         # already attributed to an enclosing serve loop.
         self._jit_names: set = set()
         self._recompile_handled: set = set()
+        # lint-accum-psum-order: function defs by name (prescanned, so a
+        # scan body passed as a named function resolves regardless of
+        # definition order), and reduce sites already flagged.
+        self._funcdefs: dict = {}
+        self._accum_handled: set = set()
         # lint-blocking-telemetry: loop nesting (a "step loop" is any
         # for/while the record call sits inside).
         self._loop_depth = 0
@@ -388,6 +409,7 @@ class _Lint(ast.NodeVisitor):
                     if isinstance(tgt, ast.Name):
                         self._jit_names.add(tgt.id)
             elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._funcdefs.setdefault(sub.name, sub)
                 for dec in sub.decorator_list:
                     d = dec.func if isinstance(dec, ast.Call) else dec
                     if _dotted(d).split(".")[-1] == "jit":
@@ -451,6 +473,8 @@ class _Lint(ast.NodeVisitor):
                 if kw.arg == "every" and isinstance(kw.value, ast.Constant) \
                         and isinstance(kw.value.value, int):
                     self.cadences.append(kw.value.value)
+
+        self._check_accum_psum_order(node, name)
 
         if self._loop_depth > 0 and _is_telemetry_record(name):
             fetches = [
@@ -720,6 +744,46 @@ class _Lint(ast.NodeVisitor):
                     "allreduce spreads it to every replica); guard with "
                     "core/sentinel.py's health_vector or jnp.isfinite, "
                     "or pragma a deliberate throwaway loop")
+
+    def _check_accum_psum_order(self, node, name):
+        """lint-accum-psum-order: a scan/fori_loop body that both computes
+        gradients and mesh-reduces them — n collectives per step where the
+        post-loop update needs only one (psum is linear; reduce AFTER the
+        accumulation loop, as in train/step_builder.py's
+        accumulate_gradients)."""
+        last = name.split(".")[-1]
+        body_idx = ACCUM_LOOP_BODY_ARG.get(last)
+        if body_idx is None or len(node.args) <= body_idx:
+            return
+        body = node.args[body_idx]
+        if isinstance(body, ast.Name):
+            body = self._funcdefs.get(body.id)
+        if not isinstance(body, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            return
+        sites, has_grad = [], False
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            sub_last = _dotted(sub.func).split(".")[-1]
+            if sub_last in GRAD_CALL_NAMES:
+                has_grad = True
+            elif sub_last in LEAF_REDUCE_NAMES \
+                    and id(sub) not in self._accum_handled:
+                sites.append(sub)
+        if not sites or not has_grad:
+            return  # reduce-only loops (stat sync) judged elsewhere
+        for call in sites:
+            self._accum_handled.add(id(call))
+            self._add(
+                "lint-accum-psum-order", Severity.WARNING, call,
+                f"psum/pmean inside a {last} body that also computes "
+                "gradients: a microbatch accumulation loop reducing "
+                "INSIDE the loop pays one collective per microbatch — "
+                "n× the wire bytes of the identical result from "
+                "accumulating on-replica and reducing once after the "
+                "loop (psum is linear; see "
+                "train/step_builder.py::accumulate_gradients)")
 
     def _check_monolithic_psum(self, node):
         """lint-monolithic-psum: a gradient-computing step reducing its

@@ -726,7 +726,8 @@ def monitored_step(fn: Callable, what: str = "train_step") -> Callable:
     def wrapped(*args, **kwargs):
         return monitor().monitored_call(lambda: fn(*args, **kwargs),
                                         what=what)
-    for attr in ("lower", "chosen", "lower_probe", "sentinel"):
+    for attr in ("lower", "chosen", "lower_probe", "lower_apply",
+                 "lower_skip", "sentinel"):
         if hasattr(fn, attr):
             setattr(wrapped, attr, getattr(fn, attr))
     return wrapped

@@ -151,6 +151,20 @@ def _remat(cls, policy_name: str):
                     policy=_REMAT_POLICIES[policy_name])
 
 
+def with_remat_policy(c: "LlamaConfig", policy: str) -> "LlamaConfig":
+    """``c`` with its remat arm set by ONE name — the vocabulary the
+    compute-tier sweep (benchmarks/remat_sweep.py) enumerates. ``"none"``
+    disables remat entirely (save every residual — the fastest arm
+    whenever the activations fit); any ``_REMAT_POLICIES`` key enables
+    remat under that checkpoint policy."""
+    if policy == "none":
+        return dataclasses.replace(c, remat=False)
+    if policy not in _REMAT_POLICIES:
+        raise ValueError(f"remat policy {policy!r} not in "
+                         f"{['none'] + sorted(_REMAT_POLICIES)}")
+    return dataclasses.replace(c, remat=True, remat_policy=policy)
+
+
 def _part(init, names):
     return nn.with_logical_partitioning(init, names)
 

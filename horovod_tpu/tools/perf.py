@@ -525,6 +525,14 @@ def ratchet_check(history: List[Dict[str, Any]],
     ``band`` × the best MFU ever recorded for that model — wins ratchet
     the floor up; a drop below the band fails. A drop below best but
     inside the band is reported as a warning line (noise allowance).
+
+    ``kind: "perf_ratio"`` records (``benchmarks/remat_sweep.py`` and any
+    slope_time_paired A/B arm) are railed separately, per
+    ``(model, arm)`` key: the latest interleaved step-time ratio must be
+    no lower than ``band`` × the best ratio ever recorded for that arm —
+    a measured compute-tier win (remat policy, scan mode, accumulation)
+    becomes a floor the moment it lands. They are excluded from the MFU
+    grouping: a ratio record carries no budget or MFU of its own.
     Returns ``(ok, messages)``.
     """
     if band is None:
@@ -533,8 +541,20 @@ def ratchet_check(history: List[Dict[str, Any]],
     ok = True
     msgs: List[str] = []
     by_model: Dict[str, List[Dict[str, Any]]] = collections.defaultdict(list)
+    by_arm: Dict[Tuple[str, str],
+                 List[Dict[str, Any]]] = collections.defaultdict(list)
     for rec in history:
         model = rec.get("model")
+        if rec.get("kind") == "perf_ratio":
+            ratio = rec.get("ratio")
+            if not model or not rec.get("arm") \
+                    or not isinstance(ratio, (int, float)):
+                ok = False
+                msgs.append("FAIL shape [perf_ratio]: record needs "
+                            f"model/arm/numeric ratio, got {rec}")
+                continue
+            by_arm[(model, rec["arm"])].append(rec)
+            continue
         if model:
             by_model[model].append(rec)
         if rec.get("kind") != "perf_budget":
@@ -571,6 +591,22 @@ def ratchet_check(history: List[Dict[str, Any]],
         else:
             msgs.append(f"ok [{model}]: MFU {latest:.4f} is the floor "
                         f"(band {band})")
+    for (model, arm), recs in sorted(by_arm.items()):
+        best = max(r["ratio"] for r in recs)
+        latest = recs[-1]["ratio"]
+        floor = best * band
+        if latest < floor:
+            ok = False
+            msgs.append(f"FAIL ratchet [{model}/{arm}]: latest ratio "
+                        f"{latest:.4f} < floor {floor:.4f} "
+                        f"(best {best:.4f} × band {band})")
+        elif latest < best:
+            msgs.append(f"warn [{model}/{arm}]: latest ratio "
+                        f"{latest:.4f} below best {best:.4f} but inside "
+                        f"the {band} band")
+        else:
+            msgs.append(f"ok [{model}/{arm}]: ratio {latest:.4f} is the "
+                        f"floor (band {band})")
     return ok, msgs
 
 

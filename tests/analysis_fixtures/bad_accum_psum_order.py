@@ -1,0 +1,56 @@
+"""Fixture: lint-accum-psum-order (exactly ONE finding).
+
+A microbatch gradient-accumulation loop that mesh-reduces INSIDE the
+scan body — one collective per microbatch, n× the wire bytes of the
+identical result from reducing once after the loop. Plus a suppressed
+fori_loop twin and two clean look-alikes (the correct post-loop
+reduction, and a grad-free stat-sync loop).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def bad_accum_step(params, batches):
+    def microbatch(acc, mb):
+        loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * mb))(params)
+        grads = lax.pmean(grads, "dp")  # <- lint-accum-psum-order
+        return jax.tree_util.tree_map(jnp.add, acc, grads), loss
+
+    acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    acc, losses = lax.scan(microbatch, acc0, batches)
+    return acc, losses
+
+
+def suppressed_accum_step(params, batches, n):
+    def body(i, acc):
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.sum(p * batches[i]))(params)
+        grads = lax.psum(grads, "dp")  # hvd-analyze: ok
+        return jax.tree_util.tree_map(jnp.add, acc, grads)
+
+    acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return lax.fori_loop(0, n, body, acc0)
+
+
+def good_accum_step(params, batches):
+    # Correct order: accumulate on-replica inside the loop, ONE mesh
+    # reduction after it (psum is linear, so the results are identical).
+    def microbatch(acc, mb):
+        loss, grads = jax.value_and_grad(lambda p: jnp.sum(p * mb))(params)
+        return jax.tree_util.tree_map(jnp.add, acc, grads), loss
+
+    acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    acc, losses = lax.scan(microbatch, acc0, batches)
+    return lax.pmean(acc, "dp"), losses
+
+
+def stat_sync_loop(stats_seq):
+    # A scan body that reduces but computes no gradients: a running
+    # cross-replica stat sync, not an accumulation loop — judged clean.
+    def sync(carry, s):
+        return carry + lax.pmean(s, "dp"), ()
+
+    total, _ = lax.scan(sync, jnp.zeros(()), stats_seq)
+    return total

@@ -83,6 +83,32 @@ def main():
     if path:
         print(f"appended budget record to {path}")
 
+    # Accumulation arm (ISSUE 12): same model, accum_steps=4 microbatch
+    # loop — per-device batch 8 splits 4×2, grads accumulate in-graph,
+    # ONE allreduce per step. Same budget shape + ratchet contract as the
+    # plain arm, under its own model key.
+    astep = make_train_step(model, dopt, loss_fn, mesh=hvd.mesh(),
+                            axis_name=hvd.RANK_AXIS, donate=False,
+                            accum_steps=4)
+    _, loss = astep(state, images, labels)   # warm/compile outside trace
+    np.asarray(loss)
+    aflops = compiled_step_flops(astep, 1, state, images, labels)
+
+    alogdir = tempfile.mkdtemp(prefix="perf_guardrail_accum_")
+    with jax.profiler.trace(alogdir):
+        for _ in range(STEPS):
+            _, loss = astep(state, images, labels)
+            np.asarray(loss)
+
+    arec = perf.attribute_logdir(alogdir, STEPS,
+                                 model="resnet_tiny_accum4_cpu8",
+                                 metric="resnet_tiny_accum4_cpu_budget",
+                                 flops_per_step=aflops)
+    print(json.dumps(arec))
+    apath = perf.append_history(arec)
+    if apath:
+        print(f"appended accum budget record to {apath}")
+
 
 if __name__ == "__main__":
     main()

@@ -208,6 +208,7 @@ LINT_CASES = [
     ("bad_silent_rpc.py", "lint-silent-rpc", "warning"),
     ("bad_unguarded_apply.py", "jax-unguarded-apply", "warning"),
     ("bad_monolithic_psum.py", "lint-monolithic-psum", "warning"),
+    ("bad_accum_psum_order.py", "lint-accum-psum-order", "warning"),
     ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
     ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
     ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
@@ -309,28 +310,26 @@ class _FakeState(NamedTuple):
     step: int
 
 
-def test_make_gspmd_deferred_train_step_resume_phase(monkeypatch):
+def test_dispatch_resume_phase():
     """ADVICE r5 #2: the apply-vs-skip counter seeds from state.step on
     first call, so a checkpoint/elastic resume keeps cadence phase
-    instead of restarting the window."""
-    import horovod_tpu.train as train_mod
-    from horovod_tpu.optimizer import deferred_pair
+    instead of restarting the window. Exercised at the make_dispatch
+    level — the single dispatcher every deferred factory now routes
+    through."""
+    from horovod_tpu.train import make_dispatch
 
-    pair = deferred_pair(1e-3, every=3)
     calls = []
 
-    def fake_make(model, opt, mesh, rules, **kw):
-        tag = "apply" if opt is pair.apply else "skip"
-
-        def fake_step(state, tokens):
+    def prog(tag):
+        def fn(state, tokens):
             calls.append(tag)
             return _FakeState(state.step + 1), 0.0
-        return fake_step
+        return fn
 
-    monkeypatch.setattr(train_mod, "make_gspmd_train_step", fake_make)
+    programs = {"apply": prog("apply"), "skip": prog("skip")}
 
     # Fresh start: applies land when the global step hits 3, 6, ...
-    step = train_mod.make_gspmd_deferred_train_step(None, pair, None, None)
+    step = make_dispatch(programs, every=3)
     st = _FakeState(0)
     for _ in range(6):
         st, _loss = step(st, None)
@@ -339,9 +338,18 @@ def test_make_gspmd_deferred_train_step_resume_phase(monkeypatch):
     # Resume mid-window at step=4: the next apply must land at global
     # step 6 (2 steps later), NOT 3 steps later.
     calls.clear()
-    step = train_mod.make_gspmd_deferred_train_step(None, pair, None, None)
+    step = make_dispatch(programs, every=3)
     st = _FakeState(4)
     for _ in range(4):
         st, _loss = step(st, None)
     assert calls == ["skip", "apply", "skip", "skip"]
     assert st.step == 8
+
+    # Folded scan advances the counter by k per dispatch: every=2 with
+    # scan_steps=2 applies on EVERY dispatch (each covers a full window).
+    calls.clear()
+    step = make_dispatch(programs, every=2, scan_steps=2)
+    st = _FakeState(0)
+    for _ in range(3):
+        st, _loss = step(st, None)
+    assert calls == ["apply", "apply", "apply"]

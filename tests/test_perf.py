@@ -251,6 +251,47 @@ def test_ratchet_noise_band_warns_not_fails():
     assert any(m.startswith("warn [m]") for m in msgs)
 
 
+def _ratio_rec(model, arm, ratio):
+    return {"kind": "perf_ratio", "metric": f"{model}_{arm}_ratio",
+            "model": model, "arm": arm, "ratio": ratio,
+            "noise": {"lo": ratio * 0.98, "hi": ratio * 1.02}}
+
+
+def test_perf_ratio_records_rail_per_arm():
+    # a measured A/B win (remat_sweep.py arm) becomes a per-(model, arm)
+    # floor: later records inside the band pass, a collapse fails
+    hist = [_ratio_rec("llama_tiny", "remat_none_vs_full", 1.30),
+            _ratio_rec("llama_tiny", "remat_none_vs_full", 1.28)]
+    ok, msgs = perf.ratchet_check(hist, band=0.9)
+    assert ok
+    assert any("warn [llama_tiny/remat_none_vs_full]" in m for m in msgs)
+    ok, msgs = perf.ratchet_check(
+        hist + [_ratio_rec("llama_tiny", "remat_none_vs_full", 1.0)],
+        band=0.9)
+    assert not ok
+    assert any("FAIL ratchet [llama_tiny/remat_none_vs_full]" in m
+               for m in msgs)
+    # arms rail independently: one arm's drop does not hide behind
+    # another arm's win on the same model
+    ok, _ = perf.ratchet_check(
+        hist + [_ratio_rec("llama_tiny", "scan_vs_unroll", 1.05)],
+        band=0.9)
+    assert ok
+
+
+def test_perf_ratio_records_excluded_from_mfu_grouping():
+    # a ratio record carries no MFU/budget — it must not drag a model
+    # into (or pollute) the MFU ratchet, and a malformed one FAILs shape
+    ok, msgs = perf.ratchet_check(
+        [_rec("m", mfu=0.50), _ratio_rec("m", "accum4_vs_plain", 1.06)],
+        band=0.9)
+    assert ok
+    assert any("ok [m]: MFU" in m for m in msgs)
+    ok, msgs = perf.ratchet_check(
+        [{"kind": "perf_ratio", "model": "m", "ratio": "fast"}])
+    assert not ok and any("FAIL shape [perf_ratio]" in m for m in msgs)
+
+
 def test_ratchet_band_env_is_honored(monkeypatch):
     monkeypatch.setenv(perf.RATCHET_BAND_ENV, "0.5")
     ok, _ = perf.ratchet_check([_rec("m", mfu=0.50), _rec("m", mfu=0.30)])

@@ -69,11 +69,19 @@ def test_cpu_mesh_budget_record_and_ratchet_rail(tmp_path):
     assert budget is not None, out.stdout[-2000:]
     _assert_budget_shape(budget, "resnet_tiny_cpu8")
 
-    # the record landed in the history, stamped with provenance
+    # ISSUE 12: the accumulation arm (accum_steps=4) rides the same
+    # driver and must satisfy the identical budget contract under its
+    # own model key
+    abudget = recs.get("resnet_tiny_accum4_cpu_budget")
+    assert abudget is not None, out.stdout[-2000:]
+    _assert_budget_shape(abudget, "resnet_tiny_accum4_cpu8")
+
+    # the records landed in the history, stamped with provenance
     history = perf.load_history(str(hist))
-    assert any(r.get("model") == "resnet_tiny_cpu8"
-               and r.get("kind") == "perf_budget" and "date" in r
-               for r in history)
+    for model in ("resnet_tiny_cpu8", "resnet_tiny_accum4_cpu8"):
+        assert any(r.get("model") == model
+                   and r.get("kind") == "perf_budget" and "date" in r
+                   for r in history), model
 
     # the rail passes on the real record (CPU: shape-railed only) ...
     assert perf.main(["--history", str(hist), "check"]) == 0

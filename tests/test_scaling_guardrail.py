@@ -63,6 +63,15 @@ def test_scaling_guardrail_emits_sane_efficiency():
                 f"{noise.get('spread')} over {noise.get('rounds')} rounds "
                 "— investigate if it persists round-over-round "
                 "(benchmarks/scaling_history.jsonl)")
+    # The accum arm (ISSUE 12) must be present. It is deliberately NOT an
+    # *_scaling_efficiency metric — walking the batch as 4 sequential
+    # microbatches has no ideal-1.0 contract — so it gets a presence pin
+    # plus a loose sanity band only: the accumulated step must stay within
+    # the same order of magnitude as the plain dp8 step.
+    accum = recs.get("dp8_accum4_step_ratio")
+    assert accum is not None, sorted(recs)
+    assert 0.2 <= accum["value"] <= 2.5, accum
+    assert (accum.get("noise") or {}).get("rounds", 0) >= 3, accum
     # The overlap record (PR 6, docs/fusion.md) rides the same run: a
     # fraction in [0, 1], or None when the trace held no collective op
     # events — either way it must be present in the series.

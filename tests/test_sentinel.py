@@ -275,7 +275,7 @@ def _xent(logits, labels):
         logits, labels).mean()
 
 
-def _mlp_setup(sentinel):
+def _mlp_setup(sentinel, scan_steps=None):
     import flax.linen as nn
     from horovod_tpu.optimizer import distributed
     from horovod_tpu.train import create_train_state, make_train_step
@@ -293,7 +293,8 @@ def _mlp_setup(sentinel):
     dopt = distributed(optax.sgd(0.1))
     state = create_train_state(model, jax.random.PRNGKey(0), images[:1],
                                dopt)
-    step = make_train_step(model, dopt, _xent, sentinel=sentinel)
+    step = make_train_step(model, dopt, _xent, sentinel=sentinel,
+                           scan_steps=scan_steps)
     return step, state, images, labels
 
 
@@ -382,12 +383,38 @@ def test_probe_program_smaller_than_apply():
     assert count(probe, "all_gather") == count(on, "all_gather")
 
 
-def test_sentinel_scan_steps_mutually_exclusive():
-    from horovod_tpu.train import make_train_step
-    with pytest.raises(ValueError):
-        _mlp_step = make_train_step(
-            object(), optax.sgd(0.1), _xent,
-            sentinel=Sentinel(clock=FakeClock()), scan_steps=4)
+def test_sentinel_composes_with_scan_steps():
+    """The formerly forbidden combination: with scan_steps=k the inner
+    health vectors stack to [k, n, 3], the host ladder adjudicates every
+    inner step, and the in-graph where-guard keeps a non-finite inner
+    step from touching state even though the host only sees the health
+    after the whole folded window."""
+    s = Sentinel(max_skips=4, max_rollbacks=1, clock=FakeClock())
+    step, state, images, labels = _mlp_setup(s, scan_steps=2)
+
+    state, loss = step(state, images, labels)     # 2 clean inner steps
+    assert np.isfinite(float(loss))
+    assert int(state.step) == 2 and s.steps_skipped == 0
+
+    # One dispatch = 2 bad inner steps: the ladder observes BOTH stacked
+    # health rows (2 skips), and the where-guard held params on each.
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    bad = images.at[0].set(jnp.nan)
+    state, _ = step(state, bad, labels)
+    assert int(state.step) == 4
+    assert s.steps_skipped == 2 and s.in_containment
+    assert _same(before, state.params)
+
+    # Containment: the next clean dispatch runs the (folded) probe —
+    # params still held — and its healthy verdicts exit containment.
+    state, _ = step(state, images, labels)
+    assert not s.in_containment
+    assert _same(before, state.params)
+
+    # Back to normal: the following clean dispatch applies updates.
+    state, _ = step(state, images, labels)
+    assert not _same(before, state.params)
+    assert s.steps_skipped == 2                   # no further skips
 
 
 def test_gspmd_step_guard_and_probe():

@@ -1,0 +1,275 @@
+"""Step-builder feature-matrix tests (train/step_builder.py).
+
+The builder composes orthogonal step features — cadence deferral,
+sentinel probe, scan folding, gradient accumulation, pipeline stages —
+into the minimal jitted program set with donation preserved. These tests
+pin the matrix: combinations that used to be forbidden compose, the
+two-program donation/DCE trick holds per combination (AOT HLO
+inspection), and accumulation keeps the single-allreduce reduction
+discipline that ``lint-accum-psum-order`` enforces statically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.optimizer import distributed
+from horovod_tpu.parallel import create_mesh
+from horovod_tpu.train import (accumulate_gradients, create_train_state,
+                               create_gspmd_train_state,
+                               create_pipeline_train_state, make_dispatch,
+                               make_train_step, make_gspmd_deferred_train_step,
+                               make_pipeline_train_step, next_token_loss)
+
+
+# --------------------------------------------------------- pure-unit layer
+
+def test_accumulate_gradients_matches_full_batch():
+    """Mean-of-microbatch gradients == full-batch gradient for a mean
+    loss (the exactness upstream's backward_passes_per_step relies on)."""
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(3).astype(np.float32))}
+    x = jnp.asarray(rng.randn(8, 3).astype(np.float32))
+    y = jnp.asarray(rng.randn(8).astype(np.float32))
+
+    def run(p, aux, xb, yb):
+        loss = jnp.mean((xb @ p["w"] - yb) ** 2)
+        return loss, aux
+
+    vg = jax.value_and_grad(run, has_aux=True)
+    (loss_full, _), grads_full = vg(params, (), x, y)
+    (loss_acc, _), grads_acc = accumulate_gradients(
+        vg, params, (), (x, y), 4)
+    np.testing.assert_allclose(np.asarray(loss_acc),
+                               np.asarray(loss_full), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads_acc["w"]),
+                               np.asarray(grads_full["w"]), rtol=1e-5)
+
+
+def test_accumulate_gradients_validates():
+    def vg(p, aux, xb):
+        return (jnp.sum(xb), aux), p
+    with pytest.raises(ValueError, match="divisible"):
+        accumulate_gradients(vg, {}, (), (jnp.zeros((6, 2)),), 4)
+    with pytest.raises(ValueError, match=">= 1"):
+        accumulate_gradients(vg, {}, (), (jnp.zeros((6, 2)),), 0)
+
+
+def test_dispatch_passthrough_without_features():
+    """No sentinel, no cadence: the apply program is returned AS-IS —
+    zero per-step dispatch overhead."""
+    def apply_prog(state, x):
+        return state, x
+    programs = {"apply": apply_prog, "skip": None, "probe": None}
+    assert make_dispatch(programs) is apply_prog
+
+
+# ------------------------------------------------- DP accumulation matrix
+
+def _xent(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+def _mlp_parts(batch=32):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(nn.relu(nn.Dense(16)(x)))
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 4, 4, 1).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=(batch,)))
+    model = MLP()
+    dopt = distributed(optax.sgd(0.1))
+    state = create_train_state(model, jax.random.PRNGKey(0), images[:1],
+                               dopt)
+    return model, dopt, state, images, labels
+
+
+def test_accum_step_matches_plain_and_keeps_one_allreduce():
+    """accum_steps=a produces the same update as the full-batch step
+    (mean loss ⇒ exact), and the compiled program carries the SAME
+    all-reduce count — nothing cross-device inside the microbatch loop
+    (the lint-accum-psum-order discipline, proven at the HLO level)."""
+    model, dopt, state, images, labels = _mlp_parts()
+    plain = make_train_step(model, dopt, _xent, donate=False)
+    accum = make_train_step(model, dopt, _xent, donate=False,
+                            accum_steps=2)
+
+    hlo_plain = plain.lower(state, images, labels).compile().as_text()
+    hlo_accum = accum.lower(state, images, labels).compile().as_text()
+    assert hlo_accum.count("all-reduce(") == hlo_plain.count("all-reduce(")
+
+    s1, l1 = plain(state, images, labels)
+    s2, l2 = accum(state, images, labels)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_accum_step_rejects_indivisible_local_batch():
+    """Shapes are per-device under shard_map: 16/8 = 2 per device is not
+    divisible by accum_steps=4, and the error says so at trace time."""
+    model, dopt, state, images, labels = _mlp_parts(batch=16)
+    step = make_train_step(model, dopt, _xent, donate=False, accum_steps=4)
+    with pytest.raises(ValueError, match="per-device"):
+        step(state, images, labels)
+
+
+def test_accum_donation_preserved():
+    """donate=True keeps buffer donation through the accumulation scan:
+    the compiled program aliases inputs to outputs (the aliasing a
+    lax.cond formulation would forfeit)."""
+    model, dopt, state, images, labels = _mlp_parts()
+    donating = make_train_step(model, dopt, _xent, donate=True,
+                               accum_steps=2)
+    plain = make_train_step(model, dopt, _xent, donate=False,
+                            accum_steps=2)
+    hlo_don = donating.lower(state, images, labels).compile().as_text()
+    hlo_not = plain.lower(state, images, labels).compile().as_text()
+    assert "input_output_alias" in hlo_don
+    assert "input_output_alias" not in hlo_not
+
+
+# ------------------------------------- deferred × sentinel (GSPMD matrix)
+
+def test_deferred_sentinel_compose_three_programs():
+    """The formerly impossible combination: cadence deferral AND sentinel
+    on one job, through the shared dispatcher — three programs (apply,
+    skip, ONE shared probe), probe DCE proven by HLO op counts, and the
+    host ladder still adjudicating."""
+    import flax.linen as nn
+    from horovod_tpu.core.sentinel import Sentinel
+    from horovod_tpu.optimizer import deferred_pair
+
+    class TinyLM(nn.Module):
+        vocab: int = 13
+
+        @nn.compact
+        def __call__(self, tokens):
+            x = nn.Embed(self.vocab, 8)(tokens)
+            return nn.Dense(self.vocab)(nn.relu(nn.Dense(8)(x)))
+
+    mesh = create_mesh({"dp": 8})
+    model = TinyLM()
+    pair = deferred_pair(1e-2, every=2)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(1, 13, size=(8, 6)))
+    state = create_gspmd_train_state(model, pair.apply,
+                                     jax.random.PRNGKey(0), tokens, mesh,
+                                     ())
+    s = Sentinel(max_skips=3, max_rollbacks=1,
+                 rollback_fn=lambda st: st, evict_fn=lambda a: None)
+    step = make_gspmd_deferred_train_step(
+        model, pair, mesh, (), loss_fn=lambda lg, tk: next_token_loss(lg, tk),
+        data_axes=("dp",), donate=False, sentinel=s)
+
+    # All three lowering handles exist (apply, skip, shared probe).
+    lo_apply = step.lower_apply(state, tokens).compile().as_text()
+    lo_skip = step.lower_skip(state, tokens).compile().as_text()
+    lo_probe = step.lower_probe(state, tokens).compile().as_text()
+    assert lo_apply and lo_skip and lo_probe
+
+    # Probe DCE: with no optimizer.update traced anywhere, the probe
+    # program is strictly smaller than the apply program.
+    assert lo_probe.count("fusion(") <= lo_apply.count("fusion(")
+    assert len(lo_probe.splitlines()) < len(lo_apply.splitlines())
+
+    # Cadence through the dispatcher: step 1 skips the deferred bank,
+    # step 2 applies; the sentinel ladder sees every step.
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    state, l1 = step(state, tokens)
+    state, l2 = step(state, tokens)
+    assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+    assert int(state.step) == 2 and s.steps_skipped == 0
+    changed = any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(state.params)))
+    assert changed
+
+
+# -------------------------------------------------------- pipeline matrix
+
+def _pipeline_parts(n_stages, schedule, dp=None):
+    rng = np.random.RandomState(7)
+    D, M, mb = 3, 40, 4
+    Ws = jnp.asarray(rng.randn(n_stages, D, D).astype(np.float32) * 0.4)
+    xs = jnp.asarray(rng.randn(M, mb, D).astype(np.float32))
+    ts = jnp.asarray(rng.randn(M, mb, D).astype(np.float32))
+
+    def stage_fn(W, x):
+        return jnp.tanh(x @ W)
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    axes = {"pp": n_stages} if dp is None else {"dp": dp, "pp": n_stages}
+    mesh = create_mesh(axes)
+    opt = optax.sgd(0.1)
+    state = create_pipeline_train_state(Ws, opt)
+    step = make_pipeline_train_step(
+        stage_fn, loss_fn, opt, mesh=mesh, schedule=schedule,
+        dp_axis_name="dp" if dp else None, donate=False)
+    return step, state, Ws, xs, ts
+
+
+def _pipeline_oracle(Ws, xs, ts, per_microbatch):
+    """Sequential composition + one SGD(0.1) step on the same loss."""
+    def seq_loss(W_all):
+        h = xs
+        for s in range(W_all.shape[0]):
+            h = jnp.tanh(h @ W_all[s])
+        if per_microbatch:
+            return jnp.mean((h - ts) ** 2, axis=(1, 2)).mean()
+        return jnp.mean((h - ts) ** 2)
+
+    loss, grads = jax.value_and_grad(seq_loss)(Ws)
+    return float(loss), np.asarray(Ws - 0.1 * grads)
+
+
+@pytest.mark.parametrize("schedule,dp", [("interleaved", None),
+                                         ("gpipe", None),
+                                         ("gpipe", 2)])
+def test_pipeline_step_matches_sequential(schedule, dp):
+    """One pipeline train step == one step of the sequential oracle, for
+    the 1F1B interleave, AD GPipe, and GPipe over a 2-axis (dp, pp)
+    mesh."""
+    n = 4 if dp else 8
+    step, state, Ws, xs, ts = _pipeline_parts(n, schedule, dp=dp)
+    ref_loss, ref_W = _pipeline_oracle(
+        Ws, xs, ts, per_microbatch=(schedule == "interleaved"))
+    state, loss = step(state, xs, ts)
+    assert int(state.step) == 1
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(state.stage_params), ref_W,
+                               rtol=2e-4, atol=1e-5)
+    # and it keeps training
+    state, loss2 = step(state, xs, ts)
+    assert float(loss2) < float(loss)
+
+
+def test_pipeline_schedule_validation():
+    def stage_fn(W, x):
+        return x
+
+    def loss_fn(y, t):
+        return jnp.mean(y)
+
+    mesh = create_mesh({"dp": 2, "pp": 4})
+    with pytest.raises(ValueError, match="dp seam"):
+        make_pipeline_train_step(stage_fn, loss_fn, optax.sgd(0.1),
+                                 mesh=mesh, schedule="interleaved",
+                                 dp_axis_name="dp")
+    with pytest.raises(ValueError, match="unknown schedule"):
+        make_pipeline_train_step(stage_fn, loss_fn, optax.sgd(0.1),
+                                 mesh=mesh, schedule="zigzag")
