@@ -26,6 +26,16 @@ Two correctness segments ride along:
   cadence; commit→served latency (adopted_at − publish time) must stay
   a small fraction of the cadence.
 
+The **decode** segment (ISSUE 13) A/Bs continuous-batching decode
+(serving/decode.py: paged KV-cache + persistent slot array) against the
+bucketed full-forward serving arm it replaces, on llama_tiny over the
+CPU mesh. Same interleaving discipline: both arms inside every
+``slope_time_paired`` round, speedup = median of per-round ratios. Also
+recorded: decode tokens/s/chip, TTFT at admission, steady-state decode
+compile count (must be ZERO after warmup — the no-recompile contract),
+and the p99 per-step latency while ≥2 weight hot-swaps land mid-decode
+(the refill-policy block-table remap cost).
+
 Emits ONE JSON line (bench.py convention) and appends it — stamped with
 date + git SHA — to ``benchmarks/serving_history.jsonl`` unless
 ``HOROVOD_SERVING_NO_HISTORY`` is set. ``--check`` validates the newest
@@ -67,6 +77,12 @@ NO_HISTORY_ENV = "HOROVOD_SERVING_NO_HISTORY"
 #: real delta-fetch regression can cross it.
 MIN_SWAP_RATIO = 1.2
 MAX_STALENESS_S = 2.0
+#: Decode rails (ISSUE 13 acceptance): continuous decode must hold ≥2×
+#: tokens/s over bucketed full-forward serving, with zero steady-state
+#: decode compiles; the p99 ceiling is a loose absolute backstop — the
+#: honest swap cost is the recorded p99/p50 pair itself.
+MIN_DECODE_SPEEDUP = 2.0
+MAX_DECODE_P99_S = 5.0
 
 
 def _counters_clean() -> Dict[str, int]:
@@ -254,6 +270,153 @@ def run_staleness_segment(*, commits: int, cadence_s: float,
         }
 
 
+# -- continuous decode vs bucketed full-forward (ISSUE 13) --------------------
+
+
+def _llama_decode_fixture():
+    """(cfg, model, unboxed params) for the decode arms — llama_tiny, the
+    CPU-mesh workhorse of the parity tests."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from horovod_tpu.models.llama import Llama, llama_tiny
+
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens)
+    return cfg, model, nn.meta.unbox(variables)["params"]
+
+
+def run_decode_segment(*, rounds: int = 5, slots: int = 8,
+                       s_short: int = 4, s_long: int = 16) -> dict:
+    """Interleaved A/B: one engine tick (``decode8`` — S new tokens via
+    the paged-KV decode program) vs one bucketed full-forward serving
+    step (``full8`` — the same S next-tokens recomputed from scratch on
+    the padded [S, bucket] batch, the /predict-style baseline).
+
+    Workload: ``slots`` concurrent sequences, 16-token prompt, a
+    48-token generation budget — so the full-forward arm pads to the
+    64 bucket (it must reserve prompt+max_new up front), while the
+    decode arm's gather width is its per-slot context, sized for the
+    whole timing run and therefore LARGER than 64: the ratio is
+    conservative against the decode arm.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.serving.decode import DecodeEngine
+
+    cfg, model, params = _llama_decode_fixture()
+    bs = 16
+    prompt = list(range(1, 17))
+    # Context budget: pre-warm + slope warmup + every timed round, with
+    # one spare block so table growth never stalls mid-measurement.
+    steps_budget = 1 + (rounds + 1) * (s_short + s_long) + s_long
+    ctx_blocks = (len(prompt) + steps_budget) // bs + 2
+    eng = DecodeEngine(cfg, params=params, slots=slots, block_size=bs,
+                       pool_blocks=slots * ctx_blocks + 2,
+                       max_blocks_per_slot=ctx_blocks,
+                       prefill_buckets=(len(prompt),),
+                       swap_policy="refill")
+    max_new = ctx_blocks * bs - len(prompt)
+    reqs = [eng.submit(prompt, max_new) for _ in range(slots)]
+    eng.decode_once()               # admits all slots (prefill compiles)
+    ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+
+    full_seq = 64                   # bucket for prompt 16 + max_new 48
+    full_toks = jnp.zeros((slots, full_seq), jnp.int32)
+    full_toks = full_toks.at[:, :len(prompt)].set(
+        jnp.asarray(prompt, jnp.int32))
+
+    @jax.jit
+    def _full_step(p, toks):
+        logits = model.apply({"params": p}, toks)
+        return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    def run_full(k):
+        out = None
+        for _ in range(k):
+            out = _full_step(params, full_toks)
+        common.sync(out)
+
+    def run_decode(k):
+        for _ in range(k):
+            eng.decode_once()
+        common.sync(eng._dev_tokens)
+
+    run_decode(1)                   # decode program compiled here
+    run_full(1)
+    warm = dict(eng.compile_counts)
+    slopes, rnds = common.slope_time_paired(
+        {"full8": run_full, "decode8": run_decode},
+        s_short, s_long, rounds=rounds, return_rounds=True)
+    steady_compiles = eng.compile_counts["decode"] - warm["decode"]
+    ratios = [r["full8"] / r["decode8"] for r in rnds]
+    swap = _run_swap_probe(cfg, params, slots=slots)
+    return {
+        "model": "llama_tiny", "slots": slots, "block_size": bs,
+        "devices_used": 1, "prompt_len": len(prompt),
+        "full_arm_seq": full_seq,
+        "sec_per_step": {k: round(v, 6) for k, v in slopes.items()},
+        "decode_tokens_per_s_per_chip": round(slots / slopes["decode8"], 1),
+        "speedup_vs_full": round(common.median_ratio(
+            rnds, "full8", "decode8"), 4),
+        "noise": _noise(ratios),
+        "ttft_p50_s": round(statistics.median(ttfts), 6) if ttfts else None,
+        "ttft_max_s": round(ttfts[-1], 6) if ttfts else None,
+        "steady_decode_compiles": steady_compiles,
+        "compile_counts": dict(eng.compile_counts),
+        "swap": swap,
+    }
+
+
+def _run_swap_probe(cfg, params, *, slots: int, steps: int = 60,
+                    swap_at=(20, 40)) -> dict:
+    """Per-step decode latency while weight hot-swaps land mid-decode
+    under the refill policy (live block tables remapped via re-prefill).
+    Prefill buckets are pre-warmed so p99 charges the swap, not XLA."""
+    import time as _time
+
+    from horovod_tpu.serving.decode import DecodeEngine
+
+    bs = 16
+    eng = DecodeEngine(cfg, params=params, slots=slots, block_size=bs,
+                       pool_blocks=slots * 8 + 2, max_blocks_per_slot=8,
+                       prefill_buckets=(16, 32, 64), swap_policy="refill")
+    # Warm every prefill bucket with throwaway one-token requests so the
+    # mid-decode refill (which re-prefills at the sequence's bucket)
+    # never hits a compile inside a timed step.
+    for warm_len in (16, 20, 40):
+        eng.submit(list(range(1, warm_len + 1)), 1)
+        eng.decode_once()
+    prompt = list(range(1, 17))
+    reqs = [eng.submit(prompt, 8 * bs - len(prompt))
+            for _ in range(slots)]
+    eng.decode_once()               # admit + first decode step
+    warm_decode = eng.compile_counts["decode"]
+    walls = []
+    for step in range(steps):
+        if step in swap_at:
+            # Re-install = new manifest seq: observed as a hot-swap.
+            eng.install_params(params)
+        t0 = _time.perf_counter()
+        eng.decode_once()
+        common.sync(eng._dev_tokens)  # hvd-analyze: ok — latency probe
+        walls.append(_time.perf_counter() - t0)
+    truncated = sum(1 for r in reqs if r.truncated)
+    return {
+        "policy": "refill", "steps": steps,
+        "swaps_during": len(swap_at),
+        "p50_step_s": round(float(np.percentile(walls, 50)), 6),
+        "p99_step_s": round(float(np.percentile(walls, 99)), 6),
+        "truncated": truncated,
+        "steady_decode_compiles":
+            eng.compile_counts["decode"] - warm_decode,
+    }
+
+
 # -- aggregation --------------------------------------------------------------
 
 
@@ -284,6 +447,7 @@ def run_harness(*, rounds: int, swaps: int, n_leaves: int,
                                   leaf_elems=leaf_elems)
     stale = run_staleness_segment(commits=5, cadence_s=0.2,
                                   n_leaves=n_leaves, leaf_elems=leaf_elems)
+    decode = run_decode_segment(rounds=rounds)
 
     def med(mode: str, field: str) -> float:
         return round(statistics.median(
@@ -304,6 +468,7 @@ def run_harness(*, rounds: int, swaps: int, n_leaves: int,
             m: med(m, "leaves_reused_per_swap") for m in ("all", "frozen")},
         "traffic": traffic,
         "staleness": stale,
+        "decode": decode,
     }
 
 
@@ -367,6 +532,30 @@ def check_history(path: str = HISTORY_PATH) -> dict:
     smax = stale.get("staleness_max_s")
     need(isinstance(smax, (int, float)) and 0 < smax < MAX_STALENESS_S,
          f"staleness_max_s={smax} outside (0, {MAX_STALENESS_S})")
+    dec = rec.get("decode") or {}
+    spd = dec.get("speedup_vs_full")
+    need(isinstance(spd, (int, float)) and spd >= MIN_DECODE_SPEEDUP,
+         f"decode speedup_vs_full={spd} < {MIN_DECODE_SPEEDUP}x (continuous "
+         f"decode not beating bucketed full-forward serving)")
+    tps = dec.get("decode_tokens_per_s_per_chip")
+    need(isinstance(tps, (int, float)) and tps > 0,
+         f"decode tokens/s/chip missing or non-positive: {tps}")
+    need(dec.get("steady_decode_compiles") == 0,
+         f"decode recompiled in steady state: "
+         f"steady_decode_compiles={dec.get('steady_decode_compiles')}")
+    dnoise = dec.get("noise") or {}
+    need(dnoise.get("rounds", 0) >= 3
+         and all(k in dnoise for k in ("ratio_min", "ratio_max", "spread")),
+         f"decode noise band incomplete: {dnoise}")
+    ttft = dec.get("ttft_p50_s")
+    need(isinstance(ttft, (int, float)) and ttft > 0,
+         f"decode ttft_p50_s missing or non-positive: {ttft}")
+    dswap = dec.get("swap") or {}
+    p99 = dswap.get("p99_step_s")
+    need(dswap.get("swaps_during", 0) >= 2
+         and isinstance(p99, (int, float)) and 0 < p99 < MAX_DECODE_P99_S
+         and dswap.get("steady_decode_compiles") == 0,
+         f"decode swap probe incomplete or out of rails: {dswap}")
     return {"check": "serving", "ok": not problems,
             "record_date": rec.get("date"), "record_git": rec.get("git"),
             "problems": problems}
@@ -417,6 +606,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(json.dumps(rec))
     if os.environ.get(NO_HISTORY_ENV, "").lower() not in ("1", "true"):
         _append_history(rec)
+        dec = rec.get("decode") or {}
+        if isinstance(dec.get("speedup_vs_full"), (int, float)):
+            # Ratchet the decode win in perf_history too, so
+            # `tools.perf check` rails it per (model, arm) like the
+            # remat-sweep ratios (respects HOROVOD_PERF_NO_HISTORY).
+            from horovod_tpu.tools import perf as perf_tools
+            perf_tools.append_history({
+                "kind": "perf_ratio",
+                "metric": "decode_speedup",
+                "model": "llama_tiny_serve_cpu8",
+                "arm": "continuous_decode_vs_full",
+                "ratio": dec["speedup_vs_full"],
+                "decode_tokens_per_s_per_chip":
+                    dec.get("decode_tokens_per_s_per_chip"),
+                "noise": dec.get("noise"),
+            })
     return 0
 
 

@@ -99,6 +99,19 @@ CLAUDE.md "Environment traps"):
   ``horovod_tpu.tools.perf``), filter on ``UMBRELLA_PREFIXES``, or
   pragma a span-sum that is deliberately a wall/overlap figure.
 
+- ``lint-decode-host-sync`` (WARNING): a host loop that drives a decode
+  step (any call whose name mentions ``decode``) AND forces a device
+  fetch in the same loop body — ``block_until_ready``, ``np.asarray``,
+  ``jax.device_get``, or ``common.sync``.  Continuous decode lives on
+  async dispatch: the engine enqueues one fixed-shape program per step
+  and the host races ahead admitting/retiring slots, so ONE blocking
+  fetch per iteration re-serializes the pipeline and tokens/s collapses
+  to round-trip latency (the decode arms in benchmarks/serving.py sync
+  once AFTER the timed window for exactly this reason).  Read tokens
+  from the engine's device-side buffer and fetch outside the loop;
+  pragma deliberate per-step probes (latency measurement, numerics
+  parity tests).
+
 - ``lint-accum-psum-order`` (WARNING): a ``lax.scan``/``lax.fori_loop``
   body that both computes gradients (``value_and_grad``/``grad``) and
   reduces them across the mesh (``psum``/``pmean``) — the microbatch
@@ -168,6 +181,25 @@ TELEMETRY_RECORD_NAMES = frozenset({
 TELEMETRY_BARE_NAMES = frozenset({"record_event", "set_gauge"})
 FETCH_CALL_NAMES = frozenset({"block_until_ready", "asarray",
                               "device_get"})
+
+# lint-decode-host-sync vocabulary: the fetches that serialize a decode
+# loop. ``sync`` is benchmarks/common.py's device->host fetch — it counts
+# here (a decode loop syncing per step defeats async dispatch) even
+# though it is not a jax API name.
+DECODE_FETCH_NAMES = frozenset({"block_until_ready", "asarray",
+                                "device_get", "sync"})
+
+
+def _is_decode_fetch(name: str) -> bool:
+    """``asarray`` counts only as numpy's (np./numpy./bare): jnp.asarray
+    is host->device and never blocks on device results."""
+    parts = name.split(".")
+    if parts[-1] not in DECODE_FETCH_NAMES:
+        return False
+    if parts[-1] == "asarray":
+        prefix = ".".join(parts[:-1]).lower()
+        return "jnp" not in prefix and "jax" not in prefix
+    return True
 
 # lint-blocking-commit vocabulary: the commit entry point marking a loop
 # as a step/commit loop, and the synchronous fetch that defeats the async
@@ -325,6 +357,9 @@ class _Lint(ast.NodeVisitor):
         # lint-blocking-commit: fetch sites already attributed to an
         # enclosing (outermost) commit loop.
         self._commit_fetch_handled: set = set()
+        # lint-decode-host-sync: fetch sites already attributed to an
+        # enclosing (outermost) decode loop.
+        self._decode_fetch_handled: set = set()
         # lint-recompile-in-request-path: names bound to jit(...) results
         # in this file (prescanned in visit_Module), and jit call sites
         # already attributed to an enclosing serve loop.
@@ -560,6 +595,35 @@ class _Lint(ast.NodeVisitor):
                 "arrays; fetch host copies only outside the step loop "
                 "(docs/checkpointing.md)")
 
+    def _check_decode_host_sync(self, node):
+        """lint-decode-host-sync: a host loop that both drives a decode
+        step and forces a device fetch per iteration — the blocking read
+        re-serializes the async decode dispatch pipeline (tokens/s
+        collapses to round-trip latency). Outer loop visited first;
+        nested loops skip already-attributed fetch sites. Comprehensions
+        are not loops here on purpose: a list-comp reading a ready host
+        buffer is the engine's own retire idiom."""
+        calls = [sub for sub in ast.walk(node) if isinstance(sub, ast.Call)]
+        if not any("decode" in _dotted(c.func).lower() for c in calls):
+            return
+        for c in calls:
+            if not _is_decode_fetch(_dotted(c.func)):
+                continue
+            if id(c) in self._decode_fetch_handled:
+                continue
+            self._decode_fetch_handled.add(id(c))
+            self._add(
+                "lint-decode-host-sync", Severity.WARNING, c,
+                "device fetch inside a decode loop body: continuous "
+                "decode lives on async dispatch (one fixed-shape program "
+                "per step, host racing ahead on admit/retire), so a "
+                "blocking read per iteration serializes the pipeline and "
+                "tokens/s collapses to round-trip latency — fetch once "
+                "OUTSIDE the loop (benchmarks/serving.py syncs after the "
+                "timed window), read tokens from the engine's device-side "
+                "buffer, or pragma a deliberate per-step probe "
+                "(docs/serving.md)")
+
     def _check_recompile_request_path(self, node):
         """lint-recompile-in-request-path: a request-draining loop calls
         a jit-bound name with no padding/bucketing call anywhere in the
@@ -623,6 +687,7 @@ class _Lint(ast.NodeVisitor):
 
     def visit_For(self, node):
         self._check_blocking_commit(node)
+        self._check_decode_host_sync(node)
         self._check_recompile_request_path(node)
         self._check_xplane_umbrella(node)
         self._loop_depth += 1
@@ -662,6 +727,7 @@ class _Lint(ast.NodeVisitor):
                     "HOROVOD_ELASTIC_POLL_JITTER, or park server-side via "
                     "get_world(wait=...) (see benchmarks/control_plane.py)")
         self._check_blocking_commit(node)
+        self._check_decode_host_sync(node)
         self._check_recompile_request_path(node)
         self._loop_depth += 1
         self.generic_visit(node)

@@ -10,7 +10,7 @@ __all__ = [
 def __getattr__(name):
     import importlib
     lazy = {"bert": ".bert", "llama": ".llama", "mixtral": ".mixtral",
-            "dlrm": ".dlrm"}
+            "dlrm": ".dlrm", "decode": ".decode"}
     for mod, path in lazy.items():
         if name == mod:
             try:

@@ -1,0 +1,285 @@
+"""Paged KV-cache decode path for the autoregressive models (Llama, Mixtral).
+
+Reference analog: none — SURVEY.md §2 confirms upstream Horovod never served
+inference; this is the TPU-native step past the reference (PARITY.md §7).
+The design follows the production paged-attention layout
+(jax.experimental.pallas.ops.tpu.paged_attention): a preallocated device
+pool of fixed-size KV blocks, per-sequence block tables mapping logical
+positions to physical blocks, and single-token queries attending against
+the gathered pages.
+
+Two jit-once programs per model config:
+
+- **prefill** (one compile per prompt bucket): the full causal forward over
+  one padded prompt, capturing every layer's post-RoPE K and raw V and
+  bulk-writing them into the slot's blocks. Returns all-position logits so
+  the last real position seeds generation (and so parity tests can compare
+  against ``model.apply`` directly).
+- **decode step** (ONE compile for the serving lifetime): a fixed-width
+  slot batch ``[S]`` advances one token. Per layer: project q/k/v for the
+  new token, write k/v at ``(table[pos//bs], pos % bs)`` (an S-row scatter —
+  per-step writes are tiny; the CLAUDE.md scatter trap is about bulk data
+  movement), then read the whole context back with ``jnp.take`` over the
+  block tables — the attention READ side is pure gather, and the MoE
+  dispatch reuses the sort-based gather-only plan from ``parallel/moe.py``.
+  Inactive/stalled slots carry zero-padded block tables, so their writes
+  target the reserved null block 0 — and are zero-masked via ``active`` so
+  block 0 stays all-zero — while their logits are garbage the engine
+  discards (active-mask semantics, no recompile on admit/retire).
+
+The math is a pure-jnp mirror of the flax modules (same einsum
+formulations, same f32 islands: RMSNorm, attention softmax, router,
+lm-head accumulation), operating on the plain params pytree the export
+seam (``train.step_builder.export_decode_params``) produces — no flax
+``apply`` in the serve path, so remat/scan/sow machinery never enters the
+decode program. Handles both checkpoint layouts: unrolled ``block_i`` keys
+and scanned ``layers``-stacked ``[L, ...]`` leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.moe import sorted_combine, sorted_dispatch, topk_router_sorted
+from .llama import LlamaConfig, rope
+
+NULL_BLOCK = 0  #: block 0 is reserved — inactive slots write/read here
+
+
+def is_moe(cfg: LlamaConfig) -> bool:
+    """Mixtral-family configs carry an expert bank (duck-typed so this
+    module never imports mixtral.py)."""
+    return getattr(cfg, "n_experts", 0) > 0
+
+
+def init_kv_pools(cfg: LlamaConfig, n_blocks: int,
+                  block_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed K and V pools, shape ``[L, n_blocks, block_size, n_kv, hd]``
+    in the model compute dtype (block 0 is the null block)."""
+    head_dim = cfg.dim // cfg.n_heads
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def layer_params(params, i: int):
+    """Layer ``i``'s param subtree for either checkpoint layout: unrolled
+    ``block_i`` keys, or the scanned ``layers`` node with [L, ...]-stacked
+    leaves (``i`` is a Python int — the slice is static at trace time)."""
+    if "layers" in params:
+        return jax.tree.map(lambda leaf: leaf[i], params["layers"]["block"])
+    return params[f"block_{i}"]
+
+
+# -- pure-jnp mirrors of the flax modules ------------------------------------
+
+def _rmsnorm(x, scale, eps, dtype):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * scale).astype(dtype)
+
+
+def _dense(x, kernel, dtype):
+    return jnp.einsum("...d,df->...f", x.astype(dtype), kernel.astype(dtype))
+
+
+def _mlp(p, c, x):
+    gate = _dense(x, p["w1"]["kernel"], c.dtype)
+    up = _dense(x, p["w3"]["kernel"], c.dtype)
+    return _dense(jax.nn.silu(gate) * up, p["w2"]["kernel"], c.dtype)
+
+
+def _moe(p, c, tokens):
+    """Gather-only routed expert bank on a flat ``[T, D]`` token batch —
+    the same sort-based dispatch plan as models/mixtral.py MoEMLP (the
+    one-hot scatter formulation profiled slower than the expert matmuls,
+    r4)."""
+    E = c.n_experts
+    T = tokens.shape[0]
+    logits = jnp.einsum("td,de->te", tokens.astype(jnp.float32),
+                        p["router"]["kernel"].astype(jnp.float32))
+    capacity = max(1, int(c.capacity_factor * c.top_k * T / E))
+    r = topk_router_sorted(logits, E, capacity, c.top_k)
+    dispatched = sorted_dispatch(tokens, r, E, capacity)
+    h = jax.nn.silu(jnp.einsum("ecd,edm->ecm", dispatched,
+                               p["w1"].astype(c.dtype)))
+    h = h * jnp.einsum("ecd,edm->ecm", dispatched, p["w3"].astype(c.dtype))
+    out = jnp.einsum("ecm,emd->ecd", h, p["w2"].astype(c.dtype))
+    return sorted_combine(out, r, T).astype(c.dtype)
+
+
+def _ffn(lp, c, x, moe: bool):
+    """The block's second half-residual on ``[..., D]`` activations."""
+    y = _rmsnorm(x, lp["mlp_norm"]["scale"], c.norm_eps, c.dtype)
+    if moe:
+        flat = y.reshape(-1, y.shape[-1])
+        return x + _moe(lp["moe"], c, flat).reshape(y.shape)
+    return x + _mlp(lp["mlp"], c, y)
+
+
+def _lm_head(params, c, x):
+    if c.tie_embeddings:
+        return jnp.einsum("...d,vd->...v", x.astype(c.dtype),
+                          params["embedding"].astype(c.dtype),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("...d,dv->...v", x.astype(c.dtype),
+                      params["lm_head"].astype(c.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def _attn_prefill(p, c, x, positions):
+    """Causal attention over the whole (padded) prompt — the training
+    formulation verbatim (materialized softmax path of llama.Attention),
+    additionally returning the pre-repeat post-RoPE K and raw V for the
+    cache."""
+    head_dim = c.dim // c.n_heads
+    B, T = x.shape[0], x.shape[1]
+    q = _dense(x, p["wq"]["kernel"], c.dtype).reshape(
+        B, T, c.n_heads, head_dim)
+    k = _dense(x, p["wk"]["kernel"], c.dtype).reshape(
+        B, T, c.n_kv_heads, head_dim)
+    v = _dense(x, p["wv"]["kernel"], c.dtype).reshape(
+        B, T, c.n_kv_heads, head_dim)
+    q = rope(q, positions, c.rope_theta)
+    k = rope(k, positions, c.rope_theta)
+    rep = c.n_heads // c.n_kv_heads
+    kr = jnp.repeat(k, rep, axis=2)
+    vr = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / head_dim ** 0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    pr = jax.nn.softmax(s, axis=-1).astype(c.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", pr, vr).reshape(
+        B, T, c.n_heads * head_dim)
+    return _dense(o, p["wo"]["kernel"], c.dtype), k, v
+
+
+def make_prefill(cfg: LlamaConfig, block_size: int):
+    """Build the prefill program for ``cfg``: one compile per prompt
+    bucket (the bucketed-prefill discipline — compile count is bounded by
+    configuration, not traffic).
+
+    ``prefill(params, k_pool, v_pool, tokens[1, T], block_ids[T // bs])
+    -> (logits[1, T, V] f32, k_pool, v_pool)`` — K/V for positions
+    ``0..T-1`` land in the slot's blocks; positions at or beyond the real
+    prompt length hold padding K/V, which is harmless because the decode
+    mask only admits ``t <= pos`` and position ``pos`` is rewritten by the
+    decode step itself before its first read.
+    """
+    moe = is_moe(cfg)
+
+    def prefill(params, k_pool, v_pool, tokens, block_ids):
+        T = tokens.shape[1]
+        if T % block_size:
+            raise ValueError(f"prefill bucket {T} must be a multiple of "
+                             f"block_size {block_size}")
+        x = jnp.take(params["embedding"], tokens, axis=0).astype(cfg.dtype)
+        positions = jnp.arange(T)[None, :]
+        ks, vs = [], []
+        for i in range(cfg.n_layers):
+            lp = layer_params(params, i)
+            h, k, v = _attn_prefill(
+                lp["attn"], cfg,
+                _rmsnorm(x, lp["attn_norm"]["scale"], cfg.norm_eps,
+                         cfg.dtype),
+                positions)
+            x = _ffn(lp, cfg, x + h, moe)
+            ks.append(k[0])
+            vs.append(v[0])
+        x = _rmsnorm(x, params["final_norm"]["scale"], cfg.norm_eps,
+                     cfg.dtype)
+        logits = _lm_head(params, cfg, x)
+        n_ch = T // block_size
+        head_dim = cfg.dim // cfg.n_heads
+        shape = (cfg.n_layers, n_ch, block_size, cfg.n_kv_heads, head_dim)
+        k_all = jnp.stack(ks).reshape(shape).astype(k_pool.dtype)
+        v_all = jnp.stack(vs).reshape(shape).astype(v_pool.dtype)
+        k_pool = k_pool.at[:, block_ids].set(k_all)
+        v_pool = v_pool.at[:, block_ids].set(v_all)
+        return logits, k_pool, v_pool
+
+    return prefill
+
+
+def make_decode_step(cfg: LlamaConfig, block_size: int):
+    """Build the single-token decode program for ``cfg`` — ONE compile for
+    the serving lifetime (fixed slot width S and block-table width Bmax;
+    admit/retire only flips the active mask and table contents).
+
+    ``decode(params, k_pool, v_pool, tokens[S], positions[S],
+    block_tables[S, Bmax], active[S])
+    -> (logits[S, V] f32, next_tokens[S] i32, k_pool, v_pool)``
+
+    Greedy next tokens are computed on device so the engine can feed them
+    straight back without a host round-trip (lint-decode-host-sync).
+    """
+    moe = is_moe(cfg)
+    head_dim = cfg.dim // cfg.n_heads
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / head_dim ** 0.5
+
+    def decode(params, k_pool, v_pool, tokens, positions, block_tables,
+               active):
+        S = tokens.shape[0]
+        bmax = block_tables.shape[1]
+        t_max = bmax * block_size
+        x = jnp.take(params["embedding"], tokens, axis=0).astype(cfg.dtype)
+        blk = jnp.take_along_axis(
+            block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+        off = positions % block_size
+        pos2 = positions[:, None]
+        mask = jnp.arange(t_max)[None, :] <= positions[:, None]
+        for i in range(cfg.n_layers):
+            lp = layer_params(params, i)
+            ap = lp["attn"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.norm_eps,
+                         cfg.dtype)
+            q = _dense(h, ap["wq"]["kernel"], cfg.dtype).reshape(
+                S, 1, cfg.n_heads, head_dim)
+            k = _dense(h, ap["wk"]["kernel"], cfg.dtype).reshape(
+                S, 1, cfg.n_kv_heads, head_dim)
+            v = _dense(h, ap["wv"]["kernel"], cfg.dtype).reshape(
+                S, 1, cfg.n_kv_heads, head_dim)
+            q = rope(q, pos2, cfg.rope_theta)[:, 0]
+            k = rope(k, pos2, cfg.rope_theta)[:, 0]
+            v = v[:, 0]
+            # write the new token's K/V (S-row scatter), then READ the
+            # whole context back as a gather over the block tables.
+            # Masked slots (inactive or stalled) target the null block
+            # through their zero-padded tables; their values are zeroed so
+            # block 0 stays all-zero — the invariant padded reads rely on.
+            act = active[:, None, None]
+            k_pool = k_pool.at[i, blk, off].set(
+                jnp.where(act, k, 0).astype(k_pool.dtype))
+            v_pool = v_pool.at[i, blk, off].set(
+                jnp.where(act, v, 0).astype(v_pool.dtype))
+            kb = jnp.take(k_pool[i], block_tables, axis=0).reshape(
+                S, t_max, cfg.n_kv_heads, head_dim)
+            vb = jnp.take(v_pool[i], block_tables, axis=0).reshape(
+                S, t_max, cfg.n_kv_heads, head_dim)
+            # grouped-query form: head h reads kv group h // rep — the
+            # same pairing as the training path's jnp.repeat, without
+            # materializing the repeated K/V
+            qg = q.reshape(S, cfg.n_kv_heads, rep, head_dim)
+            s = jnp.einsum("sgrd,stgd->sgrt", qg, kb).astype(
+                jnp.float32) * scale
+            s = jnp.where(mask[:, None, None, :], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+            o = jnp.einsum("sgrt,stgd->sgrd", pr, vb).reshape(
+                S, cfg.n_heads * head_dim)
+            x = _ffn(lp, cfg, x + _dense(o, ap["wo"]["kernel"], cfg.dtype),
+                     moe)
+        x = _rmsnorm(x, params["final_norm"]["scale"], cfg.norm_eps,
+                     cfg.dtype)
+        logits = _lm_head(params, cfg, x)
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # logits/next_tokens rows for masked slots are garbage the engine
+        # discards (it keeps their pending tokens via jnp.where); only the
+        # K/V writes above need masking, to preserve the null block.
+        return logits, next_tokens, k_pool, v_pool
+
+    return decode

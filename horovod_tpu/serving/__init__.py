@@ -8,9 +8,13 @@ Three parts, layered on the elastic/CAS infrastructure:
 - :mod:`~horovod_tpu.serving.registry` — serving-side discovery,
   delta-fetch and RCU hot-swap of the served param pytree;
 - :mod:`~horovod_tpu.serving.server` — HTTP inference frontend with
-  bucketed dynamic batching and ``hvd_serving_*`` telemetry.
+  bucketed dynamic batching and ``hvd_serving_*`` telemetry;
+- :mod:`~horovod_tpu.serving.decode` — continuous-batching LLM decode
+  over the paged KV-cache (models/decode.py): slot admit/retire,
+  block allocator, swap-aware engine behind ``POST /generate``.
 """
 
+from .decode import BlockAllocator, DecodeEngine, DecodeRequest  # noqa: F401
 from .publisher import Publisher, attach, detach, leaves_digest  # noqa: F401
 from .registry import ModelRegistry, ServedModel                 # noqa: F401
 from .server import InferenceServer, pad_to_bucket               # noqa: F401

@@ -45,6 +45,42 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 SERVING_RANK_ENV = "HOROVOD_SERVING_RANK"
 DEFAULT_SERVING_RANK = 900
 
+#: Decode-path knobs (docs/serving.md "Decode path") — the continuous
+#: batching engine's slot width: how many sequences one decode step
+#: advances. Fixed for the serving lifetime (the decode program compiles
+#: exactly once).
+DECODE_SLOTS_ENV = "HOROVOD_DECODE_SLOTS"
+DEFAULT_DECODE_SLOTS = 8
+
+#: Tokens per KV block. Prefill buckets must be multiples of this.
+DECODE_BLOCK_SIZE_ENV = "HOROVOD_DECODE_BLOCK_SIZE"
+DEFAULT_DECODE_BLOCK_SIZE = 16
+
+#: Total blocks in the preallocated device pool (block 0 is reserved).
+DECODE_POOL_BLOCKS_ENV = "HOROVOD_DECODE_POOL_BLOCKS"
+DEFAULT_DECODE_POOL_BLOCKS = 128
+
+#: Block-table width per slot — caps a sequence's context at
+#: ``max_blocks_per_slot * block_size`` positions.
+DECODE_MAX_BLOCKS_ENV = "HOROVOD_DECODE_MAX_BLOCKS_PER_SLOT"
+DEFAULT_DECODE_MAX_BLOCKS = 8
+
+#: Comma-separated ascending PROMPT buckets (token positions, not batch
+#: size) the prefill pads into — one compile each, same discipline as
+#: BUCKETS_ENV for the /predict batcher.
+DECODE_PREFILL_BUCKETS_ENV = "HOROVOD_DECODE_PREFILL_BUCKETS"
+DEFAULT_DECODE_PREFILL_BUCKETS = (16, 32, 64)
+
+#: Default generation budget when a request does not name one.
+DECODE_MAX_NEW_ENV = "HOROVOD_DECODE_MAX_NEW"
+DEFAULT_DECODE_MAX_NEW = 64
+
+#: What the engine does with LIVE slots when the registry hot-swaps:
+#: "refill" re-prefills them under the new weights (block tables
+#: remapped), "drain" finishes them on the old weights first.
+DECODE_SWAP_POLICY_ENV = "HOROVOD_DECODE_SWAP_POLICY"
+DEFAULT_DECODE_SWAP_POLICY = "refill"
+
 
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
@@ -103,3 +139,40 @@ def buckets() -> tuple:
 
 def serving_rank() -> int:
     return _env_int(SERVING_RANK_ENV, DEFAULT_SERVING_RANK)
+
+
+def decode_slots() -> int:
+    return max(1, _env_int(DECODE_SLOTS_ENV, DEFAULT_DECODE_SLOTS))
+
+
+def decode_block_size() -> int:
+    return max(1, _env_int(DECODE_BLOCK_SIZE_ENV, DEFAULT_DECODE_BLOCK_SIZE))
+
+
+def decode_pool_blocks() -> int:
+    return max(2, _env_int(DECODE_POOL_BLOCKS_ENV,
+                           DEFAULT_DECODE_POOL_BLOCKS))
+
+
+def decode_max_blocks_per_slot() -> int:
+    return max(1, _env_int(DECODE_MAX_BLOCKS_ENV, DEFAULT_DECODE_MAX_BLOCKS))
+
+
+def decode_prefill_buckets() -> tuple:
+    raw = os.environ.get(DECODE_PREFILL_BUCKETS_ENV, "")
+    if not raw:
+        return DEFAULT_DECODE_PREFILL_BUCKETS
+    try:
+        sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return DEFAULT_DECODE_PREFILL_BUCKETS
+    return tuple(s for s in sizes if s > 0) or DEFAULT_DECODE_PREFILL_BUCKETS
+
+
+def decode_max_new() -> int:
+    return max(1, _env_int(DECODE_MAX_NEW_ENV, DEFAULT_DECODE_MAX_NEW))
+
+
+def decode_swap_policy() -> str:
+    v = os.environ.get(DECODE_SWAP_POLICY_ENV, "").strip().lower()
+    return v if v in ("refill", "drain") else DEFAULT_DECODE_SWAP_POLICY

@@ -1,0 +1,579 @@
+"""Continuous-batching decode engine over the paged KV-cache.
+
+Reference analog: none — upstream Horovod served training only (SURVEY.md
+§2); this is the serving plane's decode half (docs/serving.md "Decode
+path"), built on ``models/decode.py``:
+
+- a **BlockAllocator** hands out fixed-size KV blocks from the
+  preallocated device pool (block 0 is reserved as the null block inactive
+  slots point at). Free-list discipline: no fragmentation is possible by
+  construction — any free block serves any slot, so allocation fails only
+  when the pool is genuinely exhausted (asserted by the property tests).
+- a fixed-width **slot array**: requests are ADMITTED into free slots at
+  prefill (one compile per configured prompt bucket — the same bucketed
+  discipline as the ``/predict`` batcher, policed by
+  ``lint-recompile-in-request-path``) and RETIRED per decode step; between
+  admits the ONE jitted decode program just keeps stepping with an active
+  mask, so steady-state decode compiles are zero whatever the traffic does
+  (``compile_counts`` is a trace-time counter the guardrail pins).
+- the sampled token feeds back as a DEVICE array — the steady-state loop
+  never syncs to host (``lint-decode-host-sync``); token values are only
+  fetched at retire/refill time.
+
+Weight hot-swap (``HOROVOD_DECODE_SWAP_POLICY``): the engine reads
+``registry.current()`` once per step (RCU — one attribute read). On a new
+manifest it either
+
+- **refill** (default): re-prefills every live slot's sequence-so-far
+  under the new weights into freshly allocated blocks — the block-table
+  *remap* path; the refill stall is exactly what the p99
+  latency-under-swap rail in ``benchmarks/serving.py`` measures. A live
+  sequence that has outgrown the largest prefill bucket is retired early
+  with the tokens it has (``truncated`` on the request).
+- **drain**: stops admitting, finishes every in-flight slot on the OLD
+  weights (the held ``ServedModel`` reference keeps them consistent), and
+  adopts the new ones once idle.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from . import constants as SC
+
+FREE = "free"
+ACTIVE = "active"
+
+
+class BlockAllocator:
+    """Free-list allocator over KV pool blocks ``1..n_blocks-1`` (block 0
+    is the reserved null block)."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.n_blocks = int(n_blocks)
+        self._free = list(range(self.n_blocks - 1, 0, -1))
+        self._held = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """One block id, or None when the pool is exhausted."""
+        if not self._free:
+            return None
+        b = self._free.pop()
+        self._held.add(b)
+        return b
+
+    def alloc_many(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks all-or-nothing (admission must not half-allocate)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._held.update(out)
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._held:
+                raise ValueError(f"double free / foreign block {b}")
+            self._held.discard(b)
+            self._free.append(int(b))
+
+
+class DecodeRequest:
+    """One generation request: submitted, admitted into a slot, completed
+    at retire (``event`` fires; ``tokens`` = prompt + generated)."""
+
+    __slots__ = ("prompt", "max_new", "event", "tokens", "error",
+                 "truncated", "model_seq", "t0", "ttft_s")
+
+    def __init__(self, prompt: Sequence[int], max_new: int):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.event = threading.Event()
+        self.tokens: Optional[List[int]] = None
+        self.error: Optional[str] = None
+        self.truncated = False
+        self.model_seq: Optional[int] = None
+        self.t0 = time.perf_counter()
+        self.ttft_s: Optional[float] = None
+
+
+class _Slot:
+    __slots__ = ("state", "req", "pos", "table", "gen", "gen_toks",
+                 "stalled")
+
+    def __init__(self):
+        self.state = FREE
+        self.req: Optional[DecodeRequest] = None
+        self.pos = 0
+        self.table: List[int] = []
+        self.gen = 0
+        #: generated-token device refs, in order: (array, idx) picks
+        #: ``array[idx]``; idx None means a scalar array
+        self.gen_toks: List[Tuple[Any, Optional[int]]] = []
+        self.stalled = False
+
+
+class DecodeEngine:
+    """Continuous batching over one model config. Weights come from a
+    ``ModelRegistry`` (hot-swappable) or a statically installed params
+    pytree (``install_params`` — each call counts as a swap, which is how
+    the swap-mid-decode tests drive both policies without a CAS store)."""
+
+    def __init__(self, cfg, registry=None, params=None, *,
+                 slots: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 pool_blocks: Optional[int] = None,
+                 max_blocks_per_slot: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 swap_policy: Optional[str] = None):
+        import jax
+        from ..models import decode as MD
+        from .server import pad_to_bucket
+
+        self.cfg = cfg
+        self.registry = registry
+        self._pad_to_bucket = pad_to_bucket
+        self.n_slots = SC.decode_slots() if slots is None else int(slots)
+        self.block_size = SC.decode_block_size() if block_size is None \
+            else int(block_size)
+        n_blocks = SC.decode_pool_blocks() if pool_blocks is None \
+            else int(pool_blocks)
+        self.max_blocks_per_slot = SC.decode_max_blocks_per_slot() \
+            if max_blocks_per_slot is None else int(max_blocks_per_slot)
+        self.prefill_buckets = tuple(sorted(
+            int(b) for b in (prefill_buckets or SC.decode_prefill_buckets())))
+        self.swap_policy = swap_policy or SC.decode_swap_policy()
+        if self.swap_policy not in ("refill", "drain"):
+            raise ValueError(f"swap policy {self.swap_policy!r}: use "
+                             "'refill' or 'drain'")
+        for b in self.prefill_buckets:
+            if b % self.block_size:
+                raise ValueError(f"prefill bucket {b} not a multiple of "
+                                 f"block_size {self.block_size}")
+        self.max_context = self.max_blocks_per_slot * self.block_size
+        if self.prefill_buckets[-1] > self.max_context:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} exceeds "
+                f"per-slot context {self.max_context} "
+                f"(max_blocks_per_slot * block_size)")
+
+        self.allocator = BlockAllocator(n_blocks)
+        self.slots = [_Slot() for _ in range(self.n_slots)]
+        self._pending: "collections.deque[DecodeRequest]" = \
+            collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closing = False
+
+        self._params = params
+        self._model_seq: Optional[int] = 0 if params is not None else None
+        self._installed_seq = 0 if params is not None else None
+        self._drain_target = None   # (params, seq) awaiting idle adoption
+
+        #: trace-time side-effect counters — each increment runs ONCE per
+        #: compile, so steady state pins ``decode`` exactly (the guardrail)
+        self.compile_counts = {"decode": 0, "prefill": 0}
+        _base_decode = MD.make_decode_step(cfg, self.block_size)
+        _base_prefill = MD.make_prefill(cfg, self.block_size)
+
+        def _decode_traced(p, kp, vp, toks, pos, tables, active):
+            self.compile_counts["decode"] += 1
+            return _base_decode(p, kp, vp, toks, pos, tables, active)
+
+        def _prefill_traced(p, kp, vp, toks, block_ids):
+            self.compile_counts["prefill"] += 1
+            return _base_prefill(p, kp, vp, toks, block_ids)
+
+        self._decode = jax.jit(_decode_traced, donate_argnums=(1, 2))
+        self._prefill = jax.jit(_prefill_traced, donate_argnums=(1, 2))
+        self._jnp = jax.numpy
+        self._kp, self._vp = MD.init_kv_pools(cfg, n_blocks, self.block_size)
+        self._dev_tokens = self._jnp.zeros((self.n_slots,), self._jnp.int32)
+        self._positions = np.zeros(self.n_slots, np.int32)
+        self._tables = np.zeros((self.n_slots, self.max_blocks_per_slot),
+                                np.int32)
+        self._active = np.zeros(self.n_slots, bool)
+
+    # -- weights --------------------------------------------------------------
+
+    def install_params(self, params) -> None:
+        """Static-weights mode: (re)install a params pytree; each call
+        after the first is observed as a hot-swap by the step loop."""
+        with self._lock:
+            self._installed = params
+            self._installed_seq = (self._installed_seq or 0) + 1
+        self._work.set()
+
+    def _current(self):
+        """(params, seq) from the registry, install_params, or the
+        constructor params — one RCU read, no lock on the step path."""
+        if self.registry is not None:
+            cur = self.registry.current()
+            if cur is None:
+                return None, None
+            return cur.payload, cur.manifest_seq
+        if getattr(self, "_installed", None) is not None:
+            return self._installed, self._installed_seq
+        return self._params, self._model_seq
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new: Optional[int] = None) -> DecodeRequest:
+        req = DecodeRequest(prompt, SC.decode_max_new() if max_new is None
+                            else max_new)
+        if not req.prompt or req.max_new < 1:
+            req.error = "empty prompt or max_new < 1"
+            req.event.set()
+            return req
+        if len(req.prompt) > self.prefill_buckets[-1] \
+                or len(req.prompt) + req.max_new > self.max_context:
+            req.error = (f"request needs {len(req.prompt)}+{req.max_new} "
+                         f"positions; max prompt bucket "
+                         f"{self.prefill_buckets[-1]}, context "
+                         f"{self.max_context}")
+            req.event.set()
+            return req
+        bucket = self._pad_to_bucket(len(req.prompt), self.prefill_buckets)
+        if bucket // self.block_size > self.allocator.n_blocks - 1:
+            # Admission could never succeed even on an idle pool — fail
+            # fast instead of queueing forever.
+            req.error = (f"prompt bucket {bucket} needs "
+                         f"{bucket // self.block_size} blocks; pool has "
+                         f"{self.allocator.n_blocks - 1}")
+            req.event.set()
+            return req
+        with self._lock:
+            self._pending.append(req)
+        _telemetry.set_gauge("hvd_serving_decode_queue_depth",
+                             float(len(self._pending)))
+        self._work.set()
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or bool(self._active.any())
+
+    @property
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    # -- the step loop --------------------------------------------------------
+
+    def _runnable(self) -> np.ndarray:
+        return self._active & ~np.asarray(
+            [s.stalled for s in self.slots])
+
+    def decode_once(self) -> bool:
+        """One engine tick: observe swaps, admit, step every active slot.
+        Returns True when a decode step ran."""
+        self._observe_swap()
+        self._admit_pending()
+        if not self._active.any():
+            return False
+        self._extend_tables()
+        runnable = self._runnable()
+        if not runnable.any():
+            # Every active slot is stalled on a block extension with the
+            # free list empty: no step can run, so no retire can ever free
+            # blocks — a permanent deadlock (and a leak) unless broken.
+            # Retire the longest stalled sequence truncated; its blocks
+            # unstall the rest.
+            if self.allocator.free_blocks == 0:
+                self._break_stall()
+                self._extend_tables()
+                runnable = self._runnable()
+            if not runnable.any():
+                return False
+        jnp = self._jnp
+        logits, nt, self._kp, self._vp = self._decode(
+            self._params, self._kp, self._vp, self._dev_tokens,
+            jnp.asarray(self._positions), jnp.asarray(self._tables),
+            jnp.asarray(runnable))
+        del logits  # sampling is on-device (greedy argmax in the program)
+        # Masked slots (inactive OR stalled) must keep their pending token:
+        # a stalled slot's nt row came from an un-extended table (its K/V
+        # landed in the null block), and consuming it on unstall would
+        # silently fork the stream from greedy.
+        self._dev_tokens = jnp.where(jnp.asarray(runnable), nt,
+                                     self._dev_tokens)
+        stepped = 0
+        for i, slot in enumerate(self.slots):
+            if not runnable[i]:
+                continue
+            slot.gen_toks.append((nt, i))
+            slot.gen += 1
+            slot.pos += 1
+            self._positions[i] = slot.pos
+            stepped += 1
+            if slot.gen >= slot.req.max_new:
+                self._retire(i)
+        _telemetry.inc("hvd_serving_decode_tokens_total", float(stepped))
+        _telemetry.set_gauge("hvd_serving_decode_active_slots",
+                             float(self.active_slots))
+        _telemetry.set_gauge("hvd_serving_decode_free_blocks",
+                             float(self.allocator.free_blocks))
+        return True
+
+    # -- admission / retirement ----------------------------------------------
+
+    def _admit_pending(self) -> None:
+        if self._drain_target is not None:
+            return                      # draining: no admissions
+        while self._pending:
+            idx = next((i for i, s in enumerate(self.slots)
+                        if s.state == FREE), None)
+            if idx is None:
+                return
+            params, seq = self._current()
+            if params is None:
+                return                  # nothing published yet
+            if seq != self._model_seq:
+                # A swap landed between this tick's _observe_swap and
+                # admission. Adopting here would put live slots' OLD-weights
+                # KV pages under NEW weights with no refill/drain — defer
+                # to the next tick so _observe_swap applies the policy.
+                return
+            with self._lock:
+                if not self._pending:
+                    return
+                req = self._pending.popleft()
+            bucket = self._pad_to_bucket(len(req.prompt),
+                                         self.prefill_buckets)
+            blocks = self.allocator.alloc_many(bucket // self.block_size)
+            if blocks is None:
+                with self._lock:
+                    self._pending.appendleft(req)
+                _telemetry.inc("hvd_serving_decode_admit_stalls_total")
+                return                  # pool exhausted: retry next tick
+            ft = self._run_prefill(req.prompt, blocks, bucket)
+            slot = self.slots[idx]
+            slot.state = ACTIVE
+            slot.req = req
+            slot.pos = len(req.prompt)
+            slot.table = blocks
+            slot.gen = 1
+            slot.gen_toks = [(ft, None)]
+            slot.stalled = False
+            self._positions[idx] = slot.pos
+            self._tables[idx] = 0
+            self._tables[idx, :len(blocks)] = blocks
+            self._active[idx] = True
+            self._dev_tokens = self._dev_tokens.at[idx].set(ft)
+            # TTFT is honest: the first token is materialized before the
+            # request is declared admitted (prefill is the one place the
+            # engine may sync — never the decode loop)
+            ft.block_until_ready()
+            req.ttft_s = time.perf_counter() - req.t0
+            _telemetry.inc("hvd_serving_decode_admitted_total")
+            _telemetry.observe("hvd_serving_decode_ttft_seconds", req.ttft_s)
+            if slot.gen >= req.max_new:
+                self._retire(idx)
+
+    def _run_prefill(self, prompt: Sequence[int], blocks: Sequence[int],
+                     bucket: int):
+        """Prefill ``prompt`` into ``blocks``; returns the first generated
+        token as a DEVICE scalar."""
+        jnp = self._jnp
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        logits, self._kp, self._vp = self._prefill(
+            self._params, self._kp, self._vp, jnp.asarray(padded),
+            jnp.asarray(np.asarray(blocks, np.int32)))
+        return jnp.argmax(logits[0, len(prompt) - 1]).astype(jnp.int32)
+
+    def _extend_tables(self) -> None:
+        """Grow any slot whose next write position crosses into an
+        unallocated block; a slot that cannot get one STALLS (masked out)
+        until a retire frees capacity — never a recompile, never an OOM.
+        If EVERY active slot stalls with the free list empty no retire
+        could ever happen; ``decode_once`` breaks that deadlock via
+        ``_break_stall``."""
+        for i, slot in enumerate(self.slots):
+            if slot.state != ACTIVE:
+                continue
+            need = slot.pos // self.block_size
+            if need < len(slot.table):
+                slot.stalled = False
+                continue
+            b = self.allocator.alloc()
+            if b is None:
+                if not slot.stalled:
+                    slot.stalled = True
+                    _telemetry.inc("hvd_serving_decode_block_stalls_total")
+                continue
+            slot.table.append(b)
+            self._tables[i, len(slot.table) - 1] = b
+            slot.stalled = False
+
+    def _break_stall(self) -> None:
+        """All active slots stalled with zero free blocks: retire the
+        longest sequence truncated (it has the most tokens to deliver and
+        frees the most blocks) so the remaining slots can extend."""
+        idx = max((i for i, s in enumerate(self.slots) if s.state == ACTIVE),
+                  key=lambda i: self.slots[i].pos, default=None)
+        if idx is None:
+            return
+        get_logger().warning(
+            "decode pool deadlocked (all %d active slots stalled, 0 free "
+            "blocks): retiring slot %d truncated at pos %d",
+            self.active_slots, idx, self.slots[idx].pos)
+        _telemetry.inc("hvd_serving_decode_stall_breaks_total")
+        self._retire(idx, truncated=True)
+
+    def _slot_token_values(self, slot: _Slot) -> List[int]:
+        """Fetch the slot's generated tokens (host sync — retire/refill
+        paths only, never the decode loop)."""
+        vals = np.asarray(self._jnp.stack(
+            [a if i is None else a[i] for a, i in slot.gen_toks]))
+        return [int(v) for v in vals]
+
+    def _retire(self, idx: int, truncated: bool = False) -> None:
+        slot = self.slots[idx]
+        req = slot.req
+        req.tokens = req.prompt + self._slot_token_values(slot)
+        req.truncated = truncated
+        req.model_seq = self._model_seq
+        req.event.set()
+        self.allocator.free(slot.table)
+        slot.state = FREE
+        slot.req = None
+        slot.table = []
+        slot.gen_toks = []
+        slot.stalled = False
+        slot.pos = 0
+        slot.gen = 0
+        self._active[idx] = False
+        self._positions[idx] = 0
+        self._tables[idx] = 0
+        _telemetry.inc("hvd_serving_decode_retired_total")
+        if self._drain_target is not None and not self._active.any():
+            self._params, self._model_seq = self._drain_target
+            self._drain_target = None
+            _telemetry.inc("hvd_serving_decode_drain_adoptions_total")
+
+    # -- hot-swap -------------------------------------------------------------
+
+    def _observe_swap(self) -> None:
+        params, seq = self._current()
+        if params is None or seq == self._model_seq:
+            return
+        if self._model_seq is None or not self._active.any():
+            self._params, self._model_seq = params, seq  # trivial adoption
+            self._drain_target = None
+            return
+        if self.swap_policy == "drain":
+            self._drain_target = (params, seq)
+            return
+        # refill: adopt now, remap every live slot's blocks under the new
+        # weights (the p99-latency-under-swap cost the bench rails)
+        self._params, self._model_seq = params, seq
+        self._drain_target = None
+        t0 = time.perf_counter()
+        n = self._refill_live_slots()
+        _telemetry.inc("hvd_serving_decode_refills_total", float(n))
+        _telemetry.observe("hvd_serving_decode_refill_seconds",
+                           time.perf_counter() - t0)
+
+    def _refill_live_slots(self) -> int:
+        refilled = 0
+        for i, slot in enumerate(self.slots):
+            if slot.state != ACTIVE:
+                continue
+            seq_toks = slot.req.prompt + self._slot_token_values(slot)
+            if len(seq_toks) > self.prefill_buckets[-1]:
+                # sequence has outgrown the prefill program set: finish it
+                # with what it has rather than serve mixed-generation KV
+                self._retire(i, truncated=True)
+                continue
+            bucket = self._pad_to_bucket(len(seq_toks),
+                                         self.prefill_buckets)
+            self.allocator.free(slot.table)
+            slot.table = []
+            blocks = self.allocator.alloc_many(bucket // self.block_size)
+            if blocks is None:          # cannot re-place: finish early
+                self._retire(i, truncated=True)
+                continue
+            ft = self._run_prefill(seq_toks, blocks, bucket)
+            slot.table = blocks
+            slot.pos = len(seq_toks)
+            slot.gen += 1
+            slot.gen_toks.append((ft, None))
+            self._positions[i] = slot.pos
+            self._tables[i] = 0
+            self._tables[i, :len(blocks)] = blocks
+            self._dev_tokens = self._dev_tokens.at[i].set(ft)
+            refilled += 1
+            if slot.gen >= slot.req.max_new:
+                self._retire(i)
+        return refilled
+
+    # -- background serving --------------------------------------------------
+
+    def start(self) -> None:
+        """Run the step loop on a daemon thread (server integration)."""
+        if self._thread is not None:
+            return
+
+        def _loop():
+            while not self._closing:
+                try:
+                    # Wait whenever no step ran — even with work pending
+                    # (e.g. admission blocked on the pool or on a swap):
+                    # nothing changes until a tick or an external event
+                    # sets _work, so spinning would just burn the core.
+                    if not self.decode_once():
+                        self._work.wait(timeout=0.05)
+                        self._work.clear()
+                except Exception as err:  # noqa: BLE001 — containment
+                    get_logger().error("decode engine tick failed: %s", err)
+                    self._fail_all(str(err))
+
+        self._thread = threading.Thread(target=_loop, name="hvd-decode",
+                                        daemon=True)
+        self._thread.start()
+
+    def _fail_all(self, msg: str) -> None:
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for i, slot in enumerate(self.slots):
+            if slot.state == ACTIVE:
+                slot.req.error = msg
+                slot.req.event.set()
+                self.allocator.free(slot.table)
+                slot.state = FREE
+                slot.req = None
+                slot.table = []
+                slot.gen_toks = []
+                self._active[i] = False
+        for req in pending:
+            req.error = msg
+            req.event.set()
+
+    def close(self) -> None:
+        self._closing = True
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def run_until_idle(self, max_steps: int = 10_000) -> None:
+        """Drive the loop inline until every request completes (tests)."""
+        for _ in range(max_steps):
+            if not self.has_work():
+                return
+            self.decode_once()
+        raise RuntimeError(f"engine still busy after {max_steps} steps")

@@ -77,6 +77,7 @@ class ModelRegistry:
         self._clock = clock
         self._current: Optional[ServedModel] = None
         self._leaf_cache: Dict[str, Any] = {}
+        self._swap_listeners: list = []
         #: adoption accounting, asserted by the delta-fetch unit tests
         self.stats: Dict[str, int] = {
             "blobs_fetched": 0, "leaves_reused": 0,
@@ -90,6 +91,13 @@ class ModelRegistry:
         hold the returned reference for the whole request so a concurrent
         swap cannot mix generations within it."""
         return self._current
+
+    def add_swap_listener(self, fn: Callable[[ServedModel], None]) -> None:
+        """Register a callback run after every successful swap (e.g. the
+        decode engine's wake — pollers don't need this; ``current()`` is
+        the RCU surface). Listener exceptions are contained: a bad
+        listener cannot block a swap."""
+        self._swap_listeners.append(fn)
 
     def staleness_s(self) -> Optional[float]:
         """now − publish time of the served model (the
@@ -165,6 +173,11 @@ class ModelRegistry:
         get_logger().info(
             "hot-swapped to manifest_seq=%d (%d blobs fetched, %d leaves "
             "reused, %.1f ms)", seq, fetched, reused, dt * 1e3)
+        for fn in self._swap_listeners:
+            try:
+                fn(self._current)
+            except Exception as err:  # noqa: BLE001 — listener containment
+                get_logger().warning("swap listener failed: %s", err)
         return True
 
     def _materialize(self, store: BlobStore, manifest: Dict):

@@ -21,10 +21,12 @@ results`` (see examples/online_dlrm.py) — so this server stays
 workload-agnostic.
 
 Surfaces: ``POST /predict`` (JSON request in, JSON result out),
-``GET /healthz``, and ``GET /metrics`` — the same Prometheus text
-exposition the coordinator serves (core/telemetry.py), carrying the
-``hvd_serving_*`` swap/staleness/queue/latency series under this
-process's serving rank label.
+``POST /generate`` (autoregressive decode through the continuous-batching
+engine when one is attached — serving/decode.py), ``GET /healthz``, and
+``GET /metrics`` — the same Prometheus text exposition the coordinator
+serves (core/telemetry.py), carrying the ``hvd_serving_*``
+swap/staleness/queue/latency series under this process's serving rank
+label.
 """
 
 from __future__ import annotations
@@ -82,9 +84,20 @@ class InferenceServer:
                  buckets: Optional[Sequence[int]] = None,
                  window_s: Optional[float] = None,
                  request_timeout_s: float = 30.0,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None,
+                 decode_engine: Optional[Any] = None):
         self.registry = registry
         self._forward = forward
+        # Optional continuous-batching decode engine (serving/decode.py):
+        # /generate admits into its slot array; its step loop runs on the
+        # engine's own thread so prefill stalls never block /predict.
+        self.decode_engine = decode_engine
+        if decode_engine is not None:
+            if decode_engine.registry is None:
+                decode_engine.registry = registry
+            registry.add_swap_listener(
+                lambda _cur: decode_engine._work.set())
+            decode_engine.start()
         self._buckets = tuple(sorted(int(b) for b in (buckets
                                                       or SC.buckets())))
         self._window_s = SC.batch_window_s() if window_s is None \
@@ -137,6 +150,9 @@ class InferenceServer:
                 self._reply({"error": "not found"}, 404)
 
             def do_POST(self):
+                if self.path == "/generate":
+                    self._do_generate()
+                    return
                 if self.path != "/predict":
                     self._reply({"error": "not found"}, 404)
                     return
@@ -162,6 +178,37 @@ class InferenceServer:
                 self._reply({"ok": True,
                              "result": jsonable(pending.result),
                              "model_seq": pending.model_seq})
+
+            def _do_generate(self):
+                if srv.decode_engine is None:
+                    self._reply({"ok": False,
+                                 "error": "no decode engine attached"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = [int(t) for t in body["tokens"]]
+                    max_new = body.get("max_new")
+                    if max_new is not None:
+                        max_new = int(max_new)
+                except (ValueError, KeyError, TypeError):
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": "bad json"}, 400)
+                    return
+                req = srv.decode_engine.submit(prompt, max_new)
+                if not req.event.wait(srv._request_timeout_s):
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": "timeout"}, 504)
+                    return
+                if req.error is not None:
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": req.error}, 503)
+                    return
+                _telemetry.inc("hvd_serving_requests_total")
+                self._reply({"ok": True, "tokens": req.tokens,
+                             "truncated": req.truncated,
+                             "ttft_s": req.ttft_s,
+                             "model_seq": req.model_seq})
 
         self._server = ThreadingHTTPServer((bind_host, 0), Handler)
         self._http_thread = threading.Thread(
@@ -285,6 +332,8 @@ class InferenceServer:
 
     def close(self) -> None:
         self._closing = True
+        if self.decode_engine is not None:
+            self.decode_engine.close()
         self._server.shutdown()
         self._server.server_close()
         self._batch_thread.join(timeout=5)

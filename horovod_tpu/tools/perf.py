@@ -66,6 +66,13 @@ RATCHET_BAND_ENV = "HOROVOD_PERF_RATCHET_BAND"
 DEFAULT_RATCHET_BAND = 0.90
 #: Shape rail: budget categories must sum to device wall within this.
 SUM_TOLERANCE = 0.05
+#: Cross-session noise band of the headline ``vs_baseline`` ratio
+#: (bench.py's interleaved plain-vs-hvd paired slopes). Derived in
+#: BASELINE.md §"Headline vs_baseline noise band" from the five driver
+#: readings r01–r05 (0.9996/0.9886/0.9985/0.9999/0.9631): observed
+#: spread 0.037 ≈ 2× the bench's own per-run ±0.02 band. A reading
+#: inside ``1 − band`` is noise; below ``1 − 2×band`` is a real breach.
+DEFAULT_HEADLINE_BAND = 0.04
 
 # ------------------------------------------------------- xplane trap lore
 
@@ -533,6 +540,15 @@ def ratchet_check(history: List[Dict[str, Any]],
     a measured compute-tier win (remat policy, scan mode, accumulation)
     becomes a floor the moment it lands. They are excluded from the MFU
     grouping: a ratio record carries no budget or MFU of its own.
+
+    ``kind: "headline_vs_baseline"`` records rail the bench.py headline
+    hvd-vs-plain ratio against its CROSS-SESSION noise band (the record's
+    own ``band`` field, else :data:`DEFAULT_HEADLINE_BAND`) rather than
+    against a best-ever floor — the ratio's ideal is 1.0, not monotone
+    growth, so ratcheting it would reward noise. The latest reading fails
+    below ``1 − 2×band`` (a real overhead regression, e.g. the 0.9631
+    r05 reading sat exactly at the edge of noise) and warns below
+    ``1 − band``. Also excluded from the MFU grouping.
     Returns ``(ok, messages)``.
     """
     if band is None:
@@ -543,8 +559,18 @@ def ratchet_check(history: List[Dict[str, Any]],
     by_model: Dict[str, List[Dict[str, Any]]] = collections.defaultdict(list)
     by_arm: Dict[Tuple[str, str],
                  List[Dict[str, Any]]] = collections.defaultdict(list)
+    headline: List[Dict[str, Any]] = []
     for rec in history:
         model = rec.get("model")
+        if rec.get("kind") == "headline_vs_baseline":
+            value = rec.get("value")
+            if not isinstance(value, (int, float)):
+                ok = False
+                msgs.append("FAIL shape [headline_vs_baseline]: record "
+                            f"needs a numeric value, got {rec}")
+                continue
+            headline.append(rec)
+            continue
         if rec.get("kind") == "perf_ratio":
             ratio = rec.get("ratio")
             if not model or not rec.get("arm") \
@@ -607,6 +633,27 @@ def ratchet_check(history: List[Dict[str, Any]],
         else:
             msgs.append(f"ok [{model}/{arm}]: ratio {latest:.4f} is the "
                         f"floor (band {band})")
+    if headline:
+        rec = headline[-1]
+        value = rec["value"]
+        band_rec = rec.get("band")
+        if not isinstance(band_rec, (int, float)) or band_rec <= 0:
+            band_rec = DEFAULT_HEADLINE_BAND
+        label = rec.get("metric") or "headline"
+        if value < 1.0 - 2 * band_rec:
+            ok = False
+            msgs.append(f"FAIL headline [{label}]: vs_baseline "
+                        f"{value:.4f} < {1.0 - 2 * band_rec:.4f} "
+                        f"(1 − 2×band, band ±{band_rec:.2f} — a real "
+                        "overhead regression, not session noise)")
+        elif value < 1.0 - band_rec:
+            msgs.append(f"warn headline [{label}]: vs_baseline "
+                        f"{value:.4f} inside the noise tail "
+                        f"(1 − 2×band ≤ value < 1 − band, "
+                        f"band ±{band_rec:.2f}) — watch the next reading")
+        else:
+            msgs.append(f"ok headline [{label}]: vs_baseline "
+                        f"{value:.4f} within ±{band_rec:.2f} of parity")
     return ok, msgs
 
 

@@ -14,8 +14,9 @@ produce which jitted programs, and why donation survives each.
 
 from .step_builder import (STEP_COST_ANALYSIS_ENV, PipelineTrainState,
                            accumulate_gradients, build_program_set,
-                           create_pipeline_train_state, fold_scan,
-                           make_dispatch, make_pipeline_train_step)
+                           create_pipeline_train_state, export_decode_params,
+                           fold_scan, make_dispatch,
+                           make_pipeline_train_step)
 from .dp import TrainState, create_train_state, make_train_step
 from .gspmd import (GSPMDTrainState, create_gspmd_train_state,
                     gspmd_shardings, make_gspmd_deferred_train_step,
@@ -27,6 +28,7 @@ __all__ = [
     "accumulate_gradients",
     "build_program_set",
     "create_pipeline_train_state",
+    "export_decode_params",
     "fold_scan",
     "make_dispatch",
     "make_pipeline_train_step",

@@ -387,3 +387,23 @@ def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         run.lower_apply = programs["apply"].lower
         run.lower_skip = programs["skip"].lower
     return monitored_step(run, what="pipeline_train_step")
+
+
+def export_decode_params(state_or_params):
+    """The training → serving export seam: the plain params pytree the
+    decode path (models/decode.py) consumes.
+
+    Accepts a train state (anything with ``.params``) or a params pytree,
+    strips the optimizer state by construction, and unboxes flax
+    partitioning metadata (``nn.meta.unbox``) so the serve side sees bare
+    arrays — the same shape the CAS publisher stores and the registry's
+    ``prepare_leaf`` re-devices. Works for both checkpoint layouts
+    (unrolled ``block_i`` and scanned ``layers`` stacks); no sharding or
+    donation survives the seam on purpose: serving re-places leaves on its
+    own mesh.
+    """
+    import flax.linen as nn
+    params = getattr(state_or_params, "params", state_or_params)
+    if isinstance(params, dict) and "params" in params:
+        params = params["params"]
+    return nn.meta.unbox(params)

@@ -212,6 +212,7 @@ LINT_CASES = [
     ("bad_unbounded_poll.py", "lint-unbounded-poll", "warning"),
     ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
     ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
+    ("bad_decode_host_sync.py", "lint-decode-host-sync", "warning"),
     ("bad_recompile_request_path.py", "lint-recompile-in-request-path",
      "warning"),
     ("bad_xplane_umbrella.py", "lint-xplane-umbrella", "warning"),

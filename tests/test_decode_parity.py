@@ -1,0 +1,226 @@
+"""Numerics parity: decode-with-paging == full forward (ISSUE 13).
+
+The paged decode path (models/decode.py) is a pure-jnp mirror of the
+flax modules operating on gathered KV pages; these tests pin it against
+``model.apply`` for BOTH autoregressive models:
+
+- prefill logits == full-forward logits on the padded prompt;
+- every decode step's logits == the full forward over the true sequence
+  so far (position by position, through block boundaries);
+- the engine's end-to-end greedy tokens == a flax greedy loop;
+- swap-mid-decode: under the refill policy a re-publish of the SAME
+  weights must not perturb the greedy continuation (the block-table
+  remap + re-prefill is numerically transparent), and under drain the
+  in-flight sequence finishes on the OLD weights exactly.
+
+Mixtral runs with ``capacity_factor=8.0`` so neither path drops routed
+tokens — parity is about the cache, not the router's lossy capacity.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from horovod_tpu.models import decode as MD
+
+RTOL, ATOL = 3e-5, 5e-5
+
+
+def _build(kind: str, seed: int = 0):
+    if kind == "llama":
+        from horovod_tpu.models.llama import Llama, llama_tiny
+        cfg = llama_tiny()
+        model = Llama(cfg)
+    else:
+        from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+        cfg = dataclasses.replace(mixtral_tiny(), capacity_factor=8.0)
+        model = Mixtral(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 16), jnp.int32)))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture(scope="module")
+def llama():
+    return _build("llama")
+
+
+@pytest.fixture(scope="module")
+def mixtral():
+    return _build("mixtral")
+
+
+def _full_logits(model, params, seq):
+    return np.asarray(model.apply(
+        {"params": params}, jnp.asarray([seq], jnp.int32))[0])
+
+
+def _flax_greedy(model, params, prompt, n_new):
+    seq = list(prompt)
+    for _ in range(n_new):
+        seq.append(int(np.argmax(_full_logits(model, params, seq)[-1])))
+    return seq
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_prefill_matches_full_forward(kind, llama, mixtral):
+    cfg, model, params = llama if kind == "llama" else mixtral
+    bs = 4
+    prompt = [3, 14, 15, 9, 2, 6, 5, 35, 8, 97, 93, 2, 38]
+    bucket = 16
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :len(prompt)] = prompt
+    kp, vp = MD.init_kv_pools(cfg, 16, bs)
+    prefill = jax.jit(MD.make_prefill(cfg, bs))
+    logits, kp, vp = prefill(params, kp, vp, jnp.asarray(padded),
+                             jnp.asarray([1, 2, 3, 4], jnp.int32))
+    want = _full_logits(model, params, list(padded[0]))
+    np.testing.assert_allclose(np.asarray(logits)[0, :len(prompt)],
+                               want[:len(prompt)], rtol=RTOL, atol=ATOL)
+    # Null block untouched by the bulk write.
+    assert not np.asarray(kp[:, 0]).any() and not np.asarray(vp[:, 0]).any()
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_decode_steps_match_full_forward(kind, llama, mixtral):
+    """Five paged decode steps (S=2, one slot INACTIVE pointing at the
+    null block) — each step's live-row logits must match the full
+    forward over the true sequence, across a block boundary."""
+    cfg, model, params = llama if kind == "llama" else mixtral
+    bs, bmax = 4, 8
+    prompt = [7, 1, 4, 12, 9, 30, 2]             # len 7: bucket 8, 2 blocks
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :len(prompt)] = prompt
+    kp, vp = MD.init_kv_pools(cfg, 16, bs)
+    prefill = jax.jit(MD.make_prefill(cfg, bs))
+    decode = jax.jit(MD.make_decode_step(cfg, bs))
+    logits, kp, vp = prefill(params, kp, vp, jnp.asarray(padded),
+                             jnp.asarray([1, 2], jnp.int32))
+    seq = prompt + [int(np.argmax(np.asarray(logits)[0, len(prompt) - 1]))]
+    table = [1, 2]
+    tables = np.zeros((2, bmax), np.int32)
+    active = jnp.asarray([True, False])
+    next_free = 3
+    for _ in range(5):
+        pos = len(seq) - 1                       # where the new K/V lands
+        if pos // bs >= len(table):
+            table.append(next_free)
+            next_free += 1
+        tables[0, :len(table)] = table
+        logits, nt, kp, vp = decode(
+            params, kp, vp, jnp.asarray([seq[-1], 0], jnp.int32),
+            jnp.asarray([pos, 0], jnp.int32), jnp.asarray(tables), active)
+        want = _full_logits(model, params, seq)[-1]
+        np.testing.assert_allclose(
+            np.asarray(logits)[0],  # hvd-analyze: ok — numerics parity
+            want, rtol=RTOL, atol=ATOL)
+        assert int(nt[0]) == int(np.argmax(want))
+        seq.append(int(nt[0]))
+    # The inactive slot's per-step writes are zero-masked: the null block
+    # is STILL all-zero after decode ticks, not just after prefill.
+    assert not np.asarray(kp[:, 0]).any() and not np.asarray(vp[:, 0]).any()
+
+
+def _engine(cfg, params, policy="refill"):
+    from horovod_tpu.serving.decode import DecodeEngine
+    return DecodeEngine(cfg, params=params, slots=2, block_size=4,
+                        pool_blocks=24, max_blocks_per_slot=8,
+                        prefill_buckets=(8, 16), swap_policy=policy)
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_engine_greedy_matches_flax(kind, llama, mixtral):
+    cfg, model, params = llama if kind == "llama" else mixtral
+    eng = _engine(cfg, params)
+    prompt = [11, 3, 20, 5, 42, 7]
+    req = eng.submit(prompt, 8)
+    eng.run_until_idle()
+    assert req.error is None
+    assert req.tokens == _flax_greedy(model, params, prompt, 8)
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_refill_swap_mid_decode_is_transparent(kind, llama, mixtral):
+    """Re-publishing identical weights mid-decode (refill policy: free
+    the old blocks, re-prefill the sequence-so-far, remap the block
+    table) must not change the greedy continuation."""
+    cfg, model, params = llama if kind == "llama" else mixtral
+    eng = _engine(cfg, params, policy="refill")
+    prompt = [2, 9, 33, 4, 17, 6]
+    req = eng.submit(prompt, 10)
+    for _ in range(4):
+        eng.decode_once()
+    eng.install_params(params)                   # same weights, new seq
+    eng.run_until_idle()
+    assert req.error is None and not req.truncated
+    assert req.tokens == _flax_greedy(model, params, prompt, 10)
+    assert eng.allocator.free_blocks == 23       # remap freed the originals
+
+
+def test_drain_swap_finishes_on_old_weights(llama):
+    """Drain policy: a swap mid-decode is deferred — the in-flight
+    sequence completes on the OLD weights verbatim; the NEW weights serve
+    the next admission."""
+    cfg, model, params_a = llama
+    _, _, params_b = _build("llama", seed=7)
+    eng = _engine(cfg, params_a, policy="drain")
+    prompt = [13, 8, 21, 34, 55, 3]
+    req = eng.submit(prompt, 10)
+    for _ in range(3):
+        eng.decode_once()
+    eng.install_params(params_b)
+    eng.run_until_idle()
+    assert req.tokens == _flax_greedy(model, params_a, prompt, 10)
+    req2 = eng.submit(prompt, 6)                 # drained: B now serves
+    eng.run_until_idle()
+    assert req2.tokens == _flax_greedy(model, params_b, prompt, 6)
+
+
+def test_stall_mid_generation_preserves_greedy_stream(llama):
+    """A slot stalled on block extension must resume with ITS pending
+    token intact — the decode program's next-token row for a stalled slot
+    comes from an un-extended table (K/V in the null block) and consuming
+    it would silently fork the stream (REVIEW: _dev_tokens clobber).
+    Token VALUES, not counts, against the flax greedy loop."""
+    cfg, model, params = llama
+    from horovod_tpu.serving.decode import DecodeEngine
+    eng = DecodeEngine(cfg, params=params, slots=2, block_size=4,
+                       pool_blocks=4, max_blocks_per_slot=4,
+                       prefill_buckets=(4, 8), swap_policy="refill")
+    a = eng.submit([1, 2], 10)        # bucket 4: 1 block, extends at pos 4
+    b = eng.submit([3, 4, 5, 6], 4)   # bucket 8: 2 blocks, never extends
+    stalled_seen = False
+    for _ in range(100):
+        if not eng.has_work():
+            break
+        eng.decode_once()
+        stalled_seen = stalled_seen or eng.slots[0].stalled
+    assert stalled_seen, "slot A never stalled — the scenario regressed"
+    assert a.error is None and not a.truncated
+    assert b.error is None and not b.truncated
+    assert a.tokens == _flax_greedy(model, params, [1, 2], 10)
+    assert b.tokens == _flax_greedy(model, params, [3, 4, 5, 6], 4)
+
+
+def test_refill_outgrown_sequence_retires_truncated(llama):
+    """A live sequence longer than the largest prefill bucket cannot be
+    remapped under new weights — it retires early with ``truncated``."""
+    cfg, model, params = llama
+    from horovod_tpu.serving.decode import DecodeEngine
+    eng = DecodeEngine(cfg, params=params, slots=1, block_size=4,
+                       pool_blocks=16, max_blocks_per_slot=6,
+                       prefill_buckets=(8,), swap_policy="refill")
+    req = eng.submit([1, 2, 3, 4, 5], 12)
+    for _ in range(5):                           # sequence grows past 8
+        eng.decode_once()
+    eng.install_params(params)
+    eng.run_until_idle()
+    assert req.truncated and req.error is None
+    assert 5 < len(req.tokens) <= 5 + 12
+    # The truncated prefix still matches the untruncated greedy stream.
+    full = _flax_greedy(model, params, [1, 2, 3, 4, 5], 12)
+    assert req.tokens == full[:len(req.tokens)]

@@ -1,0 +1,247 @@
+"""Paged KV-cache allocator properties + the slot admit/retire state
+machine (serving/decode.py — ISSUE 13).
+
+The allocator half is pure Python: free-list discipline over blocks
+``1..n-1`` with block 0 reserved as the null block, all-or-nothing
+admission allocation, and the no-fragmentation-by-construction property
+(any free block serves any slot, so allocation fails only on genuine
+exhaustion). The engine half drives ``DecodeEngine`` inline
+(``decode_once``/``run_until_idle``) on llama_tiny: admission into free
+slots, queueing past the slot width, requeue on pool exhaustion,
+mid-decode block-extension stalls that recover when a retire frees
+capacity, and the bounded compile counts the serving guardrail pins.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.serving.decode import ACTIVE, FREE, BlockAllocator
+
+
+# -- allocator properties -----------------------------------------------------
+
+
+def test_allocator_reserves_null_block():
+    a = BlockAllocator(8)
+    assert a.free_blocks == 7
+    got = [a.alloc() for _ in range(7)]
+    assert sorted(got) == list(range(1, 8))      # block 0 never handed out
+    assert a.alloc() is None                     # exhausted, not an error
+
+
+def test_allocator_rejects_degenerate_pool():
+    with pytest.raises(ValueError):
+        BlockAllocator(1)                        # only the null block
+
+
+def test_alloc_many_all_or_nothing():
+    a = BlockAllocator(6)
+    first = a.alloc_many(3)
+    assert len(first) == 3
+    assert a.alloc_many(3) is None               # only 2 left: no partial
+    assert a.free_blocks == 2                    # nothing half-taken
+    rest = a.alloc_many(2)
+    assert sorted(first + rest) == list(range(1, 6))
+
+
+def test_free_rejects_double_and_foreign():
+    a = BlockAllocator(4)
+    b = a.alloc()
+    a.free([b])
+    with pytest.raises(ValueError):
+        a.free([b])                              # double free
+    with pytest.raises(ValueError):
+        a.free([3])                              # never allocated
+    with pytest.raises(ValueError):
+        a.free([0])                              # the null block
+
+
+def test_allocator_churn_property():
+    """Random alloc/free churn: handed-out ids stay unique and in
+    ``1..n-1``, ``free + held == n-1`` at every step, and after total
+    release the FULL pool is allocatable in one all-or-nothing grab —
+    the no-fragmentation property."""
+    rng = np.random.RandomState(0)
+    n = 32
+    a = BlockAllocator(n)
+    held = []
+    for _ in range(500):
+        if held and rng.rand() < 0.45:
+            k = rng.randint(1, len(held) + 1)
+            batch = [held.pop(rng.randint(len(held))) for _ in range(k)]
+            a.free(batch)
+        else:
+            got = a.alloc_many(rng.randint(1, 5))
+            if got is None:
+                assert a.free_blocks < 4         # only genuine exhaustion
+                continue
+            held.extend(got)
+        assert len(set(held)) == len(held)
+        assert all(1 <= b < n for b in held)
+        assert a.free_blocks + len(held) == n - 1
+    a.free(held)
+    assert len(a.alloc_many(n - 1)) == n - 1
+
+
+# -- the slot state machine ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llama():
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from horovod_tpu.models.llama import Llama, llama_tiny
+
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)))["params"]
+    return cfg, model, params
+
+
+def _engine(cfg, params, **kw):
+    from horovod_tpu.serving.decode import DecodeEngine
+    kw.setdefault("slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("pool_blocks", 16)
+    kw.setdefault("max_blocks_per_slot", 4)
+    kw.setdefault("prefill_buckets", (4, 8))
+    return DecodeEngine(cfg, params=params, **kw)
+
+
+def test_submit_validation(llama):
+    cfg, _, params = llama
+    eng = _engine(cfg, params)
+    for bad in ([], list(range(20))):            # empty / beyond top bucket
+        req = eng.submit(bad, 2)
+        assert req.error is not None and req.event.is_set()
+    req = eng.submit([1, 2], 0)                  # max_new < 1
+    assert req.error is not None
+    req = eng.submit([1, 2], 99)                 # overflows slot context
+    assert req.error is not None
+    assert eng.active_slots == 0 and not eng.has_work()
+
+
+def test_admit_retire_roundtrip(llama):
+    cfg, _, params = llama
+    eng = _engine(cfg, params)
+    free0 = eng.allocator.free_blocks
+    req = eng.submit([5, 6, 7], 4)
+    eng.run_until_idle()
+    assert req.event.is_set() and req.error is None
+    assert len(req.tokens) == 3 + 4 and req.tokens[:3] == [5, 6, 7]
+    assert not req.truncated and req.ttft_s > 0
+    assert eng.allocator.free_blocks == free0    # every block returned
+    assert all(s.state == FREE for s in eng.slots)
+    assert eng.active_slots == 0
+
+
+def test_queueing_beyond_slot_width(llama):
+    cfg, _, params = llama
+    eng = _engine(cfg, params)
+    reqs = [eng.submit([1 + i, 2 + i], 3) for i in range(5)]
+    assert len(eng._pending) == 5
+    eng.run_until_idle()
+    for r in reqs:
+        assert r.error is None and len(r.tokens) == 5
+    assert eng.allocator.free_blocks == 15       # 16-block pool, null held
+
+
+def test_admission_requeues_on_pool_exhaustion(llama):
+    """Bucket 8 = 2 blocks; pool holds 2 free: the second request must
+    requeue (all-or-nothing), then admit after the first retires."""
+    cfg, _, params = llama
+    eng = _engine(cfg, params, slots=2, pool_blocks=3,
+                  prefill_buckets=(8,), max_blocks_per_slot=2)
+    a = eng.submit([1, 2, 3, 4, 5], 3)
+    b = eng.submit([6, 7, 8, 9, 10], 3)
+    eng.decode_once()
+    assert eng.active_slots == 1                 # b back on the queue
+    assert len(eng._pending) == 1
+    eng.run_until_idle()
+    assert a.error is None and len(a.tokens) == 8
+    assert b.error is None and len(b.tokens) == 8
+    assert eng.allocator.free_blocks == 2
+
+
+def test_extension_stall_recovers_after_retire(llama):
+    """A live slot that cannot allocate its next block STALLS (masked
+    out, no recompile, no OOM) and resumes once a retire frees capacity."""
+    cfg, _, params = llama
+    eng = _engine(cfg, params, slots=2, pool_blocks=4,
+                  prefill_buckets=(4, 8), max_blocks_per_slot=2)
+    a = eng.submit([1, 2], 6)                    # bucket 4: 1 block, extends
+    b = eng.submit([3, 4, 5, 6], 4)              # bucket 8: 2 blocks, never
+    eng.decode_once()                            # admits both: pool empty
+    assert eng.allocator.free_blocks == 0
+    stalled_seen = False
+    for _ in range(50):
+        if not eng.has_work():
+            break
+        eng.decode_once()
+        stalled_seen = stalled_seen or eng.slots[0].stalled
+    assert stalled_seen, "slot A never hit the block-extension stall"
+    assert a.error is None and len(a.tokens) == 8
+    assert b.error is None and len(b.tokens) == 8
+    assert eng.allocator.free_blocks == 3
+    assert not any(s.stalled for s in eng.slots)
+
+
+def test_all_stalled_deadlock_breaks(llama):
+    """Every active slot stalled on a block extension with the free list
+    empty: no retire could ever happen on its own, so the engine must
+    break the deadlock (retire the longest sequence truncated) instead of
+    hanging forever and leaking slots + blocks (REVIEW: livelock)."""
+    cfg, _, params = llama
+    eng = _engine(cfg, params, slots=2, pool_blocks=3,
+                  prefill_buckets=(4,), max_blocks_per_slot=4)
+    a = eng.submit([1, 2, 3], 8)                 # 1 block each: pool empty
+    b = eng.submit([4, 5, 6], 8)
+    eng.run_until_idle(max_steps=200)            # would raise if deadlocked
+    for r in (a, b):
+        assert r.error is None and r.event.is_set()
+        assert r.truncated                       # pool too small: partial
+        assert len(r.tokens) > 3                 # but tokens were delivered
+    assert eng.allocator.free_blocks == 2        # nothing leaked
+    assert all(s.state == FREE for s in eng.slots)
+    assert not eng.has_work()
+
+
+def test_pool_smaller_than_bucket_fails_fast(llama):
+    """A prompt bucket needing more blocks than the whole pool can never
+    admit — submit fails it immediately instead of queueing forever."""
+    cfg, _, params = llama
+    eng = _engine(cfg, params, slots=1, pool_blocks=2,
+                  prefill_buckets=(4, 8), max_blocks_per_slot=2)
+    req = eng.submit([1, 2, 3, 4, 5], 1)         # bucket 8 = 2 blocks > 1
+    assert req.error is not None and req.event.is_set()
+    assert not eng.has_work()
+
+
+def test_compile_counts_bounded_by_buckets(llama):
+    """Steady state: ONE decode compile ever; prefill compiles == number
+    of distinct buckets traffic touched — never per-request."""
+    cfg, _, params = llama
+    eng = _engine(cfg, params, slots=4)
+    for i in range(3):                           # bucket 4
+        eng.submit([1 + i, 2], 2)
+    eng.run_until_idle()
+    assert eng.compile_counts == {"decode": 1, "prefill": 1}
+    for i in range(4):                           # mixed buckets 4 and 8
+        eng.submit([1 + i] * (3 if i % 2 else 6), 3)
+    eng.run_until_idle()
+    assert eng.compile_counts == {"decode": 1, "prefill": 2}
+
+
+def test_slot_bookkeeping_during_flight(llama):
+    cfg, _, params = llama
+    eng = _engine(cfg, params)
+    eng.submit([9, 8, 7], 5)
+    eng.decode_once()
+    (slot,) = [s for s in eng.slots if s.state == ACTIVE]
+    assert slot.pos > 3 and slot.gen >= 1
+    assert slot.table and all(b != 0 for b in slot.table)
+    eng.run_until_idle()
+    assert slot.state == FREE and slot.table == [] and slot.pos == 0

@@ -292,6 +292,48 @@ def test_perf_ratio_records_excluded_from_mfu_grouping():
     assert not ok and any("FAIL shape [perf_ratio]" in m for m in msgs)
 
 
+def _headline_rec(value, band=None, **extra):
+    rec = {"kind": "headline_vs_baseline",
+           "metric": "resnet50_images_per_sec_per_chip", "value": value}
+    if band is not None:
+        rec["band"] = band
+    rec.update(extra)
+    return rec
+
+
+def test_headline_vs_baseline_rails_against_parity_not_best():
+    # railed against parity (ideal 1.0), NOT best-ever: the r05-style
+    # 0.9631 after a 0.9999 passes — cross-session noise, not regression
+    # (band derivation: BASELINE.md §"Headline vs_baseline noise band")
+    ok, msgs = perf.ratchet_check([_headline_rec(0.9999),
+                                   _headline_rec(0.9631)])
+    assert ok
+    assert any("ok headline" in m for m in msgs)
+    # the noise tail warns: 1 − 2×band ≤ value < 1 − band
+    ok, msgs = perf.ratchet_check([_headline_rec(0.95)])
+    assert ok and any("warn headline" in m for m in msgs)
+    # below 1 − 2×band is a real overhead regression
+    ok, msgs = perf.ratchet_check([_headline_rec(0.91)])
+    assert not ok and any("FAIL headline" in m for m in msgs)
+
+
+def test_headline_vs_baseline_band_and_shape():
+    # the record's own band overrides the default
+    ok, msgs = perf.ratchet_check([_headline_rec(0.91, band=0.10)])
+    assert ok and any("ok headline" in m for m in msgs)
+    # only the LATEST reading is judged, and headline records never join
+    # the MFU grouping (they carry a model-free ratio, not a budget)
+    ok, msgs = perf.ratchet_check(
+        [_headline_rec(0.50), _headline_rec(0.99), _rec("m", mfu=0.5)])
+    assert ok
+    assert any("ok [m]: MFU" in m for m in msgs)
+    # a non-numeric value FAILs shape
+    ok, msgs = perf.ratchet_check(
+        [{"kind": "headline_vs_baseline", "value": "fast"}])
+    assert not ok and any("FAIL shape [headline_vs_baseline]" in m
+                          for m in msgs)
+
+
 def test_ratchet_band_env_is_honored(monkeypatch):
     monkeypatch.setenv(perf.RATCHET_BAND_ENV, "0.5")
     ok, _ = perf.ratchet_check([_rec("m", mfu=0.50), _rec("m", mfu=0.30)])

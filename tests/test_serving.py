@@ -382,6 +382,43 @@ def test_server_buckets_batches_and_serves_hot_swap(tmp_path):
         srv.close()
 
 
+def test_generate_rejects_bad_max_new_with_400():
+    """A non-integer ``max_new`` must be caught by the handler's bad-json
+    path (400 + failure telemetry), never reach ``submit`` unvalidated
+    (REVIEW: uncaught ValueError surfaced as a bare 500)."""
+
+    class _StubEngine:
+        registry = None
+        _work = threading.Event()
+
+        def start(self):
+            pass
+
+        def close(self):
+            pass
+
+        def submit(self, prompt, max_new):
+            raise AssertionError("submit reached with unvalidated max_new")
+
+    reg = ModelRegistry()
+    srv = InferenceServer(reg, lambda payload, inputs, n: [],
+                          window_s=0.0, request_timeout_s=10.0,
+                          decode_engine=_StubEngine())
+    try:
+        body = json.dumps({"tokens": [1, 2], "max_new": "abc"}).encode()
+        req = urllib.request.Request(
+            f"http://{srv.addr()}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read())["error"] == "bad json"
+    finally:
+        srv.close()
+
+
 def test_server_errors_contained_when_no_model_published():
     reg = ModelRegistry()
     srv = InferenceServer(reg, lambda payload, inputs, n: [],

@@ -11,6 +11,15 @@ from its operand sizes and replica groups:
     all_gather:      (g-1)/g * result_bytes
     all_to_all:      (g-1)/g * operand_bytes
 
+``collective_permute`` (VERDICT r5 #6) is the point-to-point primitive
+under Adasum's XOR butterfly, ring attention's K/V rotation, and the
+pipeline stage handoff. It carries ``source_target_pairs`` (NOT
+replica_groups): each (s, t) pair with s != t moves the full operand
+over one link, so per participating device the wire cost is simply
+``operand_bytes`` — reported as ``ring_bytes`` for uniformity, with the
+raw ``pairs`` exposed so tests can pin the topology (XOR partners, +1
+ring, stage i→i+1).
+
 Tests assert these against the same formulas evaluated analytically,
 which pins the wire contract (what rides which fabric, and how much)
 without needing a second chip.
@@ -41,6 +50,10 @@ def collective_wire_costs(hlo_text: str) -> list:
     lines = hlo_text.splitlines()
     out = []
     for i, line in enumerate(lines):
+        pm = re.search(r'"stablehlo\.collective_permute"', line)
+        if pm:
+            out.append(_permute_cost(lines, i))
+            continue
         m = re.search(r'"stablehlo\.(%s)"' % "|".join(_COLLECTIVES), line)
         if not m:
             continue
@@ -74,3 +87,38 @@ def collective_wire_costs(hlo_text: str) -> list:
                     "operand_bytes": operand_bytes,
                     "result_bytes": result_bytes, "ring_bytes": ring})
     return out
+
+
+def _permute_cost(lines: list, i: int) -> dict:
+    """One ``stablehlo.collective_permute``: pairs from
+    ``source_target_pairs = dense<[[s, t], ...]> : tensor<Nx2xi64>``
+    (a single pair prints as ``dense<[s, t]> : tensor<1x2xi64>``); wire
+    cost per participating device = the full operand (point-to-point:
+    no ring discount, a device sends its whole buffer to its target)."""
+    line = lines[i]
+    pm = re.search(
+        r"source_target_pairs = dense<(.*?)> : tensor<(\d+)x2xi64>", line)
+    assert pm, f"no source_target_pairs on permute line: {line[:200]}"
+    pairs = [[int(v) for v in grp.split(",")]
+             for grp in re.findall(r"\[([\d,\s]+)\]", pm.group(1))]
+    if not pairs:               # tensor<1x2xi64> prints without inner []
+        flat = [int(v) for v in pm.group(1).split(",")]
+        pairs = [flat[:2]]
+    assert len(pairs) == int(pm.group(2)), (pairs, line[:200])
+    sig = None
+    for j in range(i, min(i + 16, len(lines))):
+        sm = re.search(r":\s*\(([^)]*)\)\s*->\s*(.+)$", lines[j])
+        if sm and "tensor<" in sm.group(1):
+            sig = sm
+            break
+    assert sig, f"no signature found for collective_permute at line {i}"
+    operand_bytes = sum(_tensor_bytes(s) for s in
+                        re.findall(r"tensor<([^>]+)>", sig.group(1)))
+    result_bytes = sum(_tensor_bytes(s) for s in
+                       re.findall(r"tensor<([^>]+)>", sig.group(2)))
+    return {"op": "collective_permute",
+            "pairs": pairs,
+            "n_links": sum(1 for s, t in pairs if s != t),
+            "operand_bytes": operand_bytes,
+            "result_bytes": result_bytes,
+            "ring_bytes": float(operand_bytes)}
