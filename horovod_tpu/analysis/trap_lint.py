@@ -112,6 +112,19 @@ CLAUDE.md "Environment traps"):
   pragma deliberate per-step probes (latency measurement, numerics
   parity tests).
 
+- ``lint-replicated-kv-pool`` (WARNING): a function that both builds a
+  device mesh (``Mesh``/``create_mesh``/``make_mesh``/...) and allocates
+  paged-KV pools (``init_kv_pools``) without ever placing the pool names
+  onto the mesh (no ``device_put``/``make_array_from_callback``/
+  ``with_sharding_constraint`` sees them).  jit then defaults the pools
+  to REPLICATED: every device holds the full ``[L, blocks, bs, heads,
+  hd]`` cache — tp× the KV memory the head-sharded layout needs — and
+  the shard_map'd decode program reshards them every step.  Place with
+  ``jax.device_put(pool, NamedSharding(mesh, kv_pool_spec()))`` (the
+  engine additionally pins ``Format(Layout(...))`` at the KV gather
+  seams — serving/decode.py, docs/serving.md "Sharded decode"), or
+  pragma a deliberately replicated single-device pool.
+
 - ``lint-accum-psum-order`` (WARNING): a ``lax.scan``/``lax.fori_loop``
   body that both computes gradients (``value_and_grad``/``grad``) and
   reduces them across the mesh (``psum``/``pmean``) — the microbatch
@@ -164,6 +177,18 @@ LEAF_REDUCE_NAMES = frozenset({"psum", "pmean"})
 # candidate microbatch accumulation loop (positional index of the body
 # callable in each call's args).
 ACCUM_LOOP_BODY_ARG = {"scan": 0, "fori_loop": 2}
+
+# lint-replicated-kv-pool vocabulary: the paged-KV pool allocator, the
+# mesh builders whose presence marks a function as multi-device, and the
+# placement calls that count as sharding the allocated pools.
+KV_POOL_ALLOC_NAMES = frozenset({"init_kv_pools"})
+MESH_BUILD_NAMES = frozenset({
+    "Mesh", "create_mesh", "create_hybrid_mesh", "make_mesh",
+    "create_device_mesh", "create_hybrid_device_mesh",
+})
+KV_PLACEMENT_NAMES = frozenset({
+    "device_put", "make_array_from_callback", "with_sharding_constraint",
+})
 
 # lint-unbounded-poll vocabulary: the coordinator poll, and the calls
 # that count as pacing a poll loop (a sleep, a condition/event wait, or
@@ -351,6 +376,9 @@ class _Lint(ast.NodeVisitor):
         # lint-monolithic-psum: same innermost-first attribution for
         # tree-mapped per-leaf psum sites.
         self._monolithic_handled: set = set()
+        # lint-replicated-kv-pool: pool-allocating assigns already
+        # attributed to an inner (mesh-building) function.
+        self._kv_pool_handled: set = set()
         # lint-unbounded-poll: poll sites already attributed to an
         # enclosing while loop (nested loops must not re-flag them).
         self._poll_handled: set = set()
@@ -778,8 +806,59 @@ class _Lint(ast.NodeVisitor):
         # that also computes gradients — the actual train-step body.
         self._check_unguarded_apply(node)
         self._check_monolithic_psum(node)
+        self._check_replicated_kv_pool(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_replicated_kv_pool(self, node):
+        """lint-replicated-kv-pool: KV pools allocated in a function that
+        also builds a mesh, with none of the pool names ever passed to a
+        placement call — jit defaults them to replicated (full cache per
+        device) and the sharded decode program reshards every step.
+        Innermost-first like the other function checks: the smallest
+        enclosing function that builds the mesh owns the finding."""
+        assigns, has_mesh, placed = [], False, set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                last = _dotted(sub.func).split(".")[-1]
+                if last in MESH_BUILD_NAMES:
+                    has_mesh = True
+                elif last in KV_PLACEMENT_NAMES:
+                    for arg in (list(sub.args)
+                                + [kw.value for kw in sub.keywords]):
+                        placed.update(n.id for n in ast.walk(arg)
+                                      if isinstance(n, ast.Name))
+            elif isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and (_dotted(sub.value.func).split(".")[-1]
+                         in KV_POOL_ALLOC_NAMES) \
+                    and id(sub) not in self._kv_pool_handled:
+                names = []
+                for tgt in sub.targets:
+                    elts = tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                        ast.List)) else [tgt]
+                    names.extend(e.id for e in elts
+                                 if isinstance(e, ast.Name))
+                if names:
+                    assigns.append((sub, names))
+        if not has_mesh or not assigns:
+            return  # single-device pools (no mesh) judged by enclosing scope
+        for sub, names in assigns:
+            self._kv_pool_handled.add(id(sub))
+            if not any(n in placed for n in names):
+                self._add(
+                    "lint-replicated-kv-pool", Severity.WARNING, sub,
+                    "KV pools allocated next to a mesh build but never "
+                    "placed on it: jit defaults the pools to REPLICATED, "
+                    "so every device holds the full [L, blocks, bs, "
+                    "heads, hd] cache (tp× the head-sharded HBM) and the "
+                    "shard_map'd decode program reshards it each step — "
+                    "place with jax.device_put(pool, NamedSharding(mesh, "
+                    "kv_pool_spec())) and pin Format(Layout(...)) at the "
+                    "KV gather seams (serving/decode.py, docs/serving.md "
+                    "'Sharded decode'), or pragma a deliberately "
+                    "replicated single-device pool",
+                    {"pools": names})
 
     def _check_unguarded_apply(self, node):
         """jax-unguarded-apply: gradients computed AND applied in this

@@ -356,6 +356,12 @@ class BlobStore:
                 refs.add(m["skeleton"])
             for entry in m.get("leaves", []):
                 refs.add(entry[0])
+            # optional per-shard blob layer (docs/checkpointing.md
+            # "Per-shard blobs"): shard parts are referenced too, so GC
+            # keeps them exactly as long as their manifest
+            for meta in (m.get("shards") or {}).values():
+                for entry in meta.get("parts", []):
+                    refs.add(entry[0])
         return refs
 
     def gc(self, keep: int) -> Dict[str, int]:

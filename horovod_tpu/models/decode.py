@@ -34,14 +34,31 @@ seam (``train.step_builder.export_decode_params``) produces — no flax
 ``apply`` in the serve path, so remat/scan/sow machinery never enters the
 decode program. Handles both checkpoint layouts: unrolled ``block_i`` keys
 and scanned ``layers``-stacked ``[L, ...]`` leaves.
+
+**Tensor-parallel variants** (:func:`make_prefill_tp` /
+:func:`make_decode_step_tp`): the same programs shard_map-partitioned
+over a ``tp`` mesh axis, megatron-style — attention heads and MLP/expert
+hidden matrices column-parallel (wq/wk/wv/w1/w3 split on the output dim),
+their mates row-parallel (wo/w2 split on the input dim), KV pools sharded
+on the head dimension (``[L, n_blocks, bs, n_kv/tp, hd]``), block tables
+and slot state replicated. Per layer exactly TWO ``lax.psum`` collectives
+ride the wire — one after attention-out, one after MLP/expert-down, both
+before the residual add — and nothing else: no permutes, no gathers of
+KV across shards (each shard's gather-only page reads stay local; the
+CLAUDE.md scatter trap stays honored per shard). Embedding, norms, the
+router, and the lm head are replicated, so the greedy argmax is local
+and bit-identical on every shard (``tests/test_wire_contracts.py`` pins
+the collective count and operand bytes; ``tests/test_decode_parity.py``
+pins tp>1 token streams against tp=1).
 """
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..parallel.moe import sorted_combine, sorted_dispatch, topk_router_sorted
 from .llama import LlamaConfig, rope
@@ -111,13 +128,22 @@ def _moe(p, c, tokens):
     return sorted_combine(out, r, T).astype(c.dtype)
 
 
-def _ffn(lp, c, x, moe: bool):
-    """The block's second half-residual on ``[..., D]`` activations."""
+def _ffn(lp, c, x, moe: bool, axis: Optional[str] = None):
+    """The block's second half-residual on ``[..., D]`` activations.
+
+    Under tensor parallelism (``axis`` set) the MLP/expert hidden dim is
+    sharded, so the down-projection yields a PARTIAL sum — it is
+    all-reduced over ``axis`` before the residual add (the per-layer
+    MLP-down collective of the wire contract)."""
     y = _rmsnorm(x, lp["mlp_norm"]["scale"], c.norm_eps, c.dtype)
     if moe:
         flat = y.reshape(-1, y.shape[-1])
-        return x + _moe(lp["moe"], c, flat).reshape(y.shape)
-    return x + _mlp(lp["mlp"], c, y)
+        part = _moe(lp["moe"], c, flat).reshape(y.shape)
+    else:
+        part = _mlp(lp["mlp"], c, y)
+    if axis is not None:
+        part = jax.lax.psum(part, axis)
+    return x + part
 
 
 def _lm_head(params, c, x):
@@ -130,22 +156,26 @@ def _lm_head(params, c, x):
                       preferred_element_type=jnp.float32)
 
 
-def _attn_prefill(p, c, x, positions):
+def _attn_prefill(p, c, x, positions, n_heads=None, n_kv=None):
     """Causal attention over the whole (padded) prompt — the training
     formulation verbatim (materialized softmax path of llama.Attention),
     additionally returning the pre-repeat post-RoPE K and raw V for the
-    cache."""
+    cache. ``n_heads``/``n_kv`` default to the config's counts; the TP
+    path passes the per-shard locals (the q/k/v/o kernels it sees are the
+    column/row slices, so every shape below stays consistent)."""
     head_dim = c.dim // c.n_heads
+    n_heads = n_heads or c.n_heads
+    n_kv = n_kv or c.n_kv_heads
     B, T = x.shape[0], x.shape[1]
     q = _dense(x, p["wq"]["kernel"], c.dtype).reshape(
-        B, T, c.n_heads, head_dim)
+        B, T, n_heads, head_dim)
     k = _dense(x, p["wk"]["kernel"], c.dtype).reshape(
-        B, T, c.n_kv_heads, head_dim)
+        B, T, n_kv, head_dim)
     v = _dense(x, p["wv"]["kernel"], c.dtype).reshape(
-        B, T, c.n_kv_heads, head_dim)
+        B, T, n_kv, head_dim)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
-    rep = c.n_heads // c.n_kv_heads
+    rep = n_heads // n_kv
     kr = jnp.repeat(k, rep, axis=2)
     vr = jnp.repeat(v, rep, axis=2)
     scale = 1.0 / head_dim ** 0.5
@@ -154,23 +184,19 @@ def _attn_prefill(p, c, x, positions):
     s = jnp.where(mask[None, None], s, -1e30)
     pr = jax.nn.softmax(s, axis=-1).astype(c.dtype)
     o = jnp.einsum("bhqk,bkhd->bqhd", pr, vr).reshape(
-        B, T, c.n_heads * head_dim)
+        B, T, n_heads * head_dim)
     return _dense(o, p["wo"]["kernel"], c.dtype), k, v
 
 
-def make_prefill(cfg: LlamaConfig, block_size: int):
-    """Build the prefill program for ``cfg``: one compile per prompt
-    bucket (the bucketed-prefill discipline — compile count is bounded by
-    configuration, not traffic).
-
-    ``prefill(params, k_pool, v_pool, tokens[1, T], block_ids[T // bs])
-    -> (logits[1, T, V] f32, k_pool, v_pool)`` — K/V for positions
-    ``0..T-1`` land in the slot's blocks; positions at or beyond the real
-    prompt length hold padding K/V, which is harmless because the decode
-    mask only admits ``t <= pos`` and position ``pos`` is rewritten by the
-    decode step itself before its first read.
-    """
+def _make_prefill(cfg: LlamaConfig, block_size: int, *, shards: int = 1,
+                  axis: Optional[str] = None):
+    """Prefill body parameterized by shard count: with ``shards > 1`` the
+    per-device view sees ``n_heads/shards`` query heads, ``n_kv/shards``
+    KV heads, locally-sliced kernels, and a head-sharded pool slice; the
+    attention-out and MLP-down partials are psum'd over ``axis``."""
     moe = is_moe(cfg)
+    n_heads_l = cfg.n_heads // shards
+    n_kv_l = cfg.n_kv_heads // shards
 
     def prefill(params, k_pool, v_pool, tokens, block_ids):
         T = tokens.shape[1]
@@ -186,8 +212,10 @@ def make_prefill(cfg: LlamaConfig, block_size: int):
                 lp["attn"], cfg,
                 _rmsnorm(x, lp["attn_norm"]["scale"], cfg.norm_eps,
                          cfg.dtype),
-                positions)
-            x = _ffn(lp, cfg, x + h, moe)
+                positions, n_heads_l, n_kv_l)
+            if axis is not None:
+                h = jax.lax.psum(h, axis)
+            x = _ffn(lp, cfg, x + h, moe, axis)
             ks.append(k[0])
             vs.append(v[0])
         x = _rmsnorm(x, params["final_norm"]["scale"], cfg.norm_eps,
@@ -195,7 +223,7 @@ def make_prefill(cfg: LlamaConfig, block_size: int):
         logits = _lm_head(params, cfg, x)
         n_ch = T // block_size
         head_dim = cfg.dim // cfg.n_heads
-        shape = (cfg.n_layers, n_ch, block_size, cfg.n_kv_heads, head_dim)
+        shape = (cfg.n_layers, n_ch, block_size, n_kv_l, head_dim)
         k_all = jnp.stack(ks).reshape(shape).astype(k_pool.dtype)
         v_all = jnp.stack(vs).reshape(shape).astype(v_pool.dtype)
         k_pool = k_pool.at[:, block_ids].set(k_all)
@@ -205,22 +233,33 @@ def make_prefill(cfg: LlamaConfig, block_size: int):
     return prefill
 
 
-def make_decode_step(cfg: LlamaConfig, block_size: int):
-    """Build the single-token decode program for ``cfg`` — ONE compile for
-    the serving lifetime (fixed slot width S and block-table width Bmax;
-    admit/retire only flips the active mask and table contents).
+def make_prefill(cfg: LlamaConfig, block_size: int):
+    """Build the prefill program for ``cfg``: one compile per prompt
+    bucket (the bucketed-prefill discipline — compile count is bounded by
+    configuration, not traffic).
 
-    ``decode(params, k_pool, v_pool, tokens[S], positions[S],
-    block_tables[S, Bmax], active[S])
-    -> (logits[S, V] f32, next_tokens[S] i32, k_pool, v_pool)``
-
-    Greedy next tokens are computed on device so the engine can feed them
-    straight back without a host round-trip (lint-decode-host-sync).
+    ``prefill(params, k_pool, v_pool, tokens[1, T], block_ids[T // bs])
+    -> (logits[1, T, V] f32, k_pool, v_pool)`` — K/V for positions
+    ``0..T-1`` land in the slot's blocks; positions at or beyond the real
+    prompt length hold padding K/V, which is harmless because the decode
+    mask only admits ``t <= pos`` and position ``pos`` is rewritten by the
+    decode step itself before its first read.
     """
+    return _make_prefill(cfg, block_size)
+
+
+def _make_decode(cfg: LlamaConfig, block_size: int, *, shards: int = 1,
+                 axis: Optional[str] = None):
+    """Decode-step body parameterized by shard count — same structure as
+    :func:`_make_prefill`; every KV page read/write below operates on the
+    shard's LOCAL heads, so the gather-only read discipline holds
+    per shard with zero cross-shard KV movement."""
     moe = is_moe(cfg)
     head_dim = cfg.dim // cfg.n_heads
     rep = cfg.n_heads // cfg.n_kv_heads
     scale = 1.0 / head_dim ** 0.5
+    n_heads_l = cfg.n_heads // shards
+    n_kv_l = cfg.n_kv_heads // shards
 
     def decode(params, k_pool, v_pool, tokens, positions, block_tables,
                active):
@@ -239,11 +278,11 @@ def make_decode_step(cfg: LlamaConfig, block_size: int):
             h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.norm_eps,
                          cfg.dtype)
             q = _dense(h, ap["wq"]["kernel"], cfg.dtype).reshape(
-                S, 1, cfg.n_heads, head_dim)
+                S, 1, n_heads_l, head_dim)
             k = _dense(h, ap["wk"]["kernel"], cfg.dtype).reshape(
-                S, 1, cfg.n_kv_heads, head_dim)
+                S, 1, n_kv_l, head_dim)
             v = _dense(h, ap["wv"]["kernel"], cfg.dtype).reshape(
-                S, 1, cfg.n_kv_heads, head_dim)
+                S, 1, n_kv_l, head_dim)
             q = rope(q, pos2, cfg.rope_theta)[:, 0]
             k = rope(k, pos2, cfg.rope_theta)[:, 0]
             v = v[:, 0]
@@ -258,21 +297,23 @@ def make_decode_step(cfg: LlamaConfig, block_size: int):
             v_pool = v_pool.at[i, blk, off].set(
                 jnp.where(act, v, 0).astype(v_pool.dtype))
             kb = jnp.take(k_pool[i], block_tables, axis=0).reshape(
-                S, t_max, cfg.n_kv_heads, head_dim)
+                S, t_max, n_kv_l, head_dim)
             vb = jnp.take(v_pool[i], block_tables, axis=0).reshape(
-                S, t_max, cfg.n_kv_heads, head_dim)
+                S, t_max, n_kv_l, head_dim)
             # grouped-query form: head h reads kv group h // rep — the
             # same pairing as the training path's jnp.repeat, without
             # materializing the repeated K/V
-            qg = q.reshape(S, cfg.n_kv_heads, rep, head_dim)
+            qg = q.reshape(S, n_kv_l, rep, head_dim)
             s = jnp.einsum("sgrd,stgd->sgrt", qg, kb).astype(
                 jnp.float32) * scale
             s = jnp.where(mask[:, None, None, :], s, -1e30)
             pr = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
             o = jnp.einsum("sgrt,stgd->sgrd", pr, vb).reshape(
-                S, cfg.n_heads * head_dim)
-            x = _ffn(lp, cfg, x + _dense(o, ap["wo"]["kernel"], cfg.dtype),
-                     moe)
+                S, n_heads_l * head_dim)
+            attn_out = _dense(o, ap["wo"]["kernel"], cfg.dtype)
+            if axis is not None:
+                attn_out = jax.lax.psum(attn_out, axis)
+            x = _ffn(lp, cfg, x + attn_out, moe, axis)
         x = _rmsnorm(x, params["final_norm"]["scale"], cfg.norm_eps,
                      cfg.dtype)
         logits = _lm_head(params, cfg, x)
@@ -283,3 +324,149 @@ def make_decode_step(cfg: LlamaConfig, block_size: int):
         return logits, next_tokens, k_pool, v_pool
 
     return decode
+
+
+def make_decode_step(cfg: LlamaConfig, block_size: int):
+    """Build the single-token decode program for ``cfg`` — ONE compile for
+    the serving lifetime (fixed slot width S and block-table width Bmax;
+    admit/retire only flips the active mask and table contents).
+
+    ``decode(params, k_pool, v_pool, tokens[S], positions[S],
+    block_tables[S, Bmax], active[S])
+    -> (logits[S, V] f32, next_tokens[S] i32, k_pool, v_pool)``
+
+    Greedy next tokens are computed on device so the engine can feed them
+    straight back without a host round-trip (lint-decode-host-sync).
+    """
+    return _make_decode(cfg, block_size)
+
+
+# -- tensor-parallel (tp) decode plane ---------------------------------------
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    """The divisibility contract for the megatron-style plan: query and KV
+    heads split the head dim, the MLP/expert hidden dim splits its
+    matrices. ``tp=1`` is always valid (the unsharded programs)."""
+    if tp <= 1:
+        return
+    for name, value in (("n_heads", cfg.n_heads),
+                        ("n_kv_heads", cfg.n_kv_heads),
+                        ("hidden_dim", cfg.hidden_dim)):
+        if value % tp:
+            raise ValueError(
+                f"tp={tp} does not divide cfg.{name}={value}")
+
+
+def kv_pool_spec(axis: str = "tp") -> P:
+    """PartitionSpec of the paged KV pools under tensor parallelism:
+    ``[L, n_blocks, block_size, n_kv{sharded}, head_dim]`` — block
+    geometry replicated, heads split."""
+    return P(None, None, None, axis, None)
+
+
+def decode_param_specs(cfg: LlamaConfig, params, axis: str = "tp"):
+    """PartitionSpec pytree mirroring ``params`` for the megatron plan:
+    wq/wk/wv and MLP/expert up-projections column-parallel (output dim),
+    wo and down-projections row-parallel (input dim), everything else —
+    embedding, norms, router, lm head — replicated. Handles both the
+    unrolled ``block_i`` and scanned ``layers`` checkpoint layouts (the
+    scanned ``[L, ...]`` leaves get a leading ``None``)."""
+    def block_specs(block, pfx):
+        col = P(*pfx, None, axis)
+        row = P(*pfx, axis, None)
+        specs = {}
+        for key, sub in block.items():
+            if key == "attn":
+                specs[key] = {"wq": {"kernel": col}, "wk": {"kernel": col},
+                              "wv": {"kernel": col}, "wo": {"kernel": row}}
+            elif key == "mlp":
+                specs[key] = {"w1": {"kernel": col}, "w3": {"kernel": col},
+                              "w2": {"kernel": row}}
+            elif key == "moe":
+                specs[key] = {"router": {"kernel": P()},
+                              "w1": P(*pfx, None, None, axis),
+                              "w3": P(*pfx, None, None, axis),
+                              "w2": P(*pfx, None, axis, None)}
+            else:                          # norms and future replicated bits
+                specs[key] = jax.tree.map(lambda _: P(), sub)
+        return specs
+
+    specs = {}
+    for key, sub in params.items():
+        if key == "layers":
+            specs[key] = {"block": block_specs(sub["block"], (None,))}
+        elif key.startswith("block_"):
+            specs[key] = block_specs(sub, ())
+        else:                              # embedding / final_norm / lm_head
+            specs[key] = jax.tree.map(lambda _: P(), sub)
+    return specs
+
+
+def decode_leaf_shard_axis(path_names: Sequence[Any], shape,
+                           tp: int) -> Optional[int]:
+    """Which array axis of a decode-params leaf the tp plan splits, or
+    ``None`` if the leaf is replicated (or indivisible). Keyed on the
+    trailing path names so it works for both checkpoint layouts — this is
+    the single source of truth the per-shard CAS layer (publisher shard
+    plans, registry shard selectors) derives byte movement from."""
+    names = tuple(str(n) for n in path_names)
+    if not names:
+        return None
+    leaf, parent = names[-1], (names[-2] if len(names) >= 2 else None)
+    if leaf == "kernel" and parent in ("wq", "wk", "wv", "w1", "w3"):
+        ax = len(shape) - 1                # column-parallel: output dim
+    elif leaf == "kernel" and parent in ("wo", "w2"):
+        ax = len(shape) - 2                # row-parallel: input dim
+    elif leaf in ("w1", "w3") and parent == "moe":
+        ax = len(shape) - 1                # [.., E, D, M]: expert hidden
+    elif leaf == "w2" and parent == "moe":
+        ax = len(shape) - 2                # [.., E, M, D]: expert hidden
+    else:
+        return None
+    return ax if (tp > 0 and shape[ax] % tp == 0) else None
+
+
+def _shard_mapped(cfg, mesh, axis, body, n_pools, n_extra, n_outs):
+    """Wrap ``body`` in shard_map lazily — the param PartitionSpec tree
+    needs the concrete params structure, so construction happens on first
+    call (and jit caches the result by tracing, not by wrapper identity)."""
+    from jax import shard_map               # backfilled by horovod_tpu.compat
+    pool_s = kv_pool_spec(axis)
+
+    def wrapped(params, *args):
+        specs = decode_param_specs(cfg, params, axis)
+        in_specs = (specs,) + (pool_s,) * n_pools + (P(),) * n_extra
+        out_specs = (P(),) * (n_outs - n_pools) + (pool_s,) * n_pools
+        sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        return sm(params, *args)
+
+    return wrapped
+
+
+def make_prefill_tp(cfg: LlamaConfig, block_size: int, mesh,
+                    axis: str = "tp"):
+    """:func:`make_prefill` partitioned over ``mesh[axis]``. Same
+    signature and semantics; the pools are the head-sharded global views
+    (:func:`kv_pool_spec`), params follow :func:`decode_param_specs`,
+    tokens/block_ids and the returned logits are replicated."""
+    tp = mesh.shape[axis]
+    validate_tp(cfg, tp)
+    body = _make_prefill(cfg, block_size, shards=tp, axis=axis)
+    return _shard_mapped(cfg, mesh, axis, body, n_pools=2, n_extra=2,
+                         n_outs=3)
+
+
+def make_decode_step_tp(cfg: LlamaConfig, block_size: int, mesh,
+                        axis: str = "tp"):
+    """:func:`make_decode_step` partitioned over ``mesh[axis]``. The wire
+    contract: exactly ``2 * n_layers`` all-reduces of ``[S, D]``
+    activations (attention-out + MLP/expert-down) and NOTHING else — no
+    collective-permutes, no cross-shard KV gathers; slot state, tables,
+    logits, and next_tokens stay replicated so the engine's host logic is
+    mesh-agnostic (``tests/test_wire_contracts.py`` pins this)."""
+    tp = mesh.shape[axis]
+    validate_tp(cfg, tp)
+    body = _make_decode(cfg, block_size, shards=tp, axis=axis)
+    return _shard_mapped(cfg, mesh, axis, body, n_pools=2, n_extra=4,
+                         n_outs=4)

@@ -81,6 +81,13 @@ DEFAULT_DECODE_MAX_NEW = 64
 DECODE_SWAP_POLICY_ENV = "HOROVOD_DECODE_SWAP_POLICY"
 DEFAULT_DECODE_SWAP_POLICY = "refill"
 
+#: Tensor-parallel width of the decode plane (docs/serving.md "Sharded
+#: decode"). 0/1 = single-device decode; N > 1 builds a ``tp`` mesh over
+#: the first N local devices and runs the shard_map'd decode/prefill
+#: programs (heads and expert hidden dims split, KV pools head-sharded).
+DECODE_TP_ENV = "HOROVOD_DECODE_TP"
+DEFAULT_DECODE_TP = 0
+
 
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
@@ -176,3 +183,7 @@ def decode_max_new() -> int:
 def decode_swap_policy() -> str:
     v = os.environ.get(DECODE_SWAP_POLICY_ENV, "").strip().lower()
     return v if v in ("refill", "drain") else DEFAULT_DECODE_SWAP_POLICY
+
+
+def decode_tp() -> int:
+    return max(0, _env_int(DECODE_TP_ENV, DEFAULT_DECODE_TP))

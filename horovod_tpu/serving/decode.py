@@ -33,6 +33,19 @@ manifest it either
 - **drain**: stops admitting, finishes every in-flight slot on the OLD
   weights (the held ``ServedModel`` reference keeps them consistent), and
   adopts the new ones once idle.
+
+**Sharded decode** (``mesh=`` / ``HOROVOD_DECODE_TP``, docs/serving.md
+"Sharded decode"): the engine runs the tensor-parallel program variants
+(``models/decode.py`` ``make_*_tp``) over a ``tp`` mesh axis. ALL host
+logic above is mesh-agnostic — block tables, slot state, the allocator,
+and the fed-back token array are replicated, so admission/retirement/
+swap code is byte-identical; only program construction and array
+placement change. The KV pools are head-sharded (``kv_pool_spec``) with
+their layout PINNED row-major at the jit boundary (``Format(Layout(...))``
+— the r4 DLRM trap: XLA's entry-layout heuristic may otherwise transpose
+whole pools around the page gathers), and every params adoption path
+funnels through ``_place_params`` so leaves land in their megatron
+shardings exactly once (``decode_param_specs``).
 """
 
 from __future__ import annotations
@@ -138,13 +151,25 @@ class DecodeEngine:
                  pool_blocks: Optional[int] = None,
                  max_blocks_per_slot: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 swap_policy: Optional[str] = None):
+                 swap_policy: Optional[str] = None,
+                 mesh=None, tp_axis: str = "tp"):
         import jax
         from ..models import decode as MD
         from .server import pad_to_bucket
 
         self.cfg = cfg
         self.registry = registry
+        if mesh is None:
+            tp_knob = SC.decode_tp()
+            if tp_knob > 1:
+                from ..parallel.mesh import create_mesh
+                mesh = create_mesh({tp_axis: tp_knob},
+                                   devices=jax.devices()[:tp_knob])
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        if mesh is not None:
+            MD.validate_tp(cfg, self.tp)
         self._pad_to_bucket = pad_to_bucket
         self.n_slots = SC.decode_slots() if slots is None else int(slots)
         self.block_size = SC.decode_block_size() if block_size is None \
@@ -179,7 +204,6 @@ class DecodeEngine:
         self._thread: Optional[threading.Thread] = None
         self._closing = False
 
-        self._params = params
         self._model_seq: Optional[int] = 0 if params is not None else None
         self._installed_seq = 0 if params is not None else None
         self._drain_target = None   # (params, seq) awaiting idle adoption
@@ -187,8 +211,14 @@ class DecodeEngine:
         #: trace-time side-effect counters — each increment runs ONCE per
         #: compile, so steady state pins ``decode`` exactly (the guardrail)
         self.compile_counts = {"decode": 0, "prefill": 0}
-        _base_decode = MD.make_decode_step(cfg, self.block_size)
-        _base_prefill = MD.make_prefill(cfg, self.block_size)
+        if mesh is not None:
+            _base_decode = MD.make_decode_step_tp(cfg, self.block_size,
+                                                  mesh, tp_axis)
+            _base_prefill = MD.make_prefill_tp(cfg, self.block_size,
+                                               mesh, tp_axis)
+        else:
+            _base_decode = MD.make_decode_step(cfg, self.block_size)
+            _base_prefill = MD.make_prefill(cfg, self.block_size)
 
         def _decode_traced(p, kp, vp, toks, pos, tables, active):
             self.compile_counts["decode"] += 1
@@ -198,17 +228,59 @@ class DecodeEngine:
             self.compile_counts["prefill"] += 1
             return _base_prefill(p, kp, vp, toks, block_ids)
 
-        self._decode = jax.jit(_decode_traced, donate_argnums=(1, 2))
-        self._prefill = jax.jit(_prefill_traced, donate_argnums=(1, 2))
         self._jnp = jax.numpy
         self._kp, self._vp = MD.init_kv_pools(cfg, n_blocks, self.block_size)
         self._dev_tokens = self._jnp.zeros((self.n_slots,), self._jnp.int32)
+        if mesh is not None:
+            # Pools live head-sharded on the mesh, with their row-major
+            # layout PINNED at the jit boundary: entry layouts are chosen
+            # by jit itself, and its heuristic can transpose whole pools
+            # around the page gathers (the r4 DLRM trap).
+            from jax.experimental.layout import Format, Layout
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            try:  # UNSPECIFIED = "let XLA choose" (None would replicate)
+                from jax._src.sharding_impls import UNSPECIFIED as _u
+            except ImportError:  # pragma: no cover - jax version drift
+                _u = None
+            pool_nd = NamedSharding(mesh, MD.kv_pool_spec(tp_axis))
+            pool_fmt = Format(Layout((0, 1, 2, 3, 4)), pool_nd)
+            self._kp = jax.device_put(self._kp, pool_nd)
+            self._vp = jax.device_put(self._vp, pool_nd)
+            self._dev_tokens = jax.device_put(
+                self._dev_tokens, NamedSharding(mesh, P()))
+            self._decode = jax.jit(
+                _decode_traced, donate_argnums=(1, 2),
+                in_shardings=(_u, pool_fmt, pool_fmt, _u, _u, _u, _u),
+                out_shardings=(_u, _u, pool_fmt, pool_fmt))
+            self._prefill = jax.jit(
+                _prefill_traced, donate_argnums=(1, 2),
+                in_shardings=(_u, pool_fmt, pool_fmt, _u, _u),
+                out_shardings=(_u, pool_fmt, pool_fmt))
+        else:
+            self._decode = jax.jit(_decode_traced, donate_argnums=(1, 2))
+            self._prefill = jax.jit(_prefill_traced, donate_argnums=(1, 2))
+        self._params = self._place_params(params)
         self._positions = np.zeros(self.n_slots, np.int32)
         self._tables = np.zeros((self.n_slots, self.max_blocks_per_slot),
                                 np.int32)
         self._active = np.zeros(self.n_slots, bool)
 
     # -- weights --------------------------------------------------------------
+
+    def _place_params(self, params):
+        """Mesh mode: land every leaf in its megatron sharding
+        (``decode_param_specs``) — a no-op for leaves the registry's
+        sharding-aware ``prepare_leaf`` already placed, so adoption never
+        replicates-then-reshards. Single-device mode passes through."""
+        if self.mesh is None or params is None:
+            return params
+        import jax
+        from jax.sharding import NamedSharding
+        from ..models import decode as MD
+        specs = MD.decode_param_specs(self.cfg, params, self.tp_axis)
+        return jax.tree.map(
+            lambda leaf, s: jax.device_put(
+                leaf, NamedSharding(self.mesh, s)), params, specs)
 
     def install_params(self, params) -> None:
         """Static-weights mode: (re)install a params pytree; each call
@@ -461,7 +533,9 @@ class DecodeEngine:
         self._tables[idx] = 0
         _telemetry.inc("hvd_serving_decode_retired_total")
         if self._drain_target is not None and not self._active.any():
-            self._params, self._model_seq = self._drain_target
+            tgt_params, tgt_seq = self._drain_target
+            self._params, self._model_seq = \
+                self._place_params(tgt_params), tgt_seq
             self._drain_target = None
             _telemetry.inc("hvd_serving_decode_drain_adoptions_total")
 
@@ -472,7 +546,8 @@ class DecodeEngine:
         if params is None or seq == self._model_seq:
             return
         if self._model_seq is None or not self._active.any():
-            self._params, self._model_seq = params, seq  # trivial adoption
+            # trivial adoption
+            self._params, self._model_seq = self._place_params(params), seq
             self._drain_target = None
             return
         if self.swap_policy == "drain":
@@ -480,7 +555,7 @@ class DecodeEngine:
             return
         # refill: adopt now, remap every live slot's blocks under the new
         # weights (the p99-latency-under-swap cost the bench rails)
-        self._params, self._model_seq = params, seq
+        self._params, self._model_seq = self._place_params(params), seq
         self._drain_target = None
         t0 = time.perf_counter()
         n = self._refill_live_slots()
@@ -577,3 +652,64 @@ class DecodeEngine:
                 return
             self.decode_once()
         raise RuntimeError(f"engine still busy after {max_steps} steps")
+
+
+# -- per-shard CAS glue (docs/checkpointing.md "Per-shard blobs") -------------
+#
+# Three small factories tie the decode plane's megatron plan
+# (``models/decode.py::decode_leaf_shard_axis`` — the single source of
+# truth for which array axis a leaf splits on) to the CAS seams:
+# ``tp_shard_plan`` feeds a Publisher's shard writer, ``tp_shard_selector``
+# a replica host's delta-fetching registry, ``tp_prepare_leaf`` the
+# sharding-aware leaf placement for a mesh-mode engine's registry.
+
+def tp_shard_plan(tp: int):
+    """``shard_plan`` for :class:`serving.publisher.Publisher`: split
+    every tp-sharded decode leaf into ``tp`` parts along its plan axis;
+    replicated (or indivisible) leaves keep whole-leaf blobs only."""
+    from ..models import decode as MD
+
+    def plan(path_names, shape):
+        ax = MD.decode_leaf_shard_axis(path_names, shape, tp)
+        return None if ax is None else (ax, tp)
+
+    return plan
+
+
+def tp_shard_selector(tp: int, shard_index: int):
+    """``shard_selector`` for :class:`serving.registry.ModelRegistry` on
+    the replica host holding shard ``shard_index`` of a ``tp``-wide
+    decode mesh: fetch exactly its part of each sharded leaf. A manifest
+    sharded for a DIFFERENT topology (``n != tp``) falls back to the
+    whole-leaf blob — read-compatibility under topology changes."""
+    if not 0 <= shard_index < tp:
+        raise ValueError(f"shard_index {shard_index} outside tp={tp}")
+
+    def selector(path_names, shard_meta):
+        if int(shard_meta.get("n", 0)) != tp:
+            return None
+        return [shard_index]
+
+    return selector
+
+
+def tp_prepare_leaf(cfg, mesh, tp_axis: str = "tp"):
+    """Sharding-aware ``prepare_leaf`` for a registry feeding a mesh-mode
+    engine: each newly fetched leaf lands in its megatron sharding in ONE
+    ``device_put`` — never replicated first and resharded by the engine
+    (the adopt-path placement bugfix). Cache hits keep their placed
+    object across swaps, so unchanged leaves stay zero-copy."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..models import decode as MD
+
+    tp = int(mesh.shape[tp_axis])
+    MD.validate_tp(cfg, tp)
+
+    def prepare(leaf, path_names):
+        ax = MD.decode_leaf_shard_axis(path_names, np.shape(leaf), tp)
+        spec = P() if ax is None else P(*([None] * ax + [tp_axis]))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return prepare

@@ -47,6 +47,16 @@ from ..elastic.state import _CAS_SUBDIR, _cas_store, register_commit_hook, \
 from . import constants as SC
 
 
+def _path_name(entry) -> str:
+    """One jax tree-path entry as a plain name (DictKey.key /
+    GetAttrKey.name / SequenceKey.idx), shared with the registry so both
+    ends of the per-shard layer key leaves identically."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
 def leaves_digest(manifest: Dict) -> str:
     """One digest over every content address a manifest references, in
     manifest order — the served-weights identity both ends compare: the
@@ -67,12 +77,25 @@ class Publisher:
     sentinel and no real time); ``client`` is an optional
     ``CoordinatorClient`` — without one, publishes are discoverable only
     through the pin files (store-watch mode).
+
+    ``shard_plan`` enables the optional per-shard blob layer
+    (docs/checkpointing.md "Per-shard blobs"): ``plan(path_names, shape)
+    -> (axis, n) | None`` names how a leaf is split for the serving
+    topology (``serving/decode.py::tp_shard_plan`` derives it from the
+    decode plane's megatron plan). Planned leaves additionally get ``n``
+    part blobs and a ``shards`` manifest entry keyed by the leaf's
+    digest, so a sharded registry delta-fetches only the part bytes its
+    target sharding needs. Whole-leaf blobs stay authoritative — old
+    readers and unsharded registries never see the difference, and
+    ``leaves_digest`` (the served identity) covers only skeleton + leaf
+    digests, so the shard layer does not change what is being served.
     """
 
     def __init__(self, commit_dir: str, client=None,
                  every: Optional[int] = None, keep: Optional[int] = None,
                  counters: Callable[[], Dict] = _sentinel.counters,
-                 clock: Callable[[], float] = time.time, rank: int = 0):
+                 clock: Callable[[], float] = time.time, rank: int = 0,
+                 shard_plan: Optional[Callable] = None):
         self.commit_dir = commit_dir
         self.store = _cas_store(commit_dir)
         self.client = client
@@ -81,6 +104,11 @@ class Publisher:
         self._counters = counters
         self._clock = clock
         self._rank = int(rank)
+        self._shard_plan = shard_plan
+        #: leaf digest -> shards entry, reused across publishes so an
+        #: unchanged leaf is never re-split/re-pickled (the CAS dedups
+        #: the bytes regardless; this saves the CPU work)
+        self._shard_memo: Dict[str, Dict] = {}
         self._seen = 0
         # Sentinel window baseline: counters at the LAST candidate commit
         # (cadence hit), so "zero skips/rollbacks in the window" means
@@ -128,9 +156,70 @@ class Publisher:
             self.store.get_blob(manifest["skeleton"], verify=True)
             for entry in manifest.get("leaves", []):
                 self.store.get_blob(entry[0], verify=True)
+            for meta in (manifest.get("shards") or {}).values():
+                for entry in meta.get("parts", []):
+                    self.store.get_blob(entry[0], verify=True)
         except (OSError, KeyError, BlobIntegrityError):
             return None
         return manifest
+
+    # -- per-shard blob layer --------------------------------------------------
+
+    def _write_shards(self, seq: int, manifest: Dict) -> Dict:
+        """Split each planned leaf into part blobs and republish the
+        manifest (same seq — atomic overwrite) with the ``shards`` map.
+        Best-effort: any failure logs and returns the original manifest,
+        which is complete without shards."""
+        import pickle
+
+        import numpy as np
+
+        try:
+            import jax
+            from ..elastic.state import _LeafRef
+            skeleton = pickle.loads(
+                self.store.get_blob(manifest["skeleton"]))
+            flat, _ = jax.tree_util.tree_flatten_with_path(skeleton)
+            entries = manifest.get("leaves", [])
+            shards: Dict[str, Dict] = {}
+            for path, ref in flat:
+                if not isinstance(ref, _LeafRef):
+                    continue
+                digest = entries[ref.index][0]
+                memo = self._shard_memo.get(digest)
+                if memo is not None:
+                    shards[digest] = memo
+                    continue
+                names = tuple(_path_name(p) for p in path)
+                leaf = np.asarray(pickle.loads(self.store.get_blob(digest)))
+                plan = self._shard_plan(names, leaf.shape)
+                if plan is None:
+                    continue
+                axis, n = int(plan[0]), int(plan[1])
+                if n <= 1 or axis >= leaf.ndim or leaf.shape[axis] % n:
+                    continue
+                parts = []
+                for piece in np.split(leaf, n, axis=axis):
+                    data = pickle.dumps(np.ascontiguousarray(piece),
+                                        protocol=4)
+                    d, _new = self.store.put_blob(data)
+                    parts.append([d, len(data)])
+                shards[digest] = {"axis": axis, "n": n, "parts": parts}
+            if not shards:
+                return manifest
+            manifest = dict(manifest)
+            manifest["shards"] = shards
+            self.store.publish_manifest(manifest)
+            self._shard_memo = dict(shards)
+            _telemetry.set_gauge("hvd_serving_shard_blobs",
+                                 float(sum(len(m["parts"])
+                                           for m in shards.values())))
+            return manifest
+        except Exception as err:    # noqa: BLE001 — shards are optional
+            get_logger().warning(
+                "per-shard blob layer for seq=%d failed (%s) — publishing "
+                "whole-leaf manifest only", seq, err)
+            return self.store.read_manifest(seq) or manifest
 
     # -- publishing ----------------------------------------------------------
 
@@ -152,6 +241,12 @@ class Publisher:
             self._blocked("manifest unreadable or blob integrity "
                           "verification failed", seq)
             return None
+        if self._shard_plan is not None:
+            # Shards ride the SAME manifest (atomic re-publish, same seq)
+            # and must exist before the pin/announce makes the publish
+            # discoverable — a sharded registry adopting this record must
+            # find its part blobs on first read.
+            manifest = self._write_shards(seq, manifest)
         record = {
             "manifest_seq": int(seq),
             "step": int(seq),

@@ -41,7 +41,25 @@ from typing import Any, Callable, Dict, Optional
 from ..checkpoint.store import BlobIntegrityError, BlobStore
 from ..core import telemetry as _telemetry
 from ..core.logging import get_logger
-from .publisher import leaves_digest as _leaves_digest
+from .publisher import _path_name, leaves_digest as _leaves_digest
+
+
+def _takes_path(prepare_leaf) -> bool:
+    """Whether ``prepare_leaf`` wants ``(leaf, path_names)`` — two
+    required positional parameters — or is a legacy one-argument
+    callable (``jnp.asarray``-style, extra defaulted params ignored).
+    Uninspectable callables are treated as legacy."""
+    if prepare_leaf is None:
+        return False
+    import inspect
+    try:
+        params = inspect.signature(prepare_leaf).parameters.values()
+    except (TypeError, ValueError):
+        return False
+    required = [p for p in params
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty]
+    return len(required) >= 2
 
 
 class ServedModel:
@@ -65,15 +83,31 @@ class ModelRegistry:
     ``prepare_leaf`` is applied to every NEWLY fetched leaf (e.g.
     ``jax.device_put`` onto the serving mesh); cache hits skip it, so an
     unchanged leaf keeps its already-prepared (on-device) object across
-    swaps — that is the zero-copy half of the hot-swap. ``clock`` is
-    injectable for the staleness math in tests.
+    swaps — that is the zero-copy half of the hot-swap. A one-argument
+    callable gets the raw leaf (legacy); a two-argument callable gets
+    ``(leaf, path_names)`` so it can place the leaf in its TARGET
+    sharding in one ``device_put`` — never replicated-then-resharded
+    (``serving/decode.py::tp_prepare_leaf``). ``clock`` is injectable for
+    the staleness math in tests.
+
+    ``shard_selector(path_names, shard_meta) -> part_indices | None``
+    turns on per-shard delta-fetch against manifests carrying the
+    optional ``shards`` layer: when it names part indices, only those
+    part blobs move and the leaf is concatenated from them; ``None``
+    falls back to the whole-leaf blob (and is the only path for
+    manifests without shards). ``stats["bytes_fetched"]`` counts payload
+    bytes actually read from the store either way — the per-replica
+    swap-bytes rail in benchmarks/serving.py.
     """
 
     def __init__(self, store: Optional[BlobStore] = None,
-                 prepare_leaf: Optional[Callable[[Any], Any]] = None,
-                 clock: Callable[[], float] = time.time):
+                 prepare_leaf: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.time,
+                 shard_selector: Optional[Callable] = None):
         self.store = store
         self._prepare = prepare_leaf
+        self._prepare_with_path = _takes_path(prepare_leaf)
+        self._shard_selector = shard_selector
         self._clock = clock
         self._current: Optional[ServedModel] = None
         self._leaf_cache: Dict[str, Any] = {}
@@ -81,7 +115,7 @@ class ModelRegistry:
         #: adoption accounting, asserted by the delta-fetch unit tests
         self.stats: Dict[str, int] = {
             "blobs_fetched": 0, "leaves_reused": 0,
-            "swaps": 0, "rejected": 0,
+            "swaps": 0, "rejected": 0, "bytes_fetched": 0,
         }
 
     # -- the request-path surface -------------------------------------------
@@ -150,9 +184,10 @@ class ModelRegistry:
                 record, f"leaves_digest mismatch (announced {want}, "
                         f"manifest has {digest})")
         try:
-            payload, fetched, reused = self._materialize(store, manifest)
-        except (OSError, BlobIntegrityError, KeyError, ValueError,
-                pickle.UnpicklingError) as err:
+            payload, fetched, reused, nbytes = \
+                self._materialize(store, manifest)
+        except (OSError, BlobIntegrityError, KeyError, IndexError,
+                ValueError, pickle.UnpicklingError) as err:
             return self._reject(record, f"blob fetch/verify failed: {err}")
         now = self._clock()
         self._current = ServedModel(payload, dict(record), seq, digest, now)
@@ -160,6 +195,7 @@ class ModelRegistry:
         dt = time.perf_counter() - t0
         self.stats["blobs_fetched"] += fetched
         self.stats["leaves_reused"] += reused
+        self.stats["bytes_fetched"] += nbytes
         self.stats["swaps"] += 1
         _telemetry.inc("hvd_serving_swaps_total")
         _telemetry.observe("hvd_serving_swap_seconds", dt)
@@ -183,37 +219,66 @@ class ModelRegistry:
     def _materialize(self, store: BlobStore, manifest: Dict):
         """Payload pytree from a manifest, fetching only digests the leaf
         cache does not hold (mirrors elastic/state.py::_unpack_manifest,
-        plus the cache). Verification happens inside ``get_blob``."""
+        plus the cache). Verification happens inside ``get_blob`` — for a
+        shard-selected leaf that means a corrupted single PART blob
+        raises here and rejects the adoption wholesale (the serving
+        generation is kept by the caller)."""
         import jax
+        import numpy as np
         from ..elastic.state import _LeafRef
         skeleton = pickle.loads(store.get_blob(manifest["skeleton"]))
-        refs, treedef = jax.tree_util.tree_flatten(skeleton)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(skeleton)
         entries = manifest["leaves"]
-        leaves, fetched, reused = [], 0, 0
-        for ref in refs:
+        shards = manifest.get("shards") or {}
+        leaves, fetched, reused, nbytes = [], 0, 0, 0
+        for path, ref in flat:
             if not isinstance(ref, _LeafRef):
                 raise ValueError("manifest skeleton holds a non-ref leaf "
                                  f"({type(ref).__name__})")
             digest = entries[ref.index][0]
-            if digest in self._leaf_cache:
-                leaves.append(self._leaf_cache[digest])
+            names = tuple(_path_name(p) for p in path)
+            sel = None
+            meta = shards.get(digest)
+            if meta is not None and self._shard_selector is not None:
+                sel = self._shard_selector(names, meta)
+                if sel is not None:
+                    sel = [int(i) for i in sel] or None
+            key = digest if sel is None else \
+                digest + ":" + ",".join(str(i) for i in sel)
+            if key in self._leaf_cache:
+                leaves.append(self._leaf_cache[key])
                 reused += 1
                 continue
-            leaf = pickle.loads(store.get_blob(digest))
+            if sel is None:
+                blob = store.get_blob(digest)
+                nbytes += len(blob)
+                leaf = pickle.loads(blob)
+            else:
+                parts = []
+                for i in sel:
+                    blob = store.get_blob(meta["parts"][i][0])
+                    nbytes += len(blob)
+                    parts.append(np.asarray(pickle.loads(blob)))
+                leaf = parts[0] if len(parts) == 1 else np.concatenate(
+                    parts, axis=int(meta.get("axis", 0)))
             if self._prepare is not None:
-                leaf = self._prepare(leaf)
-            self._leaf_cache[digest] = leaf
+                leaf = self._prepare(leaf, names) \
+                    if self._prepare_with_path else self._prepare(leaf)
+            self._leaf_cache[key] = leaf
             leaves.append(leaf)
             fetched += 1
-        return jax.tree_util.tree_unflatten(treedef, leaves), fetched, reused
+        return (jax.tree_util.tree_unflatten(treedef, leaves),
+                fetched, reused, nbytes)
 
     def _prune_cache(self, manifest: Dict) -> None:
         """Keep only digests the NEW manifest references — older leaves
         stay alive exactly as long as an in-flight request holds the old
-        ``ServedModel``, then the GC takes them."""
+        ``ServedModel``, then the GC takes them. Shard-selected cache
+        keys (``digest:indices``) live and die with their leaf digest."""
         live = {entry[0] for entry in manifest.get("leaves", [])}
-        for digest in [d for d in self._leaf_cache if d not in live]:
-            del self._leaf_cache[digest]
+        for key in [k for k in self._leaf_cache
+                    if k.split(":", 1)[0] not in live]:
+            del self._leaf_cache[key]
 
     # -- discovery -----------------------------------------------------------
 

@@ -1,0 +1,44 @@
+"""lint-replicated-kv-pool fixture: a tp-mesh decode setup that
+allocates the paged-KV pools and feeds them straight to the sharded
+program — jit defaults them to REPLICATED, so all 8 devices hold the
+full cache and shard_map reshards it every step. Exactly ONE finding:
+the placed variant, the single-device (no mesh) variant, and the
+pragma'd probe below must stay clean.
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models import decode as MD
+from horovod_tpu.parallel import create_mesh
+
+
+def build_engine_replicated(cfg, block_size, n_blocks, slots):
+    mesh = create_mesh({"tp": 8}, devices=jax.devices()[:8])
+    kp, vp = MD.init_kv_pools(  # <- lint-replicated-kv-pool
+        cfg, n_blocks, block_size)
+    step = MD.make_decode_step_tp(cfg, block_size, mesh)
+    return mesh, step, kp, vp
+
+
+def build_engine_placed(cfg, block_size, n_blocks, slots):
+    # Clean: pools land head-sharded on the tp mesh before first use.
+    mesh = create_mesh({"tp": 8}, devices=jax.devices()[:8])
+    kp, vp = MD.init_kv_pools(cfg, n_blocks, block_size)
+    nd = NamedSharding(mesh, MD.kv_pool_spec())
+    kp, vp = jax.device_put(kp, nd), jax.device_put(vp, nd)
+    step = MD.make_decode_step_tp(cfg, block_size, mesh)
+    return mesh, step, kp, vp
+
+
+def build_engine_single_device(cfg, block_size, n_blocks):
+    # Clean: no mesh in sight — the unsharded engine's pool allocation.
+    kp, vp = MD.init_kv_pools(cfg, n_blocks, block_size)
+    step = MD.make_decode_step(cfg, block_size)
+    return step, kp, vp
+
+
+def pool_memory_probe(cfg, block_size, n_blocks):
+    # Clean: a deliberate replicated-pool probe carries the pragma.
+    mesh = create_mesh({"tp": 8}, devices=jax.devices()[:8])
+    kp, vp = MD.init_kv_pools(cfg, n_blocks, block_size)  # hvd-analyze: ok
+    return mesh, kp.nbytes + vp.nbytes

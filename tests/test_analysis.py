@@ -216,6 +216,7 @@ LINT_CASES = [
     ("bad_recompile_request_path.py", "lint-recompile-in-request-path",
      "warning"),
     ("bad_xplane_umbrella.py", "lint-xplane-umbrella", "warning"),
+    ("bad_replicated_kv_pool.py", "lint-replicated-kv-pool", "warning"),
 ]
 
 

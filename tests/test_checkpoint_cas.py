@@ -235,6 +235,207 @@ def test_gc_keeps_pinned_manifest_and_blobs_past_retention(tmp_path):
     assert not store.has_blob(digests[1])
 
 
+# --- per-shard blob layer (ISSUE 14) ----------------------------------------
+
+def _clean_counters():
+    return {"steps_skipped": 0.0, "rollbacks": 0.0}
+
+
+def _decode_like_params(seed, dim=32, hidden=64):
+    """A params tree keyed like the decode plane's (attn/mlp kernels),
+    small enough to publish in milliseconds — ``tp_shard_plan`` matches
+    on path names and shapes, not on flax types."""
+    rng = np.random.RandomState(seed)
+    leaf = lambda *s: rng.randn(*s).astype(np.float32)  # noqa: E731
+    return {
+        "tok_embeddings": {"embedding": leaf(64, dim)},   # replicated
+        "block_0": {
+            "attn": {"wq": {"kernel": leaf(dim, dim)},    # column (axis 1)
+                     "wo": {"kernel": leaf(dim, dim)}},   # row (axis 0)
+            "mlp": {"w1": {"kernel": leaf(dim, hidden)},
+                    "w2": {"kernel": leaf(hidden, dim)}},
+        },
+    }
+
+
+def _publish_params(tmp_path, name, params, shard_plan=None):
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.serving.publisher import Publisher
+    d = str(tmp_path / name)
+    state = ObjectState(commit_dir=d, commit_async=False, params=params)
+    pub = Publisher(d, every=1, counters=_clean_counters,
+                    shard_plan=shard_plan)
+    state.commit()
+    rec = pub.maybe_publish(state._commit_seq)
+    assert rec is not None
+    return state, pub, rec
+
+
+def test_shard_manifest_roundtrip_and_identity(tmp_path):
+    """Manifest encode/decode: every planned leaf gets an
+    ``shards[leaf_digest] = {axis, n, parts}`` entry whose parts
+    re-concatenate bit-identically to the whole-leaf blob — and the
+    shard layer does NOT change ``leaves_digest`` (the served identity
+    covers skeleton + leaf digests only)."""
+    from horovod_tpu.serving.decode import tp_shard_plan
+    from horovod_tpu.serving.publisher import leaves_digest
+
+    tp = 4
+    params = _decode_like_params(0)
+    state, pub, rec = _publish_params(tmp_path, "cas", params,
+                                      shard_plan=tp_shard_plan(tp))
+    manifest = pub.store.read_manifest(rec["manifest_seq"])
+    shards = manifest["shards"]
+    # wq/wo/w1/w2 kernels planned; the embedding is replicated (no entry).
+    assert len(shards) == 4
+    leaf_bytes = {e[0]: e[1] for e in manifest["leaves"]}
+    for digest, meta in shards.items():
+        assert meta["n"] == tp and len(meta["parts"]) == tp
+        assert meta["axis"] in (0, 1)
+        whole = pickle.loads(pub.store.get_blob(digest, verify=True))
+        parts = [pickle.loads(pub.store.get_blob(p[0], verify=True))
+                 for p in meta["parts"]]
+        np.testing.assert_array_equal(
+            np.concatenate(parts, axis=meta["axis"]), whole)
+        for p in meta["parts"]:
+            assert p[1] > 0
+        assert digest in leaf_bytes                 # whole leaf stays
+    # Identity: stripping the shard layer leaves the digest unchanged.
+    bare = {k: v for k, v in manifest.items() if k != "shards"}
+    assert leaves_digest(manifest) == leaves_digest(bare) \
+        == rec["leaves_digest"]
+
+
+def test_shard_read_compat_both_ways(tmp_path):
+    """Old reader × new manifest and new reader × old manifest both
+    restore bit-identical payloads: whole-leaf blobs stay authoritative."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    from horovod_tpu.serving.registry import ModelRegistry
+
+    params = _decode_like_params(3)
+    # New manifest (with shards), plain registry (no selector).
+    _, pub, rec = _publish_params(tmp_path, "new", params,
+                                  shard_plan=tp_shard_plan(4))
+    plain = ModelRegistry(store=pub.store)
+    assert plain.adopt(rec)
+    got = plain.current().payload["attrs"]["params"]
+    for k in ("wq", "wo"):
+        np.testing.assert_array_equal(
+            np.asarray(got["block_0"]["attn"][k]["kernel"]),
+            params["block_0"]["attn"][k]["kernel"])
+    # Old manifest (no shards), shard-selecting registry: falls back to
+    # the whole leaf and still lands the complete payload.
+    _, pub2, rec2 = _publish_params(tmp_path, "old", params)
+    assert "shards" not in pub2.store.read_manifest(rec2["manifest_seq"])
+    sel = ModelRegistry(store=pub2.store,
+                        shard_selector=tp_shard_selector(4, 1))
+    assert sel.adopt(rec2)
+    got2 = sel.current().payload["attrs"]["params"]
+    np.testing.assert_array_equal(
+        np.asarray(got2["block_0"]["mlp"]["w1"]["kernel"]),
+        params["block_0"]["mlp"]["w1"]["kernel"])
+
+
+def test_shard_delta_fetch_counts_and_topology_change(tmp_path):
+    """A shard-selecting registry fetches only its part bytes for planned
+    leaves; a selector whose tp does NOT match the manifest's shard count
+    (topology changed between publish and serve) falls back to whole
+    leaves — correct first, cheap second."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    from horovod_tpu.serving.registry import ModelRegistry
+
+    tp = 4
+    state, pub, rec = _publish_params(tmp_path, "cas",
+                                      _decode_like_params(1),
+                                      shard_plan=tp_shard_plan(tp))
+    full = ModelRegistry(store=pub.store)
+    shard = ModelRegistry(store=pub.store,
+                          shard_selector=tp_shard_selector(tp, 2))
+    mismatch = ModelRegistry(store=pub.store,
+                             shard_selector=tp_shard_selector(2, 1))
+    assert full.adopt(rec) and shard.adopt(rec) and mismatch.adopt(rec)
+    fb = full.stats["bytes_fetched"]
+    sb = shard.stats["bytes_fetched"]
+    mb = mismatch.stats["bytes_fetched"]
+    # Sharded leaves dominate this tree, so the delta is well under 1/2.
+    assert 0 < sb < fb / 2, (sb, fb)
+    # n=4 manifest × tp=2 selector: every leaf falls back to whole bytes.
+    assert mb == fb, (mb, fb)
+    # The mismatch payload is still complete and correct.
+    got = mismatch.current().payload["attrs"]["params"]
+    np.testing.assert_array_equal(
+        np.asarray(got["block_0"]["attn"]["wo"]["kernel"]),
+        np.asarray(full.current()
+                   .payload["attrs"]["params"]["block_0"]["attn"]["wo"]
+                   ["kernel"]))
+    # And the shard registry's planned leaves are the right slices.
+    wq = np.asarray(shard.current()
+                    .payload["attrs"]["params"]["block_0"]["attn"]["wq"]
+                    ["kernel"])
+    wq_full = np.asarray(full.current()
+                         .payload["attrs"]["params"]["block_0"]["attn"]
+                         ["wq"]["kernel"])
+    np.testing.assert_array_equal(wq, np.split(wq_full, tp, axis=1)[2])
+
+
+def test_corrupted_shard_part_rejected_keeps_generation(tmp_path):
+    """One bit-flipped part blob must fail adoption LOUDLY on the shard
+    registry — which keeps serving its previous generation — while the
+    whole-leaf path (intact blobs) adopts the same publish fine."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    from horovod_tpu.serving.registry import ModelRegistry
+
+    tp = 4
+    state, pub, rec = _publish_params(tmp_path, "cas",
+                                      _decode_like_params(5),
+                                      shard_plan=tp_shard_plan(tp))
+    full = ModelRegistry(store=pub.store)
+    shard = ModelRegistry(store=pub.store,
+                          shard_selector=tp_shard_selector(tp, 0))
+    assert full.adopt(rec) and shard.adopt(rec)
+
+    state.params = _decode_like_params(6)
+    state.commit()
+    rec2 = pub.maybe_publish(state._commit_seq)
+    manifest = pub.store.read_manifest(rec2["manifest_seq"])
+    part_digest = next(iter(manifest["shards"].values()))["parts"][0][0]
+    with open(pub.store.blob_path(part_digest), "r+b") as fh:
+        fh.seek(10)
+        fh.write(b"\xff\xff\xff")
+
+    kept = shard.current().manifest_seq
+    rejected = shard.stats["rejected"]
+    assert shard.adopt(rec2) is False
+    assert shard.current().manifest_seq == kept      # generation kept
+    assert shard.stats["rejected"] == rejected + 1
+    assert full.adopt(rec2)                          # whole leaves intact
+
+
+def test_gc_keeps_shard_part_blobs_of_live_manifests(tmp_path):
+    """``referenced_digests`` names part blobs, so GC cannot sweep the
+    shard layer out from under a live (or pinned) manifest; dropping the
+    manifest releases the parts like any other blob."""
+    store = BlobStore(str(tmp_path / "cas"))
+    leaf, _ = store.put_blob(b"leaf-bytes" * 100)
+    p1, _ = store.put_blob(b"part-one")
+    p2, _ = store.put_blob(b"part-two")
+    store.publish_manifest({
+        "seq": 1, "skeleton": leaf, "leaves": [[leaf, 1000]],
+        "shards": {leaf: {"axis": 0, "n": 2,
+                          "parts": [[p1, 8], [p2, 8]]}}})
+    refs = store.referenced_digests([store.read_manifest(1)])
+    assert p1 in refs and p2 in refs
+    time.sleep(0.02)
+    d2, _ = store.put_blob(b"gen-2")
+    store.publish_manifest({"seq": 2, "skeleton": d2, "leaves": [[d2, 5]]})
+    store.gc(2)                     # both manifests live: parts survive
+    assert store.has_blob(p1) and store.has_blob(p2)
+    time.sleep(0.02)
+    store.gc(1)                     # manifest 1 swept: parts released
+    assert store.manifest_seqs() == [2]
+    assert not store.has_blob(p1) and not store.has_blob(p2)
+
+
 # --- torn commit (crash between blob write and manifest publish) ------------
 
 _TORN_WORKER = textwrap.dedent("""

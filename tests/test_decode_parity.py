@@ -15,6 +15,11 @@ flax modules operating on gathered KV pages; these tests pin it against
 
 Mixtral runs with ``capacity_factor=8.0`` so neither path drops routed
 tokens — parity is about the cache, not the router's lossy capacity.
+
+The tensor-parallel section (ISSUE 14) pins the shard_map'd engine:
+greedy streams bit-identical across tp=1/2/4 for both models, stall
+mid-generation, and swap-mid-decode under refill — the head-sharded
+decode program must be a pure layout change.
 """
 
 import dataclasses
@@ -204,6 +209,116 @@ def test_stall_mid_generation_preserves_greedy_stream(llama):
     assert b.error is None and not b.truncated
     assert a.tokens == _flax_greedy(model, params, [1, 2], 10)
     assert b.tokens == _flax_greedy(model, params, [3, 4, 5, 6], 4)
+
+
+# --------------------------------------------------- tensor-parallel
+
+
+def _build_tp(kind: str, seed: int = 0):
+    """TP-friendly head counts (tp ∈ {2, 4} divides n_heads=8,
+    n_kv_heads=4, hidden_dim=128); same tiny scale otherwise."""
+    if kind == "llama":
+        from horovod_tpu.models.llama import Llama, llama_tiny
+        cfg = dataclasses.replace(llama_tiny(), n_heads=8, n_kv_heads=4)
+        model = Llama(cfg)
+    else:
+        from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+        cfg = dataclasses.replace(mixtral_tiny(), n_heads=8, n_kv_heads=4,
+                                  capacity_factor=8.0)
+        model = Mixtral(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 16), jnp.int32)))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture(scope="module")
+def llama_tp():
+    return _build_tp("llama")
+
+
+@pytest.fixture(scope="module")
+def mixtral_tp():
+    return _build_tp("mixtral")
+
+
+def _tp_engine(cfg, params, tp, policy="refill", **kw):
+    from horovod_tpu.parallel import create_mesh
+    from horovod_tpu.serving.decode import DecodeEngine
+    mesh = None if tp <= 1 else create_mesh(
+        {"tp": tp}, devices=jax.devices()[:tp])
+    kw.setdefault("slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("pool_blocks", 24)
+    kw.setdefault("max_blocks_per_slot", 8)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return DecodeEngine(cfg, params=params, swap_policy=policy,
+                        mesh=mesh, **kw)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_tp_greedy_stream_bit_identical(kind, tp, llama_tp, mixtral_tp):
+    """The shard_map'd engine must emit the SAME greedy token stream as
+    the single-device engine and the flax loop — head-sharded attention
+    and row/column-split matmuls change the reduction layout, not the
+    argmax winner (ISSUE 14 wire contract keeps the math exact)."""
+    cfg, model, params = llama_tp if kind == "llama" else mixtral_tp
+    prompt = [11, 3, 20, 5, 42, 7]
+    want = _flax_greedy(model, params, prompt, 8)
+
+    base = _tp_engine(cfg, params, tp=1)
+    req1 = base.submit(prompt, 8)
+    base.run_until_idle()
+    assert req1.error is None and req1.tokens == want
+
+    eng = _tp_engine(cfg, params, tp=tp)
+    assert eng.tp == tp
+    req = eng.submit(prompt, 8)
+    eng.run_until_idle()
+    assert req.error is None
+    assert req.tokens == want == req1.tokens
+
+
+def test_tp_stall_mid_generation_preserves_stream(llama_tp):
+    """The stall/resume path (pending token held across a block-extension
+    stall) must survive sharded decode: the replicated token buffer is
+    per-slot host state, not per-shard state."""
+    cfg, model, params = llama_tp
+    eng = _tp_engine(cfg, params, tp=2, slots=2, block_size=4,
+                     pool_blocks=4, max_blocks_per_slot=4,
+                     prefill_buckets=(4, 8))
+    a = eng.submit([1, 2], 10)        # extends at pos 4 → stalls on pool
+    b = eng.submit([3, 4, 5, 6], 4)
+    stalled_seen = False
+    for _ in range(100):
+        if not eng.has_work():
+            break
+        eng.decode_once()
+        stalled_seen = stalled_seen or eng.slots[0].stalled
+    assert stalled_seen, "slot A never stalled — the scenario regressed"
+    assert a.error is None and not a.truncated
+    assert a.tokens == _flax_greedy(model, params, [1, 2], 10)
+    assert b.tokens == _flax_greedy(model, params, [3, 4, 5, 6], 4)
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_tp_refill_swap_mid_decode_is_transparent(kind, llama_tp,
+                                                  mixtral_tp):
+    """Swap-mid-decode on the sharded engine: install_params re-places
+    every leaf per the megatron plan and re-prefills live slots — the
+    greedy continuation must be unperturbed and the remap must free the
+    original blocks."""
+    cfg, model, params = llama_tp if kind == "llama" else mixtral_tp
+    eng = _tp_engine(cfg, params, tp=2, policy="refill")
+    prompt = [2, 9, 33, 4, 17, 6]
+    req = eng.submit(prompt, 10)
+    for _ in range(4):
+        eng.decode_once()
+    eng.install_params(params)                   # same weights, new seq
+    eng.run_until_idle()
+    assert req.error is None and not req.truncated
+    assert req.tokens == _flax_greedy(model, params, prompt, 10)
+    assert eng.allocator.free_blocks == 23       # remap freed the originals
 
 
 def test_refill_outgrown_sequence_retires_truncated(llama):
