@@ -48,6 +48,40 @@ walls alongside. Also recorded: per-arm steady-state compile counts
 adopted by a shard-selecting replica registry vs a whole-leaf registry,
 railed at ``replica <= full/tp * 1.25``.
 
+Sharded-decode noise band (satellite of ISSUE 16): the committed
+mixtral tp8_vs_tp1 ratio is large (~9–14) because the normalization
+credits tp× device concurrency, so its ABSOLUTE spread is large too;
+the honest figure is the RELATIVE spread (spread / ratio_min). The
+windows here (``rounds=4, s_short=3, s_long=9``) hold the relative
+spread under ~0.45 on this box; tests/test_serving_decode_guardrail.py
+pins that ceiling on the committed record.
+
+The **spec_decode** segment (ISSUE 16) A/Bs speculative decode
+(host n-gram drafting + one-shot k-token verify, serving/decode.py)
+against the plain one-token engine, SAME model/slots/pool, two
+workloads inside every interleaved round:
+
+- **repeat_heavy** — a periodic prompt; greedy decode of the tiny
+  model settles into a loop the built-in n-gram drafter locks onto,
+  so accepted length approaches k−1 and tokens/s must reach
+  ≥1.5× plain;
+- **adversarial** — random-token prompts plus an injected
+  always-wrong drafter (next = last+1 mod V): every draft is
+  rejected, the verify still emits its one guaranteed token per tick,
+  and tokens/s must hold ≥0.9× plain — the lossless-fallback rail.
+
+Arms run at a LONG context provision (3072-position tables) — the
+memory-bound regime speculative decode targets, where the k-wide
+verify's wall equals a decode tick's (at short tables the per-token
+weight math dominates and verify reads ~10% slower). Because spec
+emits a VARIABLE number of tokens per tick, window-pair slope
+differencing breaks (token and wall deltas fluctuate independently);
+each figure is a synced token RATE over a ~25-tick window, engines
+warmed past the repeat stream's ~25-token transient first. Zero
+steady-state recompiles required in every arm; the per-arm ratios
+land in perf_history as ``kind: "spec_decode"`` records ratcheted by
+``tools.perf check``.
+
 Emits ONE JSON line (bench.py convention) and appends it — stamped with
 date + git SHA — to ``benchmarks/serving_history.jsonl`` unless
 ``HOROVOD_SERVING_NO_HISTORY`` is set. ``--check`` validates the newest
@@ -102,6 +136,13 @@ MAX_DECODE_P99_S = 5.0
 #: share of the full-leaf bytes.
 MIN_TP8_SCALING = 3.0
 SHARD_SWAP_SLACK = 1.25
+#: Spec-decode rails (ISSUE 16 acceptance): with a drafter that hits
+#: (repeat-heavy stream) speculative decode must deliver ≥1.5× plain
+#: tokens/s; with an always-wrong drafter (adversarial stream) it must
+#: not fall below 0.9× plain — rejection costs one k-wide verify that
+#: still emits its guaranteed token, never a stall.
+MIN_SPEC_REPEAT_SPEEDUP = 1.5
+MIN_SPEC_ADVERSARIAL_RATIO = 0.9
 
 
 def _counters_clean() -> Dict[str, int]:
@@ -343,6 +384,13 @@ def run_decode_segment(*, rounds: int = 5, slots: int = 8,
     reqs = [eng.submit(prompt, max_new) for _ in range(slots)]
     eng.decode_once()               # admits all slots (prefill compiles)
     ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+    # TTFT split (ISSUE 16 satellite): time queued awaiting a slot vs
+    # the prefill wall itself — ttft ≈ queue_wait + prefill_wall, and
+    # only the second half is the model's bill.
+    qwaits = sorted(r.queue_wait_s for r in reqs
+                    if r.queue_wait_s is not None)
+    pwalls = sorted(r.prefill_wall_s for r in reqs
+                    if r.prefill_wall_s is not None)
 
     full_seq = 64                   # bucket for prompt 16 + max_new 48
     full_toks = jnp.zeros((slots, full_seq), jnp.int32)
@@ -384,7 +432,17 @@ def run_decode_segment(*, rounds: int = 5, slots: int = 8,
             rnds, "full8", "decode8"), 4),
         "noise": _noise(ratios),
         "ttft_p50_s": round(statistics.median(ttfts), 6) if ttfts else None,
+        "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 6)
+        if ttfts else None,
         "ttft_max_s": round(ttfts[-1], 6) if ttfts else None,
+        "queue_wait_p50_s": round(statistics.median(qwaits), 6)
+        if qwaits else None,
+        "queue_wait_p99_s": round(float(np.percentile(qwaits, 99)), 6)
+        if qwaits else None,
+        "prefill_wall_p50_s": round(statistics.median(pwalls), 6)
+        if pwalls else None,
+        "prefill_wall_p99_s": round(float(np.percentile(pwalls, 99)), 6)
+        if pwalls else None,
         "steady_decode_compiles": steady_compiles,
         "compile_counts": dict(eng.compile_counts),
         "swap": swap,
@@ -480,15 +538,18 @@ NORMALIZED_UNIT = ("tokens per device-time second: slots*devices/wall; "
                    "so wall ~= N x per-device time")
 
 
-def run_sharded_decode_segment(*, rounds: int = 3, base_slots: int = 4,
-                               s_short: int = 2, s_long: int = 6,
+def run_sharded_decode_segment(*, rounds: int = 4, base_slots: int = 4,
+                               s_short: int = 3, s_long: int = 9,
                                tps=(1, 4, 8)) -> dict:
     """Paired tp=1 vs tp=4/8 decode arms for BOTH LLMs at a fixed
     per-device KV budget: the tp arm shards the pool over heads (1/tp
     bytes per device) and spends the headroom on tp× slots — the
     capacity scaling ROADMAP 3(a) asks serving to buy with more chips.
     All arms ride inside every ``slope_time_paired`` round; scaling is
-    the median of per-round normalized-tokens/s ratios."""
+    the median of per-round normalized-tokens/s ratios. Windows are
+    longer than the decode segment's (3/9-step pairs, 4 rounds) to hold
+    the mixtral ratio's relative spread under the guardrail ceiling —
+    see the module docstring's noise-band note."""
     import jax
 
     from horovod_tpu.parallel import create_mesh
@@ -612,6 +673,157 @@ def _run_shard_swap_bytes(mkparams, tps=(4, 8)) -> dict:
     return out
 
 
+# -- speculative decode vs plain one-token decode (ISSUE 16) ------------------
+
+
+def run_spec_decode_segment(*, rounds: int = 6, slots: int = 4,
+                            spec_k: int = 4, ctx_blocks: int = 192,
+                            window_ticks: int = 25,
+                            warm_tokens: int = 40) -> dict:
+    """Paired spec-vs-plain tokens/s on two workloads (module docstring):
+    repeat_heavy (built-in n-gram drafter, settled acceptance ~2
+    tokens/slot/tick on the periodic stream) and adversarial (random
+    prompts + an injected always-wrong drafter, acceptance 0 — the
+    lossless floor). The arms run at a LONG context provision
+    (``ctx_blocks=192`` → 3072-position tables): speculative decode
+    targets memory-bound decode, and on this tiny model the shared
+    KV-gather only dominates the per-token weight math once the table
+    is wide — at 320 positions the k-wide verify costs ~10% more than
+    a decode tick, at 2048–3072 the two walls are equal, which is the
+    regime the adversarial floor is honest in. Wider is NOT better
+    past that: at 3584+ positions the k-row verify's working set falls
+    out of cache while the 1-row decode's still fits (measured
+    adversarial 0.99 → 0.60 between 192 and 224 blocks — a real cache
+    cliff, not noise). Both arms of a pair see the SAME width, so the
+    A/B stays fair at any provision.
+
+    Because spec emits a VARIABLE number of tokens per tick, two-window
+    slope pairing breaks (the token delta and the wall delta fluctuate
+    independently — measured negative slopes); each arm's figure is
+    instead a plain rate over one ~25-tick window, synced at both
+    edges, all four engines interleaved inside every round. Every
+    engine is first warmed past the ~25-token aperiodic transient the
+    repeat prompt emits before its stream settles (``warm_tokens``),
+    so the n-gram drafter is measured in its steady acceptance regime."""
+    import gc
+
+    from horovod_tpu.serving.decode import DecodeEngine
+
+    # Collect any pending garbage before the timed windows: the spec
+    # arm syncs every tick, so a deferred free landing mid-window hits
+    # it ~25× harder than the once-per-window-synced plain arm.
+    gc.collect()
+    cfg, model, params = _llama_decode_fixture()
+    bs = 16
+    vocab = int(cfg.vocab_size)
+    prompt_len = 16
+    # Token budget: warm + every measured window at full acceptance
+    # must finish without any slot retiring mid-measurement.
+    max_new = ctx_blocks * bs - prompt_len - (spec_k - 1)
+    need = warm_tokens + (rounds + 1) * window_ticks * spec_k
+    assert need < max_new, (need, max_new)
+
+    repeat_prompt = [3, 5, 7, 9] * (prompt_len // 4)
+    rng = np.random.RandomState(7)
+    adv_prompts = [[int(t) for t in rng.randint(0, vocab, size=prompt_len)]
+                   for _ in range(slots)]
+
+    def pessimal_draft(ctx, n):
+        # Deterministically wrong against any stream the model emits at
+        # that position EXCEPT a coincidental last+1 — rejection rate is
+        # ~1 and the engine's guaranteed token is the only progress.
+        return [(int(ctx[-1]) + 1) % vocab] * n
+
+    def mk_engine(k, draft_fn=None):
+        return DecodeEngine(cfg, params=params, slots=slots,
+                            block_size=bs,
+                            pool_blocks=slots * ctx_blocks + 2,
+                            max_blocks_per_slot=ctx_blocks,
+                            prefill_buckets=(prompt_len,),
+                            swap_policy="refill", spec_k=k,
+                            draft_fn=draft_fn)
+
+    arms = {
+        "repeat_heavy": {"plain": mk_engine(0), "spec": mk_engine(spec_k)},
+        "adversarial": {"plain": mk_engine(0),
+                        "spec": mk_engine(spec_k,
+                                          draft_fn=pessimal_draft)},
+    }
+    for name, pair in arms.items():
+        prompts = [repeat_prompt] * slots if name == "repeat_heavy" \
+            else adv_prompts
+        for eng in pair.values():
+            for p in prompts:
+                eng.submit(list(p), max_new)
+
+    ticks = {(n, a): 0 for n in arms for a in ("plain", "spec")}
+
+    def _sync(eng):
+        # Spec ticks already synced on the host token fetch; the plain
+        # arm syncs its device token refs.
+        common.sync(eng._kp if eng.spec_k else eng._dev_tokens)
+
+    # Warm-in: compile (admit + first ticks) AND run past the repeat
+    # stream's aperiodic transient so the drafter is measured settled.
+    for name, pair in arms.items():
+        for arm, eng in pair.items():
+            while min(s.gen for s in eng.slots) < warm_tokens:
+                eng.decode_once()
+                ticks[(name, arm)] += 1
+            _sync(eng)
+    warm = {(n, a): dict(e.compile_counts)
+            for n, pair in arms.items() for a, e in pair.items()}
+
+    def token_rate(name, arm):
+        eng = arms[name][arm]
+        _sync(eng)
+        t0, e0 = time.perf_counter(), eng.tokens_emitted
+        for _ in range(window_ticks):
+            eng.decode_once()
+        _sync(eng)
+        ticks[(name, arm)] += window_ticks
+        return (eng.tokens_emitted - e0) / max(
+            time.perf_counter() - t0, 1e-9)
+
+    per_round: Dict[str, List[dict]] = {n: [] for n in arms}
+    for _ in range(rounds):
+        # Interleaved: all four arms inside every round, so drift hits
+        # them alike (CLAUDE.md: ratios, never separate blocks).
+        for name in arms:
+            tps = {a: token_rate(name, a) for a in ("plain", "spec")}
+            per_round[name].append(
+                {**tps, "ratio": tps["spec"] / max(tps["plain"], 1e-9)})
+
+    out_arms = {}
+    for name, pair in arms.items():
+        ratios = sorted(r["ratio"] for r in per_round[name])
+        steady = {a: sum(e.compile_counts.get(prog, 0)
+                         - warm[(name, a)].get(prog, 0)
+                         for prog in set(e.compile_counts)
+                         | set(warm[(name, a)]))
+                  for a, e in pair.items()}
+        spec_eng = pair["spec"]
+        out_arms[name] = {
+            "tokens_per_s": {
+                a: round(statistics.median(
+                    r[a] for r in per_round[name]), 1)
+                for a in ("plain", "spec")},
+            "speedup": round(statistics.median(ratios), 4),
+            "noise": _noise(ratios),
+            "spec_tokens_per_tick": round(
+                spec_eng.tokens_emitted / max(ticks[(name, "spec")], 1), 3),
+            "compile_counts": {a: dict(e.compile_counts)
+                               for a, e in pair.items()},
+            "steady_compiles": steady,
+        }
+    return {
+        "model": "llama_tiny", "slots": slots, "spec_k": spec_k,
+        "block_size": bs, "ctx_blocks": ctx_blocks,
+        "window_ticks": window_ticks, "rounds": rounds,
+        "prompt_len": prompt_len, "arms": out_arms,
+    }
+
+
 # -- aggregation --------------------------------------------------------------
 
 
@@ -625,6 +837,14 @@ def _noise(ratios: List[float]) -> dict:
 
 def run_harness(*, rounds: int, swaps: int, n_leaves: int,
                 leaf_elems: int) -> dict:
+    # The spec segment runs FIRST: its spec arm syncs the device every
+    # tick (acceptance needs the [S, k] fetch), so it is the segment
+    # most sensitive to process state the others leave behind (compiled
+    # mixtral tp8 programs, server/poll threads, deferred frees — the
+    # first full-harness run measured the same arms ~0.07 lower than
+    # standalone). Measuring it on the fresh process keeps the ratio
+    # honest; the other segments sync once per window and don't care.
+    spec = run_spec_decode_segment(rounds=max(6, rounds + 1))
     arms: Dict[str, List[dict]] = {"all": [], "frozen": []}
     pair_ratios: List[float] = []
     for _ in range(rounds):
@@ -643,7 +863,7 @@ def run_harness(*, rounds: int, swaps: int, n_leaves: int,
     stale = run_staleness_segment(commits=5, cadence_s=0.2,
                                   n_leaves=n_leaves, leaf_elems=leaf_elems)
     decode = run_decode_segment(rounds=rounds)
-    sharded = run_sharded_decode_segment(rounds=max(3, rounds - 2))
+    sharded = run_sharded_decode_segment(rounds=max(4, rounds - 1))
 
     def med(mode: str, field: str) -> float:
         return round(statistics.median(
@@ -666,6 +886,7 @@ def run_harness(*, rounds: int, swaps: int, n_leaves: int,
         "staleness": stale,
         "decode": decode,
         "sharded_decode": sharded,
+        "spec_decode": spec,
     }
 
 
@@ -747,6 +968,19 @@ def check_history(path: str = HISTORY_PATH) -> dict:
     ttft = dec.get("ttft_p50_s")
     need(isinstance(ttft, (int, float)) and ttft > 0,
          f"decode ttft_p50_s missing or non-positive: {ttft}")
+    ttft99 = dec.get("ttft_p99_s")
+    need(isinstance(ttft99, (int, float)) and ttft99 >= ttft,
+         f"decode ttft_p99_s missing or below p50: {ttft99}")
+    # TTFT split: queue wait + prefill wall must both be recorded (the
+    # split is the actionable figure — which half of TTFT to attack).
+    for field in ("queue_wait_p50_s", "queue_wait_p99_s",
+                  "prefill_wall_p50_s", "prefill_wall_p99_s"):
+        v = dec.get(field)
+        need(isinstance(v, (int, float)) and v >= 0,
+             f"decode {field} missing or negative: {v}")
+    pw = dec.get("prefill_wall_p50_s")
+    need(isinstance(pw, (int, float)) and pw > 0,
+         f"decode prefill_wall_p50_s must be positive: {pw}")
     dswap = dec.get("swap") or {}
     p99 = dswap.get("p99_step_s")
     need(dswap.get("swaps_during", 0) >= 2
@@ -782,6 +1016,35 @@ def check_history(path: str = HISTORY_PATH) -> dict:
                  f"bytes {fb}")
         need(len(m.get("swap_bytes") or {}) >= 2,
              f"{kind} swap_bytes must cover tp=4 and tp=8")
+    spec = rec.get("spec_decode") or {}
+    need(isinstance(spec.get("spec_k"), int) and spec.get("spec_k", 0) >= 2,
+         f"spec_decode spec_k missing or < 2: {spec.get('spec_k')}")
+    sarms = spec.get("arms") or {}
+    need(set(sarms) >= {"repeat_heavy", "adversarial"},
+         f"spec_decode must cover both workloads, got {sorted(sarms)}")
+    floors = {"repeat_heavy": MIN_SPEC_REPEAT_SPEEDUP,
+              "adversarial": MIN_SPEC_ADVERSARIAL_RATIO}
+    for name, arm in sorted(sarms.items()):
+        spd = arm.get("speedup")
+        floor = floors.get(name, MIN_SPEC_ADVERSARIAL_RATIO)
+        need(isinstance(spd, (int, float)) and spd >= floor,
+             f"spec_decode {name} speedup={spd} < {floor}x plain")
+        anoise = arm.get("noise") or {}
+        need(anoise.get("rounds", 0) >= 3
+             and all(k in anoise
+                     for k in ("ratio_min", "ratio_max", "spread")),
+             f"spec_decode {name} noise band incomplete: {anoise}")
+        tps_arm = arm.get("tokens_per_s") or {}
+        need(all(isinstance(tps_arm.get(a), (int, float))
+                 and tps_arm.get(a, 0) > 0 for a in ("plain", "spec")),
+             f"spec_decode {name} tokens/s missing: {tps_arm}")
+        steady = arm.get("steady_compiles") or {}
+        need(steady and all(v == 0 for v in steady.values()),
+             f"spec_decode {name} recompiled in steady state: {steady}")
+        counts = (arm.get("compile_counts") or {}).get("spec") or {}
+        need(counts.get("verify") == 1 and counts.get("decode", 0) == 0,
+             f"spec_decode {name} spec arm compile counts off (want one "
+             f"verify, zero decode): {counts}")
     return {"check": "serving", "ok": not problems,
             "record_date": rec.get("date"), "record_git": rec.get("git"),
             "problems": problems}
@@ -862,6 +1125,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "tokens_per_s_normalized":
                         m.get("tokens_per_s_normalized"),
                     "noise": (m.get("noise") or {}).get("tp8_vs_tp1"),
+                })
+        spec = rec.get("spec_decode") or {}
+        for arm_name, arm in sorted((spec.get("arms") or {}).items()):
+            if isinstance(arm.get("speedup"), (int, float)):
+                from horovod_tpu.tools import perf as perf_tools
+                perf_tools.append_history({
+                    "kind": "spec_decode",
+                    "metric": "spec_decode_speedup",
+                    "model": "llama_tiny_serve_cpu8",
+                    "arm": arm_name,
+                    "ratio": arm["speedup"],
+                    "spec_k": spec.get("spec_k"),
+                    "tokens_per_s": arm.get("tokens_per_s"),
+                    "noise": arm.get("noise"),
+                    "steady_compiles": sum(
+                        (arm.get("steady_compiles") or {}).values()),
                 })
     return 0
 

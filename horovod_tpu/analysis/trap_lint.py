@@ -136,6 +136,18 @@ CLAUDE.md "Environment traps"):
   one allreduce — ``train/step_builder.py::accumulate_gradients`` is
   the reference shape.
 
+- ``lint-host-draft-loop`` (WARNING): a speculative-decode DRAFTING
+  loop (its target, iterable, or a called name mentions ``draft``) that
+  invokes a jitted callable or a ``decode``/``verify``/``prefill``
+  device program per candidate token.  Speculation's contract
+  (docs/serving.md "Speculative decode") is host-side drafting over
+  tokens the engine already holds and ONE K-wide verify call per tick —
+  a device round-trip per drafted token serializes exactly the
+  memory-bound pipeline speculation exists to widen, costing more than
+  the plain path it replaces.  Draft from host ints
+  (``serving/decode.py::_ngram_draft``), batch the window, verify once;
+  pragma a deliberate draft-model forward.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -225,6 +237,23 @@ def _is_decode_fetch(name: str) -> bool:
         prefix = ".".join(parts[:-1]).lower()
         return "jnp" not in prefix and "jax" not in prefix
     return True
+
+
+# lint-host-draft-loop vocabulary: the call-name fragments that mark a
+# call inside a drafting loop as a per-token device program (jit-bound
+# names from the file's prescan count too).
+DRAFT_DEVICE_CALL_TOKENS = ("decode", "verify", "prefill")
+
+
+def _mentions_draft(node) -> bool:
+    """True when a subtree names anything draft-ish — the loop-header /
+    called-name evidence that a loop iterates per drafted candidate."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "draft" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "draft" in sub.attr.lower():
+            return True
+    return False
 
 # lint-blocking-commit vocabulary: the commit entry point marking a loop
 # as a step/commit loop, and the synchronous fetch that defeats the async
@@ -388,6 +417,9 @@ class _Lint(ast.NodeVisitor):
         # lint-decode-host-sync: fetch sites already attributed to an
         # enclosing (outermost) decode loop.
         self._decode_fetch_handled: set = set()
+        # lint-host-draft-loop: device-call sites already attributed to
+        # an enclosing (outermost) drafting loop.
+        self._draft_loop_handled: set = set()
         # lint-recompile-in-request-path: names bound to jit(...) results
         # in this file (prescanned in visit_Module), and jit call sites
         # already attributed to an enclosing serve loop.
@@ -652,6 +684,46 @@ class _Lint(ast.NodeVisitor):
                 "buffer, or pragma a deliberate per-step probe "
                 "(docs/serving.md)")
 
+    def _check_host_draft_loop(self, node):
+        """lint-host-draft-loop: a drafting loop (header or a called
+        name mentions ``draft``) that calls a jitted name or a decode/
+        verify/prefill program per iteration — a device round-trip per
+        candidate token, serializing the pipeline one-shot verification
+        exists to widen. Outer loop visited first; nested loops skip
+        already-attributed call sites. The drafting evidence and the
+        device call must share the loop: a loop that only BUILDS the
+        window (host drafting) with the verify call outside stays
+        clean — that is the required shape."""
+        header = [node.target, node.iter] \
+            if isinstance(node, (ast.For, ast.AsyncFor)) else [node.test]
+        calls = [sub for sub in ast.walk(node) if isinstance(sub, ast.Call)]
+        drafty = any(_mentions_draft(h) for h in header) \
+            or any("draft" in _dotted(c.func).lower() for c in calls)
+        if not drafty:
+            return
+        for c in calls:
+            dotted = _dotted(c.func)
+            last = dotted.split(".")[-1].lower()
+            is_device = (
+                (isinstance(c.func, ast.Name)
+                 and c.func.id in self._jit_names)
+                or any(tok in last for tok in DRAFT_DEVICE_CALL_TOKENS))
+            if not is_device or id(c) in self._draft_loop_handled:
+                continue
+            self._draft_loop_handled.add(id(c))
+            self._add(
+                "lint-host-draft-loop", Severity.WARNING, c,
+                f"device program {dotted!r} called inside a per-draft-"
+                "token host loop: speculative decode drafts on HOST "
+                "tokens the engine already holds and verifies the whole "
+                "K-wide window in ONE program call per tick — a device "
+                "round-trip per candidate serializes the memory-bound "
+                "pipeline speculation exists to widen and costs more "
+                "than the plain path (serving/decode.py _ngram_draft, "
+                "docs/serving.md 'Speculative decode'); batch the "
+                "window and verify once, or pragma a deliberate "
+                "draft-model forward")
+
     def _check_recompile_request_path(self, node):
         """lint-recompile-in-request-path: a request-draining loop calls
         a jit-bound name with no padding/bucketing call anywhere in the
@@ -716,6 +788,7 @@ class _Lint(ast.NodeVisitor):
     def visit_For(self, node):
         self._check_blocking_commit(node)
         self._check_decode_host_sync(node)
+        self._check_host_draft_loop(node)
         self._check_recompile_request_path(node)
         self._check_xplane_umbrella(node)
         self._loop_depth += 1
@@ -756,6 +829,7 @@ class _Lint(ast.NodeVisitor):
                     "get_world(wait=...) (see benchmarks/control_plane.py)")
         self._check_blocking_commit(node)
         self._check_decode_host_sync(node)
+        self._check_host_draft_loop(node)
         self._check_recompile_request_path(node)
         self._loop_depth += 1
         self.generic_visit(node)

@@ -8,7 +8,7 @@ pool of fixed-size KV blocks, per-sequence block tables mapping logical
 positions to physical blocks, and single-token queries attending against
 the gathered pages.
 
-Two jit-once programs per model config:
+Three jit-once programs per model config:
 
 - **prefill** (one compile per prompt bucket): the full causal forward over
   one padded prompt, capturing every layer's post-RoPE K and raw V and
@@ -26,6 +26,16 @@ Two jit-once programs per model config:
   target the reserved null block 0 — and are zero-masked via ``active`` so
   block 0 stays all-zero — while their logits are garbage the engine
   discards (active-mask semantics, no recompile on admit/retire).
+- **verify step** (ONE compile per draft width — the engine fixes ONE):
+  the decode step widened to a ``[S, K]`` window of candidate tokens per
+  slot for speculative decoding. One forward scores all K candidate
+  positions: the causal mask inside the window falls out of the same
+  ``t <= pos+j`` admission the paged reads already use, K/V writes stay
+  the decode step's masked scatter (so the null-block invariant holds for
+  masked slots, and rejected candidates' writes are overwritten by the
+  next window before any mask can admit them), and greedy
+  longest-matching-prefix acceptance on the host makes the emitted stream
+  bit-identical to single-token decode (``tests/test_decode_parity.py``).
 
 The math is a pure-jnp mirror of the flax modules (same einsum
 formulations, same f32 islands: RMSNorm, attention softmax, router,
@@ -341,6 +351,111 @@ def make_decode_step(cfg: LlamaConfig, block_size: int):
     return _make_decode(cfg, block_size)
 
 
+def _make_verify(cfg: LlamaConfig, block_size: int, *, shards: int = 1,
+                 axis: Optional[str] = None):
+    """Speculative verify body — the decode step widened to a k-token
+    window per slot. Same shard parameterization as :func:`_make_decode`;
+    the window axis rides every einsum as a batch dim, so the gather-only
+    read discipline and the per-layer collective placement are unchanged.
+    """
+    moe = is_moe(cfg)
+    head_dim = cfg.dim // cfg.n_heads
+    rep = cfg.n_heads // cfg.n_kv_heads
+    scale = 1.0 / head_dim ** 0.5
+    n_heads_l = cfg.n_heads // shards
+    n_kv_l = cfg.n_kv_heads // shards
+
+    def verify(params, k_pool, v_pool, tokens, positions, block_tables,
+               active):
+        S, K = tokens.shape
+        bmax = block_tables.shape[1]
+        t_max = bmax * block_size
+        x = jnp.take(params["embedding"], tokens, axis=0).astype(cfg.dtype)
+        # window position j of slot s sits at logical position pos[s] + j
+        pos_k = positions[:, None] + jnp.arange(
+            K, dtype=positions.dtype)[None, :]
+        blk = jnp.take_along_axis(block_tables, pos_k // block_size, axis=1)
+        off = pos_k % block_size
+        # Past-context AND in-window causality in ONE mask: context token
+        # t is admitted for window row j iff t <= pos+j, and window token
+        # j' (written to the pool below at position pos+j') satisfies that
+        # exactly when j' <= j.
+        mask = jnp.arange(t_max)[None, None, :] <= pos_k[:, :, None]
+        for i in range(cfg.n_layers):
+            lp = layer_params(params, i)
+            ap = lp["attn"]
+            h = _rmsnorm(x, lp["attn_norm"]["scale"], cfg.norm_eps,
+                         cfg.dtype)
+            q = _dense(h, ap["wq"]["kernel"], cfg.dtype).reshape(
+                S, K, n_heads_l, head_dim)
+            k = _dense(h, ap["wk"]["kernel"], cfg.dtype).reshape(
+                S, K, n_kv_l, head_dim)
+            v = _dense(h, ap["wv"]["kernel"], cfg.dtype).reshape(
+                S, K, n_kv_l, head_dim)
+            q = rope(q, pos_k, cfg.rope_theta)
+            k = rope(k, pos_k, cfg.rope_theta)
+            # Write ALL K candidate positions ([S, K]-row scatter), masked
+            # exactly like the decode step: inactive/stalled slots write
+            # zeros through their zero-padded tables into the null block.
+            # Rejected candidates' K/V DO land in the pool — harmlessly:
+            # the engine rewinds ``positions`` to the accepted prefix, the
+            # mask never admits a position beyond the rewound ``pos``, and
+            # the next window (which always starts at the rewound pos and
+            # spans past every stale position) overwrites them before any
+            # later row's mask can admit them (tests/test_spec_decode.py
+            # pins this across block boundaries).
+            act = active[:, None, None, None]
+            k_pool = k_pool.at[i, blk, off].set(
+                jnp.where(act, k, 0).astype(k_pool.dtype))
+            v_pool = v_pool.at[i, blk, off].set(
+                jnp.where(act, v, 0).astype(v_pool.dtype))
+            kb = jnp.take(k_pool[i], block_tables, axis=0).reshape(
+                S, t_max, n_kv_l, head_dim)
+            vb = jnp.take(v_pool[i], block_tables, axis=0).reshape(
+                S, t_max, n_kv_l, head_dim)
+            qg = q.reshape(S, K, n_kv_l, rep, head_dim)
+            s = jnp.einsum("skgrd,stgd->skgrt", qg, kb).astype(
+                jnp.float32) * scale
+            s = jnp.where(mask[:, :, None, None, :], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+            o = jnp.einsum("skgrt,stgd->skgrd", pr, vb).reshape(
+                S, K, n_heads_l * head_dim)
+            attn_out = _dense(o, ap["wo"]["kernel"], cfg.dtype)
+            if axis is not None:
+                attn_out = jax.lax.psum(attn_out, axis)
+            x = _ffn(lp, cfg, x + attn_out, moe, axis)
+        x = _rmsnorm(x, params["final_norm"]["scale"], cfg.norm_eps,
+                     cfg.dtype)
+        logits = _lm_head(params, cfg, x)
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits, next_tokens, k_pool, v_pool
+
+    return verify
+
+
+def make_verify_step(cfg: LlamaConfig, block_size: int):
+    """Build the speculative k-token verify program for ``cfg`` — ONE
+    compile per draft width K (the engine uses one fixed
+    ``HOROVOD_DECODE_SPEC_K``, so one compile for the serving lifetime).
+
+    ``verify(params, k_pool, v_pool, tokens[S, K], positions[S],
+    block_tables[S, Bmax], active[S])
+    -> (logits[S, K, V] f32, next_tokens[S, K] i32, k_pool, v_pool)``
+
+    ``tokens[s, 0]`` is slot ``s``'s pending (already-sampled, not yet
+    cached) token at position ``positions[s]``; ``tokens[s, 1:]`` are the
+    host-drafted candidates at the following positions. Row ``j`` of
+    ``next_tokens[s]`` is the model's greedy continuation after consuming
+    window tokens ``0..j`` — so ``next_tokens[s, 0]`` is always the TRUE
+    next token, and draft ``tokens[s, j+1]`` is accepted exactly when it
+    equals ``next_tokens[s, j]`` with every earlier draft accepted (the
+    lossless longest-matching-prefix rule; the engine applies it on host
+    where the drafts already live). A caller that never accepts drafts
+    reads ``next_tokens[:, 0]`` and gets the plain decode step's stream.
+    """
+    return _make_verify(cfg, block_size)
+
+
 # -- tensor-parallel (tp) decode plane ---------------------------------------
 
 def validate_tp(cfg: LlamaConfig, tp: int) -> None:
@@ -468,5 +583,21 @@ def make_decode_step_tp(cfg: LlamaConfig, block_size: int, mesh,
     tp = mesh.shape[axis]
     validate_tp(cfg, tp)
     body = _make_decode(cfg, block_size, shards=tp, axis=axis)
+    return _shard_mapped(cfg, mesh, axis, body, n_pools=2, n_extra=4,
+                         n_outs=4)
+
+
+def make_verify_step_tp(cfg: LlamaConfig, block_size: int, mesh,
+                        axis: str = "tp"):
+    """:func:`make_verify_step` partitioned over ``mesh[axis]``. The wire
+    contract is the decode step's, re-pinned at the window width: exactly
+    ``2 * n_layers`` all-reduces of the ``[S, K, D]`` (= ``S·K × D``
+    bytes) activations and NOTHING else — zero collective-permutes, zero
+    cross-shard KV movement (``tests/test_wire_contracts.py``
+    ``test_tp_verify_wire_contract`` pins count, operand bytes, and the
+    absence of permutes)."""
+    tp = mesh.shape[axis]
+    validate_tp(cfg, tp)
+    body = _make_verify(cfg, block_size, shards=tp, axis=axis)
     return _shard_mapped(cfg, mesh, axis, body, n_pools=2, n_extra=4,
                          n_outs=4)

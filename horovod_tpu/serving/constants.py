@@ -88,6 +88,15 @@ DEFAULT_DECODE_SWAP_POLICY = "refill"
 DECODE_TP_ENV = "HOROVOD_DECODE_TP"
 DEFAULT_DECODE_TP = 0
 
+#: Speculative-decode window width (docs/serving.md "Speculative
+#: decode"): tokens scored per verify call = 1 pending token + K-1
+#: host-drafted candidates. 0 (or 1) disables speculation — the engine
+#: runs today's single-token decode program byte-identically. K >= 2
+#: replaces the decode call with ONE verify call per tick; greedy
+#: longest-matching-prefix acceptance keeps the stream lossless.
+DECODE_SPEC_K_ENV = "HOROVOD_DECODE_SPEC_K"
+DEFAULT_DECODE_SPEC_K = 0
+
 
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
@@ -187,3 +196,7 @@ def decode_swap_policy() -> str:
 
 def decode_tp() -> int:
     return max(0, _env_int(DECODE_TP_ENV, DEFAULT_DECODE_TP))
+
+
+def decode_spec_k() -> int:
+    return max(0, _env_int(DECODE_SPEC_K_ENV, DEFAULT_DECODE_SPEC_K))

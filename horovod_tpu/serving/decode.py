@@ -34,6 +34,27 @@ manifest it either
   weights (the held ``ServedModel`` reference keeps them consistent), and
   adopts the new ones once idle.
 
+**Speculative decode** (``spec_k=`` / ``HOROVOD_DECODE_SPEC_K``,
+docs/serving.md "Speculative decode"): with ``K >= 2`` the engine
+replaces the single-token decode call with ONE K-wide verify call per
+tick (``models/decode.py::make_verify_step``). The K-1 candidate tokens
+come from a host-side n-gram / prompt-lookup drafter
+(:func:`_ngram_draft`) over tokens the engine already holds — no draft
+model, no extra weights, and no extra device round-trips beyond the one
+``[S, K]`` fetch acceptance itself requires (drafting is pure host
+Python; ``lint-host-draft-loop`` polices the per-draft-token device-call
+antipattern). Greedy longest-matching-prefix acceptance emits 1..K
+tokens per tick, bit-identical to the non-speculative stream; on
+rejection the host simply rewinds ``positions`` to the accepted prefix —
+the next verify window starts there and overwrites every rejected
+position's K/V before any causal mask can admit it (the paged-pool
+rewind invariant, ``tests/test_spec_decode.py``). ``K = 0`` (default)
+keeps today's path byte-identical — the verify program is never built
+and ``compile_counts`` has no ``verify`` key. All other semantics —
+admit/retire/stall/deadlock-break/refill/drain — are unchanged;
+``hvd_serving_spec_*`` telemetry reports the accept-length histogram and
+draft hit rate.
+
 **Sharded decode** (``mesh=`` / ``HOROVOD_DECODE_TP``, docs/serving.md
 "Sharded decode"): the engine runs the tensor-parallel program variants
 (``models/decode.py`` ``make_*_tp``) over a ``tp`` mesh axis. ALL host
@@ -63,6 +84,29 @@ from . import constants as SC
 
 FREE = "free"
 ACTIVE = "active"
+
+
+def _ngram_draft(ctx: Sequence[int], n: int, max_ngram: int = 3) -> List[int]:
+    """Prompt-lookup drafting: the ``n`` tokens that followed the most
+    recent EARLIER occurrence of the longest matching suffix n-gram
+    (``max_ngram`` down to 1) anywhere in ``ctx`` (prompt + accepted
+    generations). Pure host Python over host ints — by design: the
+    verify side is ONE device program call per tick, and a drafter that
+    called into the device per candidate token would serialize exactly
+    the pipeline speculation exists to widen (``lint-host-draft-loop``).
+    Falls back to repeating the last token when nothing matches (a miss
+    costs nothing extra: the verify window runs at fixed width K anyway).
+    """
+    L = len(ctx)
+    for m in range(min(max_ngram, L - 1), 0, -1):
+        suffix = list(ctx[L - m:])
+        for start in range(L - m - 1, -1, -1):
+            if list(ctx[start:start + m]) == suffix:
+                cont = [int(t) for t in ctx[start + m:start + m + n]]
+                if cont:
+                    return (cont + [cont[-1]] * n)[:n]
+    last = int(ctx[-1]) if L else 0
+    return [last] * n
 
 
 class BlockAllocator:
@@ -109,7 +153,8 @@ class DecodeRequest:
     at retire (``event`` fires; ``tokens`` = prompt + generated)."""
 
     __slots__ = ("prompt", "max_new", "event", "tokens", "error",
-                 "truncated", "model_seq", "t0", "ttft_s")
+                 "truncated", "model_seq", "t0", "ttft_s",
+                 "queue_wait_s", "prefill_wall_s")
 
     def __init__(self, prompt: Sequence[int], max_new: int):
         self.prompt = [int(t) for t in prompt]
@@ -121,11 +166,16 @@ class DecodeRequest:
         self.model_seq: Optional[int] = None
         self.t0 = time.perf_counter()
         self.ttft_s: Optional[float] = None
+        #: the TTFT split (benchmarks/serving.py): time queued before the
+        #: winning admission pass vs the prefill call wall (dispatch +
+        #: first-token sync). ttft_s ~= queue_wait_s + prefill_wall_s.
+        self.queue_wait_s: Optional[float] = None
+        self.prefill_wall_s: Optional[float] = None
 
 
 class _Slot:
     __slots__ = ("state", "req", "pos", "table", "gen", "gen_toks",
-                 "stalled")
+                 "stalled", "pending")
 
     def __init__(self):
         self.state = FREE
@@ -133,10 +183,15 @@ class _Slot:
         self.pos = 0
         self.table: List[int] = []
         self.gen = 0
-        #: generated-token device refs, in order: (array, idx) picks
-        #: ``array[idx]``; idx None means a scalar array
-        self.gen_toks: List[Tuple[Any, Optional[int]]] = []
+        #: generated tokens, in order. Plain mode: device refs — (array,
+        #: idx) picks ``array[idx]``, idx None means a scalar array (values
+        #: fetched only at retire/refill). Spec mode: plain host ints (the
+        #: drafter needs host values every tick anyway).
+        self.gen_toks: List[Any] = []
         self.stalled = False
+        #: spec mode only: the pending token (sampled, K/V not yet
+        #: written) as a host int — window position 0 of the next verify.
+        self.pending: Optional[int] = None
 
 
 class DecodeEngine:
@@ -152,7 +207,10 @@ class DecodeEngine:
                  max_blocks_per_slot: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  swap_policy: Optional[str] = None,
-                 mesh=None, tp_axis: str = "tp"):
+                 mesh=None, tp_axis: str = "tp",
+                 spec_k: Optional[int] = None,
+                 draft_fn: Optional[Callable[[Sequence[int], int],
+                                             Sequence[int]]] = None):
         import jax
         from ..models import decode as MD
         from .server import pad_to_bucket
@@ -188,6 +246,14 @@ class DecodeEngine:
             if b % self.block_size:
                 raise ValueError(f"prefill bucket {b} not a multiple of "
                                  f"block_size {self.block_size}")
+        k = SC.decode_spec_k() if spec_k is None else int(spec_k)
+        #: speculative window width; < 2 normalizes to 0 (off) — a K of 1
+        #: would be the plain path with an extra host fetch for nothing.
+        self.spec_k = k if k >= 2 else 0
+        self._draft_fn = draft_fn
+        #: host tokens emitted so far (both paths) — the spec bench's
+        #: tokens/s numerator (token-slope over interleaved windows).
+        self.tokens_emitted = 0
         self.max_context = self.max_blocks_per_slot * self.block_size
         if self.prefill_buckets[-1] > self.max_context:
             raise ValueError(
@@ -211,14 +277,23 @@ class DecodeEngine:
         #: trace-time side-effect counters — each increment runs ONCE per
         #: compile, so steady state pins ``decode`` exactly (the guardrail)
         self.compile_counts = {"decode": 0, "prefill": 0}
+        if self.spec_k:
+            # K = 0 never builds the verify program — the compile_counts
+            # dict itself is the byte-identity witness (guardrail pins
+            # exact dict equality at spec off).
+            self.compile_counts["verify"] = 0
         if mesh is not None:
             _base_decode = MD.make_decode_step_tp(cfg, self.block_size,
                                                   mesh, tp_axis)
             _base_prefill = MD.make_prefill_tp(cfg, self.block_size,
                                                mesh, tp_axis)
+            _base_verify = MD.make_verify_step_tp(
+                cfg, self.block_size, mesh, tp_axis) if self.spec_k else None
         else:
             _base_decode = MD.make_decode_step(cfg, self.block_size)
             _base_prefill = MD.make_prefill(cfg, self.block_size)
+            _base_verify = MD.make_verify_step(
+                cfg, self.block_size) if self.spec_k else None
 
         def _decode_traced(p, kp, vp, toks, pos, tables, active):
             self.compile_counts["decode"] += 1
@@ -227,6 +302,10 @@ class DecodeEngine:
         def _prefill_traced(p, kp, vp, toks, block_ids):
             self.compile_counts["prefill"] += 1
             return _base_prefill(p, kp, vp, toks, block_ids)
+
+        def _verify_traced(p, kp, vp, toks, pos, tables, active):
+            self.compile_counts["verify"] += 1
+            return _base_verify(p, kp, vp, toks, pos, tables, active)
 
         self._jnp = jax.numpy
         self._kp, self._vp = MD.init_kv_pools(cfg, n_blocks, self.block_size)
@@ -256,14 +335,29 @@ class DecodeEngine:
                 _prefill_traced, donate_argnums=(1, 2),
                 in_shardings=(_u, pool_fmt, pool_fmt, _u, _u),
                 out_shardings=(_u, pool_fmt, pool_fmt))
+            if self.spec_k:
+                self._verify = jax.jit(
+                    _verify_traced, donate_argnums=(1, 2),
+                    in_shardings=(_u, pool_fmt, pool_fmt, _u, _u, _u, _u),
+                    out_shardings=(_u, _u, pool_fmt, pool_fmt))
         else:
             self._decode = jax.jit(_decode_traced, donate_argnums=(1, 2))
             self._prefill = jax.jit(_prefill_traced, donate_argnums=(1, 2))
+            if self.spec_k:
+                self._verify = jax.jit(_verify_traced,
+                                       donate_argnums=(1, 2))
         self._params = self._place_params(params)
         self._positions = np.zeros(self.n_slots, np.int32)
         self._tables = np.zeros((self.n_slots, self.max_blocks_per_slot),
                                 np.int32)
         self._active = np.zeros(self.n_slots, bool)
+        # Device mirrors of the block tables and the runnable mask: both
+        # change only on admit/retire/extend/refill, not per tick, so the
+        # step path skips two host->device uploads per tick (the upload
+        # cost is pure overhead the verify window cannot amortize).
+        self._tables_dev = None
+        self._runnable_host: Optional[np.ndarray] = None
+        self._runnable_dev = None
 
     # -- weights --------------------------------------------------------------
 
@@ -312,10 +406,18 @@ class DecodeEngine:
             req.error = "empty prompt or max_new < 1"
             req.event.set()
             return req
+        # Spec mode reserves K-1 extra positions: the LAST verify window
+        # may start at the final budgeted position and still index
+        # pos..pos+K-1 into the block table — the window-fit rule that
+        # keeps take_along_axis in bounds (models/decode.py verify).
+        window_slack = self.spec_k - 1 if self.spec_k else 0
         if len(req.prompt) > self.prefill_buckets[-1] \
-                or len(req.prompt) + req.max_new > self.max_context:
-            req.error = (f"request needs {len(req.prompt)}+{req.max_new} "
-                         f"positions; max prompt bucket "
+                or len(req.prompt) + req.max_new + window_slack \
+                > self.max_context:
+            req.error = (f"request needs {len(req.prompt)}+{req.max_new}"
+                         + (f"+{window_slack} (speculative window)"
+                            if window_slack else "")
+                         + f" positions; max prompt bucket "
                          f"{self.prefill_buckets[-1]}, context "
                          f"{self.max_context}")
             req.event.set()
@@ -349,6 +451,18 @@ class DecodeEngine:
         return self._active & ~np.asarray(
             [s.stalled for s in self.slots])
 
+    def _tables_device(self):
+        if self._tables_dev is None:
+            self._tables_dev = self._jnp.asarray(self._tables)
+        return self._tables_dev
+
+    def _runnable_device(self, runnable: np.ndarray):
+        if self._runnable_host is None \
+                or not np.array_equal(runnable, self._runnable_host):
+            self._runnable_host = runnable.copy()
+            self._runnable_dev = self._jnp.asarray(runnable)
+        return self._runnable_dev
+
     def decode_once(self) -> bool:
         """One engine tick: observe swaps, admit, step every active slot.
         Returns True when a decode step ran."""
@@ -370,18 +484,20 @@ class DecodeEngine:
                 runnable = self._runnable()
             if not runnable.any():
                 return False
+        if self.spec_k:
+            return self._spec_step(runnable)
         jnp = self._jnp
+        runnable_dev = self._runnable_device(runnable)
         logits, nt, self._kp, self._vp = self._decode(
             self._params, self._kp, self._vp, self._dev_tokens,
-            jnp.asarray(self._positions), jnp.asarray(self._tables),
-            jnp.asarray(runnable))
+            jnp.asarray(self._positions), self._tables_device(),
+            runnable_dev)
         del logits  # sampling is on-device (greedy argmax in the program)
         # Masked slots (inactive OR stalled) must keep their pending token:
         # a stalled slot's nt row came from an un-extended table (its K/V
         # landed in the null block), and consuming it on unstall would
         # silently fork the stream from greedy.
-        self._dev_tokens = jnp.where(jnp.asarray(runnable), nt,
-                                     self._dev_tokens)
+        self._dev_tokens = jnp.where(runnable_dev, nt, self._dev_tokens)
         stepped = 0
         for i, slot in enumerate(self.slots):
             if not runnable[i]:
@@ -393,7 +509,111 @@ class DecodeEngine:
             stepped += 1
             if slot.gen >= slot.req.max_new:
                 self._retire(i)
+        self.tokens_emitted += stepped
         _telemetry.inc("hvd_serving_decode_tokens_total", float(stepped))
+        _telemetry.set_gauge("hvd_serving_decode_active_slots",
+                             float(self.active_slots))
+        _telemetry.set_gauge("hvd_serving_decode_free_blocks",
+                             float(self.allocator.free_blocks))
+        return True
+
+    # -- speculative tick -----------------------------------------------------
+
+    def _draft(self, slot: _Slot, n: int) -> List[int]:
+        """``n`` candidate tokens for ``slot`` from the injected
+        ``draft_fn`` (bench's adversarial arm) or the built-in n-gram
+        lookup. Host-only by contract (``lint-host-draft-loop``)."""
+        ctx = slot.req.prompt + slot.gen_toks
+        if self._draft_fn is not None:
+            cand = [int(t) for t in self._draft_fn(ctx, n)]
+            if len(cand) < n:
+                pad = cand[-1] if cand else (int(ctx[-1]) if ctx else 0)
+                cand += [pad] * (n - len(cand))
+            return cand[:n]
+        return _ngram_draft(ctx, n)
+
+    def _spec_step(self, runnable: np.ndarray) -> bool:
+        """One speculative tick over the runnable slots: draft on host,
+        verify all K window positions in ONE program call, accept the
+        longest matching prefix, rewind positions to the accepted length.
+
+        Window row i = ``[pending, d_1 .. d_{K-1}]`` at positions
+        ``pos .. pos+K-1``; the program's ``g[i, j]`` is the greedy token
+        after consuming window token j, so ``g[i, 0]`` is always the TRUE
+        next token and draft ``d_j`` is accepted iff ``d_j == g[i, j-1]``
+        with every earlier draft accepted. Emitting ``g[i, :n_acc+1]`` is
+        therefore bit-identical to running the plain decode loop
+        ``n_acc+1`` times — lossless by construction. The single
+        ``np.asarray`` below is the one host fetch speculation inherently
+        needs (drafting consumes host tokens); it replaces the plain
+        path's zero-fetch feedback but the verify call amortizes the
+        weight read over every accepted token.
+        """
+        jnp = self._jnp
+        K = self.spec_k
+        vmax = int(self.cfg.vocab_size) - 1
+        toks = np.zeros((self.n_slots, K), np.int32)
+        for i, slot in enumerate(self.slots):
+            if not runnable[i]:
+                continue
+            toks[i, 0] = slot.pending
+            # Clamp drafts into vocab: an out-of-range id from an injected
+            # drafter would hit jnp.take's fill mode → NaN embedding → NaN
+            # K/V rows that poison even MASKED attention (0 · NaN = NaN).
+            # Acceptance below compares the clamped value actually
+            # verified, so clamping stays lossless.
+            toks[i, 1:] = np.clip(self._draft(slot, K - 1), 0, vmax)
+        if self.mesh is None:
+            # One batched transfer for the two per-tick host arrays: the
+            # spec tick syncs on its host fetch every tick (acceptance
+            # needs g), so upload latency is serial — measured ~55us/tick
+            # cheaper batched than two jnp.asarray calls.
+            import jax
+            toks_dev, pos_dev = jax.device_put((toks, self._positions))
+        else:
+            toks_dev = jnp.asarray(toks)
+            pos_dev = jnp.asarray(self._positions)
+        logits, g, self._kp, self._vp = self._verify(
+            self._params, self._kp, self._vp, toks_dev, pos_dev,
+            self._tables_device(), self._runnable_device(runnable))
+        del logits              # greedy argmax is in the program
+        g_h = np.asarray(g)     # the one [S, K] host fetch per tick
+        # Longest-matching-prefix lengths for ALL slots at once: draft
+        # d_{j+1} is accepted iff it equals g[:, j] with every earlier
+        # draft accepted — a leading-True run length per row.
+        n_accs = np.cumprod(toks[:, 1:] == g_h[:, :-1], axis=1).sum(axis=1)
+        stepped = 0
+        hits = 0
+        n_run = 0
+        for i, slot in enumerate(self.slots):
+            if not runnable[i]:
+                continue
+            n_run += 1
+            n_acc = int(n_accs[i])
+            # g[i, :n_acc] re-derives the accepted drafts; position
+            # n_acc is the first novel token. Budget can cap the emit
+            # below the accepted length (the slot retires regardless).
+            n_emit = min(n_acc + 1, slot.req.max_new - slot.gen)
+            new = [int(t) for t in g_h[i, :n_emit]]
+            slot.gen_toks.extend(new)
+            slot.pending = new[-1]
+            slot.gen += n_emit
+            # The REWIND: positions advance by the accepted length only;
+            # every window row past it holds stale K/V the next verify
+            # (starting at the new pos) overwrites before any causal
+            # mask can admit it (tests/test_spec_decode.py).
+            slot.pos += n_emit
+            self._positions[i] = slot.pos
+            stepped += n_emit
+            hits += n_acc
+            _telemetry.observe("hvd_serving_spec_accept_len", float(n_acc))
+            if slot.gen >= slot.req.max_new:
+                self._retire(i)
+        self.tokens_emitted += stepped
+        _telemetry.inc("hvd_serving_decode_tokens_total", float(stepped))
+        _telemetry.inc("hvd_serving_spec_draft_hits_total", float(hits))
+        _telemetry.inc("hvd_serving_spec_draft_tokens_total",
+                       float(n_run * (K - 1)))
         _telemetry.set_gauge("hvd_serving_decode_active_slots",
                              float(self.active_slots))
         _telemetry.set_gauge("hvd_serving_decode_free_blocks",
@@ -431,6 +651,11 @@ class DecodeEngine:
                     self._pending.appendleft(req)
                 _telemetry.inc("hvd_serving_decode_admit_stalls_total")
                 return                  # pool exhausted: retry next tick
+            # TTFT split: everything before this instant is queue wait
+            # (batching, slot/pool contention, deferred swaps); everything
+            # after is the prefill wall (dispatch + first-token sync).
+            t_adm = time.perf_counter()
+            req.queue_wait_s = t_adm - req.t0
             ft = self._run_prefill(req.prompt, blocks, bucket)
             slot = self.slots[idx]
             slot.state = ACTIVE
@@ -438,11 +663,11 @@ class DecodeEngine:
             slot.pos = len(req.prompt)
             slot.table = blocks
             slot.gen = 1
-            slot.gen_toks = [(ft, None)]
             slot.stalled = False
             self._positions[idx] = slot.pos
             self._tables[idx] = 0
             self._tables[idx, :len(blocks)] = blocks
+            self._tables_dev = None
             self._active[idx] = True
             self._dev_tokens = self._dev_tokens.at[idx].set(ft)
             # TTFT is honest: the first token is materialized before the
@@ -450,6 +675,15 @@ class DecodeEngine:
             # engine may sync — never the decode loop)
             ft.block_until_ready()
             req.ttft_s = time.perf_counter() - req.t0
+            req.prefill_wall_s = time.perf_counter() - t_adm
+            if self.spec_k:
+                # Spec mode keeps HOST tokens: the prefill token is both
+                # the first emitted token and the pending window head.
+                tok0 = int(ft)
+                slot.gen_toks = [tok0]
+                slot.pending = tok0
+            else:
+                slot.gen_toks = [(ft, None)]
             _telemetry.inc("hvd_serving_decode_admitted_total")
             _telemetry.observe("hvd_serving_decode_ttft_seconds", req.ttft_s)
             if slot.gen >= req.max_new:
@@ -468,28 +702,33 @@ class DecodeEngine:
         return jnp.argmax(logits[0, len(prompt) - 1]).astype(jnp.int32)
 
     def _extend_tables(self) -> None:
-        """Grow any slot whose next write position crosses into an
-        unallocated block; a slot that cannot get one STALLS (masked out)
-        until a retire frees capacity — never a recompile, never an OOM.
-        If EVERY active slot stalls with the free list empty no retire
-        could ever happen; ``decode_once`` breaks that deadlock via
-        ``_break_stall``."""
+        """Grow any slot whose next WRITE WINDOW crosses into unallocated
+        blocks — one position per tick plain, ``spec_k`` positions under
+        speculation (the whole verify window must be backed before the
+        call: every window row is scattered, accepted or not); a slot
+        that cannot get every block it needs STALLS (masked out) until a
+        retire frees capacity — never a recompile, never an OOM. Partial
+        extensions keep their blocks (they stay in the table for the
+        retry). If EVERY active slot stalls with the free list empty no
+        retire could ever happen; ``decode_once`` breaks that deadlock
+        via ``_break_stall``."""
+        window = self.spec_k if self.spec_k else 1
         for i, slot in enumerate(self.slots):
             if slot.state != ACTIVE:
                 continue
-            need = slot.pos // self.block_size
+            need = (slot.pos + window - 1) // self.block_size
+            while need >= len(slot.table):
+                b = self.allocator.alloc()
+                if b is None:
+                    break
+                slot.table.append(b)
+                self._tables[i, len(slot.table) - 1] = b
+                self._tables_dev = None
             if need < len(slot.table):
                 slot.stalled = False
-                continue
-            b = self.allocator.alloc()
-            if b is None:
-                if not slot.stalled:
-                    slot.stalled = True
-                    _telemetry.inc("hvd_serving_decode_block_stalls_total")
-                continue
-            slot.table.append(b)
-            self._tables[i, len(slot.table) - 1] = b
-            slot.stalled = False
+            elif not slot.stalled:
+                slot.stalled = True
+                _telemetry.inc("hvd_serving_decode_block_stalls_total")
 
     def _break_stall(self) -> None:
         """All active slots stalled with zero free blocks: retire the
@@ -508,7 +747,12 @@ class DecodeEngine:
 
     def _slot_token_values(self, slot: _Slot) -> List[int]:
         """Fetch the slot's generated tokens (host sync — retire/refill
-        paths only, never the decode loop)."""
+        paths only, never the decode loop). Spec-mode entries are already
+        host ints; plain-mode entries are device refs."""
+        if not slot.gen_toks:
+            return []
+        if isinstance(slot.gen_toks[0], int):
+            return list(slot.gen_toks)
         vals = np.asarray(self._jnp.stack(
             [a if i is None else a[i] for a, i in slot.gen_toks]))
         return [int(v) for v in vals]
@@ -526,11 +770,13 @@ class DecodeEngine:
         slot.table = []
         slot.gen_toks = []
         slot.stalled = False
+        slot.pending = None
         slot.pos = 0
         slot.gen = 0
         self._active[idx] = False
         self._positions[idx] = 0
         self._tables[idx] = 0
+        self._tables_dev = None
         _telemetry.inc("hvd_serving_decode_retired_total")
         if self._drain_target is not None and not self._active.any():
             tgt_params, tgt_seq = self._drain_target
@@ -586,10 +832,16 @@ class DecodeEngine:
             slot.table = blocks
             slot.pos = len(seq_toks)
             slot.gen += 1
-            slot.gen_toks.append((ft, None))
+            if self.spec_k:
+                tok0 = int(ft)
+                slot.gen_toks.append(tok0)
+                slot.pending = tok0
+            else:
+                slot.gen_toks.append((ft, None))
             self._positions[i] = slot.pos
             self._tables[i] = 0
             self._tables[i, :len(blocks)] = blocks
+            self._tables_dev = None
             self._dev_tokens = self._dev_tokens.at[i].set(ft)
             refilled += 1
             if slot.gen >= slot.req.max_new:

@@ -73,6 +73,11 @@ SUM_TOLERANCE = 0.05
 #: spread 0.037 ≈ 2× the bench's own per-run ±0.02 band. A reading
 #: inside ``1 − band`` is noise; below ``1 − 2×band`` is a real breach.
 DEFAULT_HEADLINE_BAND = 0.04
+#: Absolute per-arm floors for ``kind: "spec_decode"`` records
+#: (benchmarks/serving.py spec segment, ISSUE 16): the repeat-heavy arm
+#: must keep the n-gram drafter paying off, and the adversarial
+#: all-rejected arm must stay a near-free fallback — the lossless rail.
+SPEC_DECODE_FLOORS = {"repeat_heavy": 1.5, "adversarial": 0.9}
 
 # ------------------------------------------------------- xplane trap lore
 
@@ -541,6 +546,18 @@ def ratchet_check(history: List[Dict[str, Any]],
     becomes a floor the moment it lands. They are excluded from the MFU
     grouping: a ratio record carries no budget or MFU of its own.
 
+    ``kind: "spec_decode"`` records (benchmarks/serving.py spec segment)
+    are railed per ``(model, arm)`` against the ABSOLUTE
+    :data:`SPEC_DECODE_FLOORS` for their workload arm — repeat_heavy
+    ≥ 1.5× plain, adversarial ≥ 0.9× plain — not against a best-ever
+    floor: the repeat arm's median swings with n-gram acceptance
+    (measured 1.95–2.52 across honest sessions, wider than the MFU
+    band), so a best×band ratchet would fail clean readings. A drop
+    below the arm's best is reported as a warning drift line. Shape:
+    model, a known arm, numeric ratio, ``spec_k ≥ 2``, a ≥3-round
+    noise band, positive plain/spec tokens_per_s and ZERO steady-state
+    compiles. Also excluded from the MFU grouping.
+
     ``kind: "headline_vs_baseline"`` records rail the bench.py headline
     hvd-vs-plain ratio against its CROSS-SESSION noise band (the record's
     own ``band`` field, else :data:`DEFAULT_HEADLINE_BAND`) rather than
@@ -559,6 +576,8 @@ def ratchet_check(history: List[Dict[str, Any]],
     by_model: Dict[str, List[Dict[str, Any]]] = collections.defaultdict(list)
     by_arm: Dict[Tuple[str, str],
                  List[Dict[str, Any]]] = collections.defaultdict(list)
+    by_spec: Dict[Tuple[str, str],
+                  List[Dict[str, Any]]] = collections.defaultdict(list)
     headline: List[Dict[str, Any]] = []
     for rec in history:
         model = rec.get("model")
@@ -580,6 +599,35 @@ def ratchet_check(history: List[Dict[str, Any]],
                             f"model/arm/numeric ratio, got {rec}")
                 continue
             by_arm[(model, rec["arm"])].append(rec)
+            continue
+        if rec.get("kind") == "spec_decode":
+            ratio = rec.get("ratio")
+            arm = rec.get("arm")
+            noise = rec.get("noise") or {}
+            tps = rec.get("tokens_per_s") or {}
+            shape_ok = (
+                bool(model) and arm in SPEC_DECODE_FLOORS
+                and isinstance(ratio, (int, float))
+                and isinstance(rec.get("spec_k"), int)
+                and rec["spec_k"] >= 2
+                and isinstance(noise.get("rounds"), int)
+                and noise["rounds"] >= 3
+                and all(isinstance(noise.get(k), (int, float))
+                        for k in ("ratio_min", "ratio_max", "spread"))
+                and rec.get("steady_compiles") == 0
+                and all(isinstance(tps.get(a), (int, float)) and tps[a] > 0
+                        for a in ("plain", "spec")))
+            if not shape_ok:
+                ok = False
+                msgs.append(
+                    "FAIL shape [spec_decode]: record needs model, an arm "
+                    f"in {sorted(SPEC_DECODE_FLOORS)}, numeric ratio, "
+                    "spec_k >= 2, a >=3-round noise band "
+                    "(rounds/ratio_min/ratio_max/spread), positive "
+                    "plain/spec tokens_per_s and zero steady_compiles, "
+                    f"got {rec}")
+                continue
+            by_spec[(model, arm)].append(rec)
             continue
         if model:
             by_model[model].append(rec)
@@ -633,6 +681,25 @@ def ratchet_check(history: List[Dict[str, Any]],
         else:
             msgs.append(f"ok [{model}/{arm}]: ratio {latest:.4f} is the "
                         f"floor (band {band})")
+    for (model, arm), recs in sorted(by_spec.items()):
+        floor_abs = SPEC_DECODE_FLOORS[arm]
+        best = max(r["ratio"] for r in recs)
+        latest = recs[-1]["ratio"]
+        if latest < floor_abs:
+            ok = False
+            msgs.append(f"FAIL floor [spec_decode {model}/{arm}]: latest "
+                        f"spec-vs-plain {latest:.4f} < absolute floor "
+                        f"{floor_abs} (the {arm} rail — lossless "
+                        "speculation must not cost this much)")
+        elif latest < best * band:
+            msgs.append(f"warn [spec_decode {model}/{arm}]: latest "
+                        f"{latest:.4f} drifted below best {best:.4f} × "
+                        f"band {band} (acceptance-driven medians swing "
+                        "wider than the MFU band — absolute floor "
+                        f"{floor_abs} still holds)")
+        else:
+            msgs.append(f"ok [spec_decode {model}/{arm}]: {latest:.4f} ≥ "
+                        f"floor {floor_abs} (best {best:.4f})")
     if headline:
         rec = headline[-1]
         value = rec["value"]

@@ -1,0 +1,48 @@
+"""lint-host-draft-loop fixture: a speculative-decode drafting loop that
+calls the jitted decode program once PER CANDIDATE token — a device
+round-trip per draft, serializing the pipeline one-shot verification
+exists to widen. Exactly ONE finding: the per-draft device loop; the
+host-only drafter, the build-window-then-verify-once shape, and the
+pragma'd draft-model forward must all stay clean.
+"""
+import jax
+
+decode_step = jax.jit(lambda p, t: t)
+verify_step = jax.jit(lambda p, w: w)
+
+
+def draft_with_model(params, ctx, k):
+    # BAD: scores each draft candidate with its own device call.
+    drafts = []
+    for _ in range(k):
+        tok = decode_step(params, ctx[-1])  # <- lint-host-draft-loop
+        drafts.append(int(tok))
+        ctx = ctx + [int(tok)]
+    return drafts
+
+
+def ngram_draft(ctx, k):
+    # Clean: pure host lookup over host ints — no device call at all.
+    drafts = []
+    for m in range(min(3, len(ctx) - 1), 0, -1):
+        if list(ctx[-m:]) == list(ctx[:m]):
+            drafts = [int(t) for t in ctx[m:m + k]]
+            break
+    return drafts or [ctx[-1]] * k
+
+
+def spec_tick(params, window, draft_fn, ctx, k):
+    # Clean: the loop only BUILDS the window from host drafts; the one
+    # K-wide verify call sits outside the loop.
+    for j, tok in enumerate(draft_fn(ctx, k)):
+        window[j] = tok
+    return verify_step(params, window)
+
+
+def draft_model_forward(params, ctx, k, small_step):
+    # Clean: a deliberate draft-MODEL forward carries the pragma.
+    drafts = []
+    for _ in range(k):
+        tok = small_step(params, ctx[-1])  # hvd-analyze: ok — draft model
+        drafts.append(int(tok))
+    return drafts

@@ -213,6 +213,7 @@ LINT_CASES = [
     ("bad_blocking_telemetry.py", "lint-blocking-telemetry", "warning"),
     ("bad_blocking_commit.py", "lint-blocking-commit", "warning"),
     ("bad_decode_host_sync.py", "lint-decode-host-sync", "warning"),
+    ("bad_host_draft_loop.py", "lint-host-draft-loop", "warning"),
     ("bad_recompile_request_path.py", "lint-recompile-in-request-path",
      "warning"),
     ("bad_xplane_umbrella.py", "lint-xplane-umbrella", "warning"),

@@ -321,6 +321,109 @@ def test_tp_refill_swap_mid_decode_is_transparent(kind, llama_tp,
     assert eng.allocator.free_blocks == 23       # remap freed the originals
 
 
+# --------------------------------------------------- speculative decode
+#
+# ISSUE 16 acceptance: greedy streams bit-identical spec vs non-spec
+# (K ∈ {0, 2, 4}) at tp=1/2/4 for BOTH models, including
+# stall-mid-generation and weight-swap-mid-decode. The built-in n-gram
+# drafter rides a repeat-heavy prompt so accept lengths actually exceed
+# one (the lossless claim is vacuous if nothing is ever accepted);
+# tests/test_spec_decode.py covers the zero-accept adversarial side.
+
+SPEC_PROMPT = [5, 6, 7, 5, 6, 7, 5, 6]
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_spec_stream_bit_identical_across_k_and_tp(kind, tp, llama_tp,
+                                                   mixtral_tp):
+    cfg, model, params = llama_tp if kind == "llama" else mixtral_tp
+    want = _flax_greedy(model, params, SPEC_PROMPT, 12)
+    for k in (0, 2, 4):
+        eng = _tp_engine(cfg, params, tp=tp, spec_k=k,
+                         max_blocks_per_slot=8)
+        req = eng.submit(SPEC_PROMPT, 12)
+        eng.run_until_idle()
+        assert req.error is None, (k, req.error)
+        assert req.tokens == want, (k, req.tokens, want)
+        if k:
+            assert eng.compile_counts["verify"] == 1
+            assert eng.compile_counts["decode"] == 0
+        else:
+            assert "verify" not in eng.compile_counts
+
+
+def test_spec_stall_mid_generation_preserves_stream(llama_tp):
+    """Block-extension stall under speculation: the stalled slot's
+    PENDING host token (window head) must survive the masked-out ticks —
+    on unstall the verify window resumes from it exactly. An oracle
+    always-wrong drafter pins every slot to one emit per tick, making
+    the block arithmetic (and therefore the stall) deterministic:
+    3 usable blocks, A's window outgrows its single block at pos 5
+    while B holds the other two until its budget retires it."""
+    cfg, model, params = llama_tp
+    pa, pb = [1, 2], [3, 4, 5, 6, 7, 8, 9, 10]
+    full_a = _flax_greedy(model, params, pa, 5)
+    full_b = _flax_greedy(model, params, pb, 6)
+    V = cfg.vocab_size
+
+    def wrong(ctx, n):
+        full = full_a if ctx[0] == 1 else full_b
+        return [(full[len(ctx) + j] + 1) % V
+                if len(ctx) + j < len(full) else 1 for j in range(n)]
+
+    eng = _tp_engine(cfg, params, tp=1, spec_k=4, slots=2, block_size=8,
+                     pool_blocks=4, max_blocks_per_slot=4,
+                     prefill_buckets=(8, 16), draft_fn=wrong)
+    a = eng.submit(pa, 5)
+    b = eng.submit(pb, 6)
+    stalled_seen = False
+    for _ in range(100):
+        if not eng.has_work():
+            break
+        eng.decode_once()
+        stalled_seen = stalled_seen or eng.slots[0].stalled
+    assert stalled_seen, "slot A never stalled — the scenario regressed"
+    assert a.error is None and not a.truncated
+    assert b.error is None and not b.truncated
+    assert a.tokens == full_a
+    assert b.tokens == full_b
+
+
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_spec_refill_swap_mid_decode_is_transparent(kind, llama_tp,
+                                                    mixtral_tp):
+    """Refill swap mid-SPECULATIVE-decode: the re-prefill consumes the
+    host-int gen_toks (including the pending token, whose K/V the pool
+    never held) and the continuation stays bit-identical."""
+    cfg, model, params = llama_tp if kind == "llama" else mixtral_tp
+    eng = _tp_engine(cfg, params, tp=2, policy="refill", spec_k=2)
+    req = eng.submit(SPEC_PROMPT, 10)
+    for _ in range(3):
+        eng.decode_once()
+    assert eng._active.any(), "request finished before the swap landed"
+    eng.install_params(params)                   # same weights, new seq
+    eng.run_until_idle()
+    assert req.error is None and not req.truncated
+    assert req.tokens == _flax_greedy(model, params, SPEC_PROMPT, 10)
+    assert eng.allocator.free_blocks == 23       # remap freed the originals
+
+
+def test_spec_drain_swap_finishes_on_old_weights(llama_tp):
+    cfg, model, params_a = llama_tp
+    _, _, params_b = _build_tp("llama", seed=7)
+    eng = _tp_engine(cfg, params_a, tp=1, policy="drain", spec_k=4)
+    req = eng.submit(SPEC_PROMPT, 10)
+    for _ in range(2):
+        eng.decode_once()
+    eng.install_params(params_b)
+    eng.run_until_idle()
+    assert req.tokens == _flax_greedy(model, params_a, SPEC_PROMPT, 10)
+    req2 = eng.submit(SPEC_PROMPT, 6)            # drained: B now serves
+    eng.run_until_idle()
+    assert req2.tokens == _flax_greedy(model, params_b, SPEC_PROMPT, 6)
+
+
 def test_refill_outgrown_sequence_retires_truncated(llama):
     """A live sequence longer than the largest prefill bucket cannot be
     remapped under new weights — it retires early with ``truncated``."""

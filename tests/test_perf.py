@@ -334,6 +334,67 @@ def test_headline_vs_baseline_band_and_shape():
                           for m in msgs)
 
 
+def _spec_rec(arm, ratio, **over):
+    rec = {"kind": "spec_decode", "metric": "spec_decode_speedup",
+           "model": "llama_tiny_serve_cpu8", "arm": arm, "ratio": ratio,
+           "spec_k": 4,
+           "tokens_per_s": {"plain": 1600.0, "spec": 1600.0 * ratio},
+           "noise": {"rounds": 6, "ratio_min": ratio * 0.9,
+                     "ratio_max": ratio * 1.1, "spread": ratio * 0.2},
+           "steady_compiles": 0}
+    rec.update(over)
+    return rec
+
+
+def test_spec_decode_rails_absolute_floors_per_arm():
+    # the ISSUE 16 rails are ABSOLUTE per workload arm, not best-ever:
+    # repeat_heavy >= 1.5x plain, adversarial >= 0.9x plain
+    ok, msgs = perf.ratchet_check(
+        [_spec_rec("repeat_heavy", 2.4), _spec_rec("adversarial", 0.96)],
+        band=0.9)
+    assert ok
+    assert any("ok [spec_decode" in m and "repeat_heavy" in m
+               for m in msgs)
+    ok, msgs = perf.ratchet_check([_spec_rec("adversarial", 0.85)],
+                                  band=0.9)
+    assert not ok and any("FAIL floor [spec_decode" in m for m in msgs)
+    ok, msgs = perf.ratchet_check([_spec_rec("repeat_heavy", 1.3)],
+                                  band=0.9)
+    assert not ok and any("FAIL floor [spec_decode" in m for m in msgs)
+
+
+def test_spec_decode_drift_below_best_warns_not_fails():
+    # acceptance-driven medians swing wider than the MFU band
+    # (measured 1.95-2.52 across honest sessions): below best*band but
+    # above the absolute floor is a drift WARNING, not a failure
+    ok, msgs = perf.ratchet_check(
+        [_spec_rec("repeat_heavy", 2.5), _spec_rec("repeat_heavy", 1.95)],
+        band=0.9)
+    assert ok
+    assert any("warn [spec_decode" in m for m in msgs)
+
+
+def test_spec_decode_shape_rails():
+    # zero steady-state compiles is part of the record's SHAPE: a spec
+    # arm that recompiles mid-stream is broken even at a great ratio
+    ok, msgs = perf.ratchet_check(
+        [_spec_rec("repeat_heavy", 2.4, steady_compiles=1)])
+    assert not ok and any("FAIL shape [spec_decode]" in m for m in msgs)
+    for bad in (_spec_rec("warp_drive", 2.4),          # unknown arm
+                _spec_rec("repeat_heavy", 2.4, spec_k=1),
+                _spec_rec("repeat_heavy", 2.4, noise={"rounds": 2}),
+                _spec_rec("repeat_heavy", 2.4,
+                          tokens_per_s={"plain": 1600.0})):
+        ok, msgs = perf.ratchet_check([bad])
+        assert not ok and any("FAIL shape [spec_decode]" in m
+                              for m in msgs)
+    # spec records never join the MFU grouping
+    ok, msgs = perf.ratchet_check(
+        [_rec("m", mfu=0.5), _spec_rec("adversarial", 0.96)], band=0.9)
+    assert ok
+    assert any("ok [m]: MFU" in m for m in msgs)
+
+
 def test_ratchet_band_env_is_honored(monkeypatch):
     monkeypatch.setenv(perf.RATCHET_BAND_ENV, "0.5")
     ok, _ = perf.ratchet_check([_rec("m", mfu=0.50), _rec("m", mfu=0.30)])

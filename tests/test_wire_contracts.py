@@ -166,6 +166,55 @@ def test_tp_decode_wire_contract(kind):
     assert not _permutes(hlo)
 
 
+@pytest.mark.parametrize("kind", ["llama", "mixtral"])
+def test_tp_verify_wire_contract(kind):
+    """ISSUE 16: the K-wide verify step keeps the decode wire contract —
+    still EXACTLY two all-reduces per layer, the operand grown to the
+    [S·K, D] window activation (k-fold amortization of the same two
+    fabric crossings, the whole point of one-shot verification), zero
+    collective-permutes, zero resharding."""
+    import dataclasses
+
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+
+    from horovod_tpu.models import decode as MD
+    from horovod_tpu.parallel import create_mesh
+
+    if kind == "llama":
+        from horovod_tpu.models.llama import Llama, llama_tiny
+        cfg = dataclasses.replace(llama_tiny(), n_heads=8, n_kv_heads=8)
+        model = Llama(cfg)
+    else:
+        from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
+        cfg = dataclasses.replace(mixtral_tiny(), n_heads=8, n_kv_heads=8,
+                                  capacity_factor=8.0)
+        model = Mixtral(cfg)
+    params = nn.meta.unbox(jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)))["params"]
+
+    S, K, bs, bmax = 2, 4, 4, 8
+    mesh = create_mesh({"tp": N}, devices=jax.devices()[:N])
+    kp, vp = MD.init_kv_pools(cfg, 16, bs)
+    pool_nd = NamedSharding(mesh, MD.kv_pool_spec())
+    kp, vp = jax.device_put(kp, pool_nd), jax.device_put(vp, pool_nd)
+    step = jax.jit(MD.make_verify_step_tp(cfg, bs, mesh))
+    hlo = step.lower(
+        params, kp, vp, jnp.zeros((S, K), jnp.int32),
+        jnp.zeros((S,), jnp.int32), jnp.zeros((S, bmax), jnp.int32),
+        jnp.zeros((S,), jnp.bool_)).as_text()
+
+    costs = collective_wire_costs(hlo)
+    assert [c["op"] for c in costs] == ["all_reduce"] * (2 * cfg.n_layers), \
+        [c["op"] for c in costs]
+    act_bytes = S * K * cfg.dim * 4          # one [S·K, D] f32 window
+    for c in costs:
+        assert c["group_size"] == N, c
+        assert c["operand_bytes"] == act_bytes, c
+        assert c["ring_bytes"] == 2 * (N - 1) / N * act_bytes, c
+    assert not _permutes(hlo)
+
+
 def test_permute_parse_single_pair():
     """The tensor<1x2xi64> single-pair rendering parses too (a 2-device
     permute or a single handoff prints without nested brackets)."""
