@@ -10,7 +10,7 @@ the deadlock patterns, the cotangent-scaling psum trap and the cond-copy
 trap BEFORE a multi-host TPU job launches, plus an AST lint that encodes
 the environment traps documented in CLAUDE.md.
 
-Two engines:
+Three engines:
 
 - :func:`analyze_step` — jaxpr-level collective-graph analysis.  Traces a
   step function abstractly (``jax.make_jaxpr`` on ``ShapeDtypeStruct``
@@ -18,18 +18,36 @@ Two engines:
   closed jaxpr including ``pjit``/``scan``/``cond``/``while``/``shard_map``
   sub-jaxprs, extracts the ordered collective signature stream and runs
   the JAX* checks listed in ``docs/analysis.md``.
+  :func:`analyze_rank_divergence` replays the trace once per simulated
+  rank and diffs the per-rank streams — the static analogue of the
+  controller's mismatch Response.
 - :func:`lint_paths` — AST trap lint over source files (no execution),
   the LINT* checks.
+- :mod:`.contracts` — the compiled-program contract registry: every
+  shipped program family's HLO-level invariants, checked against
+  :func:`summarize` summaries of the lowered/optimized text
+  (``--contracts``).
+
+All three report through :class:`Finding` (text, ``--json``, or SARIF
+via :func:`to_sarif`).
 
 CLI: ``python -m horovod_tpu.analysis <target> ...`` (see ``__main__.py``).
 """
 
-from .findings import Finding, Severity, format_findings
-from .jaxpr import CollectiveCall, analyze_step, collective_stream
+from .findings import (Finding, Severity, findings_from_sarif,
+                       format_findings, to_sarif)
+from .hlo import (HloCollective, HloSummary, collective_wire_costs,
+                  summarize, summarize_optimized, summarize_stablehlo)
+from .jaxpr import (CollectiveCall, analyze_rank_divergence, analyze_step,
+                    collective_stream, rank_streams)
 from .trap_lint import lint_paths, lint_source
 
 __all__ = [
     "Finding", "Severity", "format_findings",
+    "to_sarif", "findings_from_sarif",
+    "HloCollective", "HloSummary", "collective_wire_costs",
+    "summarize", "summarize_optimized", "summarize_stablehlo",
     "CollectiveCall", "analyze_step", "collective_stream",
+    "analyze_rank_divergence", "rank_streams",
     "lint_paths", "lint_source",
 ]

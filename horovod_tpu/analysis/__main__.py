@@ -6,6 +6,7 @@ Usage:
     python -m horovod_tpu.analysis --self-lint         # lint this repo
     python -m horovod_tpu.analysis --step MOD:ATTR     # jaxpr analysis
     python -m horovod_tpu.analysis --preflight SCRIPT  # launcher hook
+    python -m horovod_tpu.analysis --contracts         # contract matrix
 
 ``--step`` imports ``MOD`` (a module name or a ``.py`` path) and calls
 the zero-argument factory ``ATTR``, which must return either
@@ -16,8 +17,16 @@ under ``HOROVOD_PREFLIGHT_ANALYZE=1``: it lints the entry script and, if
 the script defines an ``HVD_ANALYZE`` factory, imports it (module-level
 code runs, the ``__main__`` guard does not) and jaxpr-checks the step.
 
+``--contracts`` runs the compiled-program contract registry
+(``analysis/contracts.py``): every registered family's programs are
+traced/compiled on the 8-device CPU mesh and their HLO summaries checked
+against the family's declared invariants; ``--family NAME`` (repeatable)
+restricts the matrix.  Needs the tier-1 incantation
+(``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``).
+
 Output is one ``file:line: SEVERITY [check-id] message`` line per
-finding (``--json`` for JSON lines).  Exit status: 0 clean or
+finding (``--json`` for JSON lines, ``--sarif`` for one SARIF 2.1.0
+document — SARIF wins when both are given).  Exit status: 0 clean or
 warnings-only, 1 if any ERROR finding, 2 on usage errors (``--strict``
 promotes warnings to the failing exit).
 """
@@ -124,8 +133,17 @@ def main(argv=None) -> int:
     parser.add_argument("--preflight", metavar="SCRIPT",
                         help="launcher preflight: lint SCRIPT and jaxpr-"
                              f"check its {ANALYZE_HOOK} hook if defined")
+    parser.add_argument("--contracts", action="store_true",
+                        help="run the compiled-program contract registry "
+                             "(analysis/contracts.py)")
+    parser.add_argument("--family", action="append", metavar="NAME",
+                        help="restrict --contracts to this family "
+                             "(repeatable)")
     parser.add_argument("--json", action="store_true",
                         help="emit findings as JSON lines")
+    parser.add_argument("--sarif", action="store_true",
+                        help="emit findings as one SARIF 2.1.0 document "
+                             "(takes precedence over --json)")
     parser.add_argument("--strict", action="store_true",
                         help="exit nonzero on warnings too")
     args = parser.parse_args(argv)
@@ -147,12 +165,31 @@ def main(argv=None) -> int:
     if args.preflight:
         findings.extend(_preflight(args.preflight))
         did_something = True
+    if args.contracts:
+        from . import contracts
+        only = args.family or None
+        if only:
+            unknown = [n for n in only
+                       if n not in contracts.families()]
+            if unknown:
+                print(f"unknown contract families: {unknown}; "
+                      f"registered: {contracts.families()}",
+                      file=sys.stderr)
+                return 2
+        findings.extend(contracts.run_contracts(only))
+        did_something = True
+    elif args.family:
+        print("--family requires --contracts", file=sys.stderr)
+        return 2
 
     if not did_something:
         parser.print_usage(sys.stderr)
         return 2
 
-    if args.json:
+    if args.sarif:
+        from .findings import to_sarif
+        print(json.dumps(to_sarif(findings)))
+    elif args.json:
         for f in findings:
             print(json.dumps(f.to_dict()))
     elif findings:
@@ -163,7 +200,7 @@ def main(argv=None) -> int:
     if args.strict and any(f.severity == Severity.WARNING
                            for f in findings):
         return 1
-    if not args.json and not findings:
+    if not args.sarif and not args.json and not findings:
         print("hvd-analyze: clean")
     return 0
 

@@ -63,3 +63,81 @@ def max_severity(findings: List[Finding]) -> Optional[Severity]:
         if worst is None or order.index(f.severity) > order.index(worst):
             worst = f.severity
     return worst
+
+
+# ----------------------------------------------------------------- SARIF
+#
+# One emitter shared by all three engines (trap lint, jaxpr, contract
+# registry) so CI annotators consume a single schema.  Check ids double
+# as SARIF rule ids — they are stable across releases (documented in
+# docs/analysis.md "Stable rule ids").  SARIF requires startLine >= 1,
+# so line-0 findings (module-level / registry findings) are clamped and
+# the ORIGINAL finding dict is stashed in ``result.properties.hvd`` —
+# :func:`findings_from_sarif` round-trips losslessly from there.
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_SARIF_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+                Severity.INFO: "note"}
+
+
+def to_sarif(findings: List[Finding],
+             tool_name: str = "hvd-analyze") -> Dict[str, Any]:
+    """Render findings as one SARIF 2.1.0 run (a plain dict; json-dump
+    it yourself).  Rule ids are the check ids, in first-seen order."""
+    rules, rule_index = [], {}
+    results = []
+    for f in findings:
+        if f.check_id not in rule_index:
+            rule_index[f.check_id] = len(rules)
+            rules.append({"id": f.check_id})
+        results.append({
+            "ruleId": f.check_id,
+            "ruleIndex": rule_index[f.check_id],
+            "level": _SARIF_LEVEL[f.severity],
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "properties": {"hvd": f.to_dict()},
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {"name": tool_name, "rules": rules}},
+            "results": results,
+        }],
+    }
+
+
+def findings_from_sarif(doc: Dict[str, Any]) -> List[Finding]:
+    """Reconstruct the Finding list from a :func:`to_sarif` document
+    (lossless: reads the stashed ``properties.hvd`` payload, falling
+    back to the SARIF fields for documents produced elsewhere)."""
+    level_to_sev = {v: k for k, v in _SARIF_LEVEL.items()}
+    out = []
+    for run in doc.get("runs", []):
+        for r in run.get("results", []):
+            hvd = (r.get("properties") or {}).get("hvd")
+            if hvd is not None:
+                out.append(Finding(
+                    hvd["check_id"], Severity(hvd["severity"]),
+                    hvd["file"], hvd["line"], hvd["message"],
+                    hvd.get("detail")))
+                continue
+            loc = (r.get("locations") or [{}])[0] \
+                .get("physicalLocation", {})
+            out.append(Finding(
+                r.get("ruleId", "unknown"),
+                level_to_sev.get(r.get("level", "warning"),
+                                 Severity.WARNING),
+                loc.get("artifactLocation", {}).get("uri", "<unknown>"),
+                loc.get("region", {}).get("startLine", 0),
+                r.get("message", {}).get("text", "")))
+    return out

@@ -394,6 +394,112 @@ def analyze_step(fn, *args,
     return ana.findings
 
 
+def rank_streams(factory, size: int, ranks=None):
+    """Per-rank collective signature streams of a rank-parameterized step.
+
+    ``factory(rank, size)`` must return ``(fn, args)`` (or the dict form
+    ``{"fn": fn, "args": (...)}``) with the CONCRETE rank/size already
+    bound — the closure a launcher builds per process.  Each rank's step
+    is traced abstractly and its ordered collective stream extracted;
+    a rank whose trace fails contributes the sentinel stream
+    ``[("<trace-error>", message)]`` so rank-DEPENDENT trace failure
+    registers as divergence while a uniform failure does not.
+    """
+    if ranks is None:
+        ranks = range(size)
+    streams = {}
+    for rank in ranks:
+        spec = factory(rank, size)
+        if isinstance(spec, dict):
+            fn, args = spec["fn"], tuple(spec.get("args", ()))
+        else:
+            fn, args = spec[0], tuple(spec[1])
+        try:
+            closed = _closed_jaxpr_of(fn, *args)
+        except Exception as e:  # noqa: BLE001 — any trace failure counts
+            streams[rank] = [("<trace-error>",
+                              f"{type(e).__name__}: {e}")]
+            continue
+        ana = _Analysis(DEFAULT_BIG_CARRY_BYTES)
+        ana.visit(closed.jaxpr, _Ctx(cond_site=None, mesh_axes=None))
+        streams[rank] = ana.stream
+    return streams
+
+
+def _stream_sig(entry):
+    """Comparison key for one stream entry: (primitive, axes, shape,
+    dtype) — file/line excluded (identical code traced from different
+    closures may report different lines)."""
+    if isinstance(entry, CollectiveCall):
+        return (entry.primitive, entry.axes, entry.shape, entry.dtype)
+    return tuple(entry)  # the ("<trace-error>", msg) sentinel
+
+
+def _stream_repr(stream) -> List[str]:
+    out = []
+    for entry in stream:
+        if isinstance(entry, CollectiveCall):
+            out.append(f"{entry.primitive}{list(entry.axes)} "
+                       f"{entry.dtype}{list(entry.shape)}")
+        else:
+            out.append(f"{entry[0]} {entry[1]}")
+    return out
+
+
+def analyze_rank_divergence(factory, size: int,
+                            ranks=None) -> List[Finding]:
+    """Static cross-rank divergence detection — the SPMD analogue of the
+    reference controller's mismatch response (``horovod/common/
+    controller.cc`` builds a "who disagreed, about what" error when
+    ranks negotiate different tensor streams; SURVEY.md §2).
+
+    Evaluates the step once per simulated rank with concrete rank/size
+    bindings (see :func:`rank_streams`), then diffs the per-rank
+    collective signature streams pairwise against rank ``ranks[0]``.
+    The first divergent op — extra, missing, or different — produces a
+    ``jax-rank-divergence`` ERROR carrying BOTH ranks' full streams and
+    the divergence index, catching ``if rank == 0: allreduce(...)``
+    before a multi-host job hangs on it.
+    """
+    streams = rank_streams(factory, size, ranks)
+    order = list(streams)
+    base_rank = order[0]
+    base = streams[base_rank]
+    base_sig = [_stream_sig(e) for e in base]
+    findings: List[Finding] = []
+    for rank in order[1:]:
+        other = streams[rank]
+        other_sig = [_stream_sig(e) for e in other]
+        if other_sig == base_sig:
+            continue
+        idx = next((i for i, (a, b)
+                    in enumerate(zip(base_sig, other_sig)) if a != b),
+                   min(len(base_sig), len(other_sig)))
+        # Location: the first entry present at the divergence point.
+        file, line = "<unknown>", 0
+        for stream in (base, other):
+            if idx < len(stream) \
+                    and isinstance(stream[idx], CollectiveCall):
+                file, line = stream[idx].file, stream[idx].line
+                break
+        a = base_sig[idx] if idx < len(base_sig) else None
+        b = other_sig[idx] if idx < len(other_sig) else None
+        findings.append(Finding(
+            "jax-rank-divergence", Severity.ERROR, file, line,
+            f"ranks {base_rank} and {rank} (of {size}) emit different "
+            f"collective streams — first divergence at op {idx}: rank "
+            f"{base_rank} issues {a}, rank {rank} issues {b}; on a real "
+            f"job the minority rank never shows up and the collective "
+            f"deadlocks (the mismatch the reference controller "
+            f"negotiates at runtime, controller.cc)",
+            {"size": size, "divergence_index": idx,
+             "rank_a": base_rank, "rank_b": rank,
+             "stream_a": _stream_repr(base),
+             "stream_b": _stream_repr(other)}))
+        break  # first divergent pair is the actionable one
+    return findings
+
+
 def collective_stream(fn, *args, **kwargs) -> List[CollectiveCall]:
     """The ordered collective signature stream of a traced step.
 

@@ -148,6 +148,21 @@ CLAUDE.md "Environment traps"):
   (``serving/decode.py::_ngram_draft``), batch the window, verify once;
   pragma a deliberate draft-model forward.
 
+- ``lint-rank-conditional-collective`` (ERROR): a collective call
+  (``allreduce``/``broadcast``/``psum``/``barrier``/...) lexically
+  inside the body of an ``if`` whose test calls ``rank()`` /
+  ``local_rank()`` / ``cross_rank()`` — the oldest Horovod failure
+  class of all: only some ranks reach the collective, the rest never
+  show up, and the job hangs with no error.  This is the host-level AST
+  complement to the jaxpr engine's per-rank stream diffing
+  (``analysis.jaxpr.analyze_rank_divergence``): the AST rule catches
+  the pattern in ANY Python file without tracing; the jaxpr check
+  proves it on the traced step.  Rank-conditional host work (rank-0
+  logging, checkpoint writes) is fine — only collective NAMES inside
+  the branch trip this.  A deliberate both-paths protocol (e.g. the
+  engine's ``broadcast_object`` early-return, where both branches call
+  the same collective) carries the pragma.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -359,6 +374,20 @@ def _maps_leafwise_reduce(fn_arg) -> bool:
     return False
 
 
+# lint-rank-conditional-collective vocabulary: the rank accessors whose
+# presence as a CALL in an if-test marks the branch rank-divergent, and
+# the collective entry points (host engine API + jax primitives) that
+# must never sit inside such a branch.
+RANK_CALL_NAMES = frozenset({"rank", "local_rank", "cross_rank"})
+RANK_CONDITIONAL_COLLECTIVES = frozenset({
+    "allreduce", "grouped_allreduce", "hierarchical_allreduce",
+    "allgather", "allgather_object", "broadcast", "broadcast_object",
+    "alltoall", "reducescatter", "barrier",
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "reduce_scatter", "all_to_all",
+})
+
+
 # Directory names never linted (fixture corpora are known-bad on purpose).
 EXCLUDED_DIR_NAMES = frozenset({
     "analysis_fixtures", "__pycache__", ".git", "node_modules",
@@ -436,6 +465,9 @@ class _Lint(ast.NodeVisitor):
         # lint-xplane-umbrella: duration accumulations already attributed
         # to an enclosing events loop (nested walks must not re-flag).
         self._xplane_handled: set = set()
+        # lint-rank-conditional-collective: collective call sites already
+        # attributed to an enclosing (outermost) rank-conditional.
+        self._rank_cond_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -511,7 +543,45 @@ class _Lint(ast.NodeVisitor):
                         self._jit_names.add(sub.name)
         self.generic_visit(node)
 
+    def _check_rank_conditional_collective(self, node):
+        """lint-rank-conditional-collective: a collective call lexically
+        under an ``if rank() ...`` branch — the deadlock class the
+        reference controller's negotiation existed to surface.  Outer If
+        visited first, so nested rank-conditionals skip already-claimed
+        call sites.  Only the branch bodies are scanned; a rank call
+        ALONE (logging, checkpoint gating) never trips this."""
+        test_is_ranked = any(
+            isinstance(sub, ast.Call)
+            and _dotted(sub.func).split(".")[-1] in RANK_CALL_NAMES
+            for sub in ast.walk(node.test))
+        if not test_is_ranked:
+            return
+        for stmt in list(node.body) + list(node.orelse):
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _dotted(sub.func)
+                if name.split(".")[-1] not in RANK_CONDITIONAL_COLLECTIVES:
+                    continue
+                if id(sub) in self._rank_cond_handled:
+                    continue
+                self._rank_cond_handled.add(id(sub))
+                self._add(
+                    "lint-rank-conditional-collective", Severity.ERROR,
+                    sub,
+                    f"collective {name!r} inside a rank-conditional "
+                    f"branch (if ...rank()... at line {node.lineno}): "
+                    "only some ranks reach the collective and the rest "
+                    "never show up — the job hangs with no error (the "
+                    "mismatch class horovod/common/controller.cc "
+                    "negotiates at runtime). Hoist the collective out "
+                    "of the branch so EVERY rank calls it, gate only "
+                    "the host-side work on rank, or pragma a vetted "
+                    "both-paths protocol (docs/analysis.md)",
+                    {"conditional_line": node.lineno})
+
     def visit_If(self, node):
+        self._check_rank_conditional_collective(node)
         guarded = any(
             isinstance(sub, ast.Constant) and sub.value == XLA_GUARD_ENV
             for sub in ast.walk(node.test))

@@ -229,7 +229,10 @@ class CollectiveEngine:
             blob = np.frombuffer(
                 pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
                 dtype=np.uint8).copy()
-            self.broadcast(name, blob, root_rank, members)
+            # Both paths broadcast (receivers call it right below with
+            # arr=None) — the early-return is shape dispatch, not a
+            # rank-gated collective.
+            self.broadcast(name, blob, root_rank, members)  # hvd-analyze: ok
             return obj
         rows = self.broadcast(name, None, root_rank, members)
         return pickle.loads(np.asarray(rows, dtype=np.uint8).tobytes())

@@ -340,22 +340,32 @@ def _maybe_preflight_analyze(command: List[str]) -> None:
     Runs hvd-analyze over the entry script BEFORE any worker spawns: the
     AST trap lint always, plus the jaxpr collective checks when the
     script defines an ``HVD_ANALYZE`` factory (see docs/analysis.md).
-    Runs in a subprocess pinned to CPU so tracing can never touch this
-    process' backend state or a real chip.  ERROR findings abort the
-    launch (the whole point: catch the deadlock before N hosts hang);
-    set the variable to ``warn`` to report without aborting.
+    ``HOROVOD_PREFLIGHT_ANALYZE=contracts`` (or ``full``) additionally
+    runs the compiled-program contract registry (``--contracts``) on an
+    8-device virtual CPU mesh — minutes, not seconds, so it is its own
+    opt-in level.  Runs in a subprocess pinned to CPU so tracing can
+    never touch this process' backend state or a real chip.  ERROR
+    findings abort the launch (the whole point: catch the deadlock
+    before N hosts hang); set the variable to ``warn`` to report
+    without aborting.
     """
     val = os.environ.get("HOROVOD_PREFLIGHT_ANALYZE", "").lower()
-    if val not in ("1", "true", "yes", "on", "warn"):
+    if val not in ("1", "true", "yes", "on", "warn", "contracts", "full"):
         return
     script = next((c for c in command if c.endswith(".py")), None)
     if script is None or not os.path.exists(script):
         return
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.analysis",
-         "--preflight", script],
-        env=env, capture_output=True, text=True)
+    cmd = [sys.executable, "-m", "horovod_tpu.analysis",
+           "--preflight", script]
+    if val in ("contracts", "full"):
+        cmd.append("--contracts")
+        # The contract matrix traces 8-way meshes; the preflight
+        # subprocess needs the virtual-device incantation.
+        env["XLA_FLAGS"] = " ".join(filter(None, [
+            env.get("XLA_FLAGS", ""),
+            "--xla_force_host_platform_device_count=8"]))
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
     out = (proc.stdout or "") + (proc.stderr or "")
     if out.strip():
         print(f"[hvdrun] preflight analyze ({script}):\n{out.strip()}")

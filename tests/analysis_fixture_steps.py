@@ -110,3 +110,43 @@ def donated_reuse_spec():
         y = jax.jit(lambda v: v + 1, donate_argnums=(0,))(x)
         return y + x  # <- jax-donated-reuse
     return fn, (_x(),)
+
+
+# ------------------------------------------- rank-parameterized factories
+#
+# ``analyze_rank_divergence`` consumes factory(rank, size) -> (fn, args):
+# the step is re-traced once per simulated rank with the CONCRETE rank
+# bound, so host-level ``if rank == 0:`` branches (invisible to a single
+# abstract trace — Python already picked the branch) shape each rank's
+# collective stream differently and the pairwise diff catches it.
+
+def rank_gated_allreduce_factory(rank, size):
+    """The canonical mismatch: rank 0 issues a psum the other ranks never
+    reach (reference: horovod/common/controller.cc answers this with a
+    mismatch Response at runtime; under GSPMD the job just hangs)."""
+    mesh = _mesh()
+
+    def fn(x):
+        def inner(x):
+            if rank == 0:
+                return lax.psum(x, "dp")  # <- jax-rank-divergence
+            return x * 1.0
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P("dp"), check_vma=False)(x)
+    return fn, (_x(),)
+
+
+def uniform_allreduce_factory(rank, size):
+    """Control: every rank traces the identical stream — rank only picks
+    host-side work, the collective is unconditional.  Must produce ZERO
+    divergence findings."""
+    mesh = _mesh()
+
+    def fn(x):
+        def inner(x):
+            out = lax.psum(x, "dp")
+            return out
+
+        return shard_map(inner, mesh=mesh, in_specs=P("dp"),
+                         out_specs=P(), check_vma=False)(x)
+    return fn, (_x(),)

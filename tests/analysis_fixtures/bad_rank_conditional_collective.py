@@ -1,0 +1,37 @@
+"""Fixture: lint-rank-conditional-collective (exactly ONE finding).
+
+A collective issued under a rank-gated conditional — the canonical
+SPMD deadlock (reference: the controller's mismatch Response fires when
+rank 0 submits a tensor the others never announce; under GSPMD the job
+just hangs).  Plus a suppressed twin and two clean look-alikes.
+"""
+
+import horovod_tpu as hvd
+
+
+def bad_broadcast_metrics(metrics):
+    if hvd.rank() == 0:
+        metrics = hvd.allreduce(metrics)  # <- lint-rank-conditional-collective
+    return metrics
+
+
+def suppressed_broadcast_metrics(metrics):
+    if hvd.rank() == 0:
+        metrics = hvd.allreduce(metrics)  # hvd-analyze: ok
+    return metrics
+
+
+def clean_logging(metrics):
+    # Rank-gated HOST work (no collective) is the normal idiom.
+    if hvd.rank() == 0:
+        print("metrics:", metrics)
+    return metrics
+
+
+def clean_all_ranks_reduce(metrics):
+    # Every rank reaches the collective; the conditional only picks the
+    # label afterwards.
+    reduced = hvd.allreduce(metrics)
+    if hvd.rank() == 0:
+        print("reduced:", reduced)
+    return reduced

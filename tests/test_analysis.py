@@ -23,8 +23,11 @@ import numpy as np
 import pytest
 
 import horovod_tpu as hvd
-from horovod_tpu.analysis import (analyze_step, collective_stream,
-                                  lint_paths, lint_source)
+from horovod_tpu.analysis import (Finding, Severity,
+                                  analyze_rank_divergence, analyze_step,
+                                  collective_stream, findings_from_sarif,
+                                  lint_paths, lint_source,
+                                  summarize_stablehlo, to_sarif)
 from horovod_tpu.analysis.__main__ import main as analysis_main
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -218,6 +221,8 @@ LINT_CASES = [
      "warning"),
     ("bad_xplane_umbrella.py", "lint-xplane-umbrella", "warning"),
     ("bad_replicated_kv_pool.py", "lint-replicated-kv-pool", "warning"),
+    ("bad_rank_conditional_collective.py",
+     "lint-rank-conditional-collective", "error"),
 ]
 
 
@@ -259,6 +264,131 @@ def test_self_lint_clean(capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "hvd-analyze: clean" in out
+
+
+def test_self_lint_sweeps_benchmarks_and_examples():
+    """The --self-lint path set is pinned: benchmarks/ and examples/
+    (home of the measurement-trap lints' real targets) must stay in the
+    sweep alongside the package, tests, and entry points."""
+    from horovod_tpu.analysis.__main__ import REPO_SELF_LINT_TARGETS
+    for target in ("horovod_tpu", "tests", "benchmarks", "examples",
+                   "bench.py", "__graft_entry__.py"):
+        assert target in REPO_SELF_LINT_TARGETS, target
+
+
+# ---------------------------------------------------- rank divergence
+
+def test_rank_divergence_flags_rank_gated_allreduce():
+    """The ISSUE 17 acceptance case: ``if rank == 0: psum(...)`` is
+    invisible to a single abstract trace (Python already picked the
+    branch) but the per-rank replay catches it — first divergent op,
+    BOTH ranks' streams in the detail, location at the gated psum."""
+    findings = analyze_rank_divergence(
+        fixture_steps.rank_gated_allreduce_factory, 8)
+    assert [f.check_id for f in findings] == ["jax-rank-divergence"], \
+        findings
+    f = findings[0]
+    assert f.severity == Severity.ERROR
+    assert f.file == FIXTURE_STEPS
+    assert f.line == _marker_line(FIXTURE_STEPS, "jax-rank-divergence")
+    d = f.detail
+    assert d["size"] == 8 and d["divergence_index"] == 0
+    assert d["rank_a"] == 0 and d["rank_b"] == 1
+    assert d["stream_a"] and d["stream_b"] == []
+
+
+def test_rank_divergence_zero_false_positives():
+    """Every shipped fixture step, wrapped in a rank-ignoring factory,
+    plus the uniform-collective control: ZERO divergence findings.
+    ``bad_axis_spec`` fails to trace on EVERY rank — uniform failure is
+    agreement, not divergence."""
+    for spec_name, _ in JAXPR_CASES:
+        spec = getattr(fixture_steps, spec_name)
+        assert analyze_rank_divergence(
+            lambda rank, size, _s=spec: _s(), 4) == [], spec_name
+    assert analyze_rank_divergence(
+        fixture_steps.uniform_allreduce_factory, 8) == []
+
+
+# --------------------------------------- hlo layer vs jaxpr layer
+
+_JAXPR_TO_HLO = {"psum": "all_reduce", "pmean": "all_reduce",
+                 "ppermute": "collective_permute",
+                 "all_gather": "all_gather",
+                 "psum_scatter": "reduce_scatter",
+                 "all_to_all": "all_to_all"}
+
+
+def test_hlo_stream_matches_jaxpr_stream():
+    """Property: on a program where XLA introduces no extra collectives
+    (shard_map lowered to stablehlo, pre-SPMD), the hlo-layer stream is
+    the jaxpr-layer stream under the primitive→opcode map — the two
+    engines agree on what rides the fabric, in order."""
+    from jax import lax, shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def fn(x):
+        def inner(v):
+            v = lax.psum(v, "r")
+            v = lax.ppermute(v, "r", perm)
+            g = lax.all_gather(v, "r")
+            return jnp.sum(g, axis=0)
+        return shard_map(inner, mesh=mesh, in_specs=P("r"),
+                         out_specs=P("r"), check_vma=False)(x)
+
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    jaxpr_ops = [_JAXPR_TO_HLO[c.primitive]
+                 for c in collective_stream(fn, x)]
+    summary = summarize_stablehlo(jax.jit(fn).lower(x).as_text())
+    assert summary.ops() == jaxpr_ops
+    assert jaxpr_ops == ["all_reduce", "collective_permute", "all_gather"]
+
+
+# --------------------------------------------------------------- SARIF
+
+def test_sarif_round_trip():
+    """to_sarif emits schema-shaped 2.1.0 (rules in first-seen order,
+    startLine clamped to >= 1, severity→level mapped) and
+    findings_from_sarif reconstructs the EXACT finding list from the
+    stashed properties.hvd payload — including line 0 and detail."""
+    findings = [
+        Finding("lint-xla-flags", Severity.ERROR, "a.py", 3, "boom",
+                {"flag": "--xla_bogus"}),
+        Finding("contract-decode-tp", Severity.ERROR,
+                "horovod_tpu/models/decode.py", 0, "stream reshaped"),
+        Finding("lint-torch-seed", Severity.WARNING, "b.py", 9, "races"),
+        Finding("jax-rank-divergence", Severity.INFO, "c.py", 1, "note"),
+    ]
+    doc = json.loads(json.dumps(to_sarif(findings)))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "hvd-analyze"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "lint-xla-flags", "contract-decode-tp", "lint-torch-seed",
+        "jax-rank-divergence"]
+    res = run["results"]
+    assert [r["level"] for r in res] == ["error", "error", "warning",
+                                         "note"]
+    # line-0 registry finding: clamped in the SARIF region ...
+    assert res[1]["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 1
+    # ... but preserved through the round trip.
+    assert findings_from_sarif(doc) == findings
+
+
+def test_cli_sarif_flag(capsys):
+    """--sarif prints ONE SARIF document (not JSON lines) and keeps the
+    ERROR exit code."""
+    rc = analysis_main(
+        ["--sarif", os.path.join(FIXTURE_DIR, "bad_xla_flags.py")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    results = doc["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["lint-xla-flags"]
+    assert results[0]["properties"]["hvd"]["severity"] == "error"
 
 
 # ----------------------------------------------------------- preflight
@@ -305,6 +435,42 @@ def test_preflight_runs_hvd_analyze_hook(tmp_path, monkeypatch):
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     with pytest.raises(SystemExit, match="preflight analyze"):
         _maybe_preflight_analyze(["python", str(script)])
+
+
+def test_preflight_contracts_mode_command(tmp_path, monkeypatch):
+    """HOROVOD_PREFLIGHT_ANALYZE=contracts appends --contracts to the
+    preflight subprocess and gives it the 8-virtual-device incantation
+    (command construction only — the real matrix runs in
+    tests/test_contracts.py, not here)."""
+    from horovod_tpu.runner import launch as launch_mod
+
+    script = tmp_path / "train.py"
+    script.write_text("print('worker')\n")
+    captured = {}
+
+    def fake_run(cmd, env=None, capture_output=None, text=None):
+        captured["cmd"], captured["env"] = cmd, env
+
+        class Result:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+        return Result()
+
+    monkeypatch.setattr(launch_mod.subprocess, "run", fake_run)
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    monkeypatch.setenv("HOROVOD_PREFLIGHT_ANALYZE", "contracts")
+    launch_mod._maybe_preflight_analyze(["python", str(script)])
+    assert "--preflight" in captured["cmd"]
+    assert "--contracts" in captured["cmd"]
+    assert captured["env"]["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" \
+        in captured["env"]["XLA_FLAGS"]
+
+    # plain "1" keeps the fast lint+jaxpr preflight: no --contracts.
+    monkeypatch.setenv("HOROVOD_PREFLIGHT_ANALYZE", "1")
+    launch_mod._maybe_preflight_analyze(["python", str(script)])
+    assert "--contracts" not in captured["cmd"]
 
 
 # ------------------------------------------- deferred-step resume phase

@@ -1,140 +1,37 @@
-"""Bench-parity regression tests (VERDICT r5 Weak #1).
+"""Bench-parity regression tests (VERDICT r5 Weak #1 → ISSUE 17).
 
 BENCH_r05's ``vs_baseline`` 0.9631 fell outside the stated ±0.02 band.
 The bisect suspicion was that the r5 train.py deferral change
-(``make_gspmd_deferred_train_step``) taxed ``make_train_step``. These
-tests pin the graph-level facts that rule that out permanently:
+(``make_gspmd_deferred_train_step``) taxed ``make_train_step``. The
+graph-level facts that rule that out permanently are now declared in
+the contract registry (``horovod_tpu/analysis/contracts.py``) and
+driven thin from here:
 
-1. bench.py's two arms (hvd DistributedOptimizer step vs plain step)
-   compile to programs with byte-identical collective-op sets on the
-   bench's 1-device mesh — the distributed machinery inserts nothing
-   the plain arm doesn't have, so any measured ratio shift is NOISE,
-   not graph tax. (The r5 reading was re-attributed to across-session
-   tunnel noise; see docs/benchmarks.md "Parity band".)
-2. The deferred factory at ``every=1`` emits collective HLO
-   byte-identical to the standard GSPMD step it wraps — the deferral
-   is graph-level inert at k=1 and cannot tax the standard arms.
+1. ``bench-arms-parity``: bench.py's two arms (hvd DistributedOptimizer
+   step vs plain step) compile to programs with byte-identical — and on
+   the bench's 1-device mesh, EMPTY — collective-op sets; any measured
+   ratio shift is NOISE, not graph tax (see docs/benchmarks.md
+   "Parity band").
+2. ``gspmd-deferred-every1``: the deferred factory at ``every=1`` emits
+   collective HLO signature-identical to the standard GSPMD step it
+   wraps — the deferral is graph-level inert at k=1.
 
 Collective HLO is compared post-SPMD-partitioning (``.compile()``):
 GSPMD inserts collectives during partitioning, so stablehlo lowering
-alone would compare nothing.
+alone would compare nothing.  Builds are memoized in the registry and
+shared with the full ``--contracts`` matrix (tests/test_contracts.py).
 """
 
 from __future__ import annotations
 
-import re
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 import pytest
 
-_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
-                   "collective-permute", "all-to-all")
+import horovod_tpu  # noqa: F401  (compat shims before any jax use)
+from horovod_tpu.analysis import contracts
 
 
-def _collective_signature(compiled) -> list:
-    """Sorted (opcode, shape, replica_groups) tuples from optimized HLO —
-    instruction ids/channel ids vary run to run, the collective structure
-    must not."""
-    text = compiled.as_text()
-    sig = []
-    for line in text.splitlines():
-        m = re.search(
-            r"=\s+(\S+)\s+(all-reduce|all-gather|reduce-scatter|"
-            r"collective-permute|all-to-all)(?:-start)?\(", line)
-        if not m:
-            continue
-        groups = re.search(r"replica_groups=(\{[^}]*\}|\[[^\]]*\][^,)]*)",
-                           line)
-        sig.append((m.group(2), m.group(1),
-                    groups.group(1) if groups else ""))
-    return sorted(sig)
-
-
-def test_bench_arms_collective_hlo_identical():
-    """bench.py's hvd arm vs plain arm, exactly as the bench builds them
-    (1-device mesh, same model factory, scan_steps): identical collective
-    sets — on one chip both must be EMPTY (force_axis_size1 collapses the
-    distributed collectives to identity)."""
-    import horovod_tpu as hvd
-    from horovod_tpu.models import ResNetTiny
-    from horovod_tpu.optimizer import distributed
-    from horovod_tpu.train import create_train_state, make_train_step
-
-    hvd.init()
-
-    def loss_fn(logits, y):
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
-
-    rng = np.random.RandomState(0)
-    images = jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32))
-    labels = jnp.asarray(rng.randint(0, 1000, size=(4,)))
-
-    model = ResNetTiny(num_classes=1000, axis_name=hvd.RANK_AXIS,
-                       dtype=jnp.float32)
-    dopt = distributed(optax.sgd(0.1, momentum=0.9))
-    state = create_train_state(model, jax.random.PRNGKey(0), images[:1],
-                               dopt)
-    mesh1 = jax.sharding.Mesh(np.asarray(jax.devices()[:1]),
-                              (hvd.RANK_AXIS,))
-    step_hvd = make_train_step(model, dopt, loss_fn, scan_steps=4,
-                               mesh=mesh1, donate=False)
-
-    model_p = ResNetTiny(num_classes=1000, axis_name=None,
-                         dtype=jnp.float32)
-    popt = optax.sgd(0.1, momentum=0.9)
-    pstate = create_train_state(model_p, jax.random.PRNGKey(0), images[:1],
-                                popt, broadcast=False)
-    step_plain = make_train_step(model_p, popt, loss_fn, scan_steps=4,
-                                 mesh=mesh1, donate=False)
-
-    sig_hvd = _collective_signature(
-        step_hvd.lower(state, images, labels).compile())
-    sig_plain = _collective_signature(
-        step_plain.lower(pstate, images, labels).compile())
-    assert sig_hvd == sig_plain
-    assert sig_hvd == []    # 1-chip: the machinery must insert NOTHING
-
-
-def test_deferred_every1_collective_hlo_identical_to_standard_step():
-    """make_gspmd_deferred_train_step(every=1) — the r5 change — lowers
-    to collective HLO byte-identical to make_gspmd_train_step over the
-    same optimizer on a real 8-way CPU data-parallel mesh."""
-    from horovod_tpu.models.llama import LOGICAL_RULES
-    from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
-    from horovod_tpu.optimizer import deferred_pair
-    from horovod_tpu.parallel import create_mesh
-    from horovod_tpu.train import (create_gspmd_train_state,
-                                   make_gspmd_deferred_train_step,
-                                   make_gspmd_train_step)
-
-    cfg = mixtral_tiny()
-    mesh = create_mesh({"dp": 8})
-    model = Mixtral(cfg)
-    pair = deferred_pair(1e-3, every=1)
-    assert pair.every == 1
-    rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)))
-    state = create_gspmd_train_state(model, pair.apply,
-                                     jax.random.PRNGKey(0), tokens, mesh,
-                                     LOGICAL_RULES)
-
-    standard = make_gspmd_train_step(model, pair.apply, mesh,
-                                     LOGICAL_RULES, donate=False)
-    deferred = make_gspmd_deferred_train_step(model, pair, mesh,
-                                              LOGICAL_RULES, donate=False)
-
-    sig_std = _collective_signature(
-        standard.lower(state, tokens).compile())
-    sig_dfr = _collective_signature(
-        deferred.lower_apply(state, tokens).compile())
-    assert sig_std, "8-way DP step must contain collectives"
-    assert sig_dfr == sig_std
-    # every=1 means EVERY dispatch is the apply program — the skip program
-    # never runs, so the deferred step and the standard step execute the
-    # same collective graph every step.
-    from horovod_tpu.train import GSPMDTrainState
-    assert isinstance(state, GSPMDTrainState)
+@pytest.mark.parametrize("family", ["bench-arms-parity",
+                                    "gspmd-deferred-every1"])
+def test_bench_parity_contract(family):
+    findings = contracts.check_family(family)
+    assert not findings, "\n".join(f.format() for f in findings)

@@ -1,16 +1,18 @@
 """Scheduled gradient-bucket fusion + DCN-hop wire compression (PR 6).
 
-HLO-level pins for the overlap-and-wire tier (docs/fusion.md): the
-fusion threshold reshapes the DP train step's gradient collective
-stream (reverse-layer buckets → N independent all-reduces, donation
-intact), ``HOROVOD_FUSION_THRESHOLD=0`` disables fusion per reference
-semantics (one collective per tensor), and
-``HOROVOD_HIERARCHICAL_COMPRESSION`` casts ONLY the cross-slice (DCN)
-hop to the wire dtype — proven by operand-byte accounting on the
-lowered program (tests/wire_accounting.py), not timing. Numerics:
-compression round-trips within wire tolerance, integer leaves ride
-untouched, and a compressed-hop training run matches the uncompressed
-losses to bf16 tolerance.
+HLO-level pins for the overlap-and-wire tier (docs/fusion.md), now
+declared in the contract registry (``horovod_tpu/analysis/contracts.py``
+families ``dp-step-fusion`` and ``hierarchical-allreduce``, ISSUE 17)
+and driven thin from here: the fusion threshold reshapes the DP train
+step's gradient collective stream (reverse-layer buckets → N
+independent all-reduces, donation intact), ``HOROVOD_FUSION_THRESHOLD=0``
+disables fusion per reference semantics (one collective per tensor),
+and ``HOROVOD_HIERARCHICAL_COMPRESSION`` casts ONLY the cross-slice
+(DCN) hop to the wire dtype — proven by operand-byte accounting on the
+lowered program, not timing. Numerics stay here: compression
+round-trips within wire tolerance, integer leaves ride untouched, and a
+compressed-hop training run matches the uncompressed losses to bf16
+tolerance.
 """
 
 import jax
@@ -22,15 +24,10 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.analysis import contracts
 from horovod_tpu.collectives import ops
 from horovod_tpu.collectives.compression import Compression
-from horovod_tpu.collectives.ops import fusion_threshold_override
 from horovod_tpu.core.config import Config
-from wire_accounting import collective_wire_costs
-
-
-def _n_allreduce(txt):
-    return txt.count('"stablehlo.all_reduce"')
 
 
 def _mlp_pieces(width=64, depth=4):
@@ -50,87 +47,26 @@ def _mlp_pieces(width=64, depth=4):
     return MLP(), loss_fn
 
 
-def _lower_step_text(threshold):
-    """Lowered text of a fresh donated DP train step traced under the
-    given fusion threshold (fresh per call: jit caches lowerings, so an
-    override only matters on the first trace of a given step object)."""
-    from horovod_tpu.optimizer import distributed
-    from horovod_tpu.train import create_train_state, make_train_step
-
-    model, loss_fn = _mlp_pieces()
-    opt = distributed(optax.sgd(0.1))
-    xs = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype(np.float32))
-    ys = jnp.asarray(np.random.RandomState(1).randint(0, 4, size=(16,)))
-    state = create_train_state(model, jax.random.PRNGKey(0), xs[:2], opt,
-                               broadcast=False)
-    step = make_train_step(model, opt, loss_fn, donate=True)
-    with fusion_threshold_override(threshold):
-        return step.lower(state, xs, ys).as_text()
-
-
-def test_threshold_reshapes_train_step_collectives():
+def test_fusion_threshold_contract():
     """The DP step's gradient allreduce goes out as one fused buffer
     (uncapped), several independent bucket collectives (capped), or one
-    per tensor (threshold 0) — and buffer donation survives bucketing."""
-    hvd.shutdown()
-    hvd.init()
-    n_mono = _n_allreduce(_lower_step_text(1 << 62))
-    n_buck = _n_allreduce(_lower_step_text(20 << 10))
-    n_per = _n_allreduce(_lower_step_text(0))
-    # 10 grad leaves + the loss pmean: monolithic = 1 + 1.
-    assert n_mono == 2
-    assert n_per == 11
-    # Bucketed sits strictly between: several INDEPENDENT collectives
-    # (each an early-backward prefix's bucket), not one, not per-leaf.
-    assert n_mono < n_buck < n_per, (n_mono, n_buck, n_per)
-
-
-def test_donation_preserved_across_thresholds():
-    hvd.shutdown()
-    hvd.init()
-    for thr in (1 << 62, 20 << 10, 0):
-        txt = _lower_step_text(thr)
-        assert "jax.buffer_donor" in txt or "tf.aliasing_output" in txt, \
-            f"donation lost at threshold {thr}"
+    per tensor (threshold 0) — and buffer donation survives bucketing.
+    Declared as the ``dp-step-fusion`` contract; this driver shares its
+    memoized build with the ``--contracts`` matrix."""
+    findings = contracts.check_family("dp-step-fusion")
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
 def _mesh2d():
     return Mesh(np.array(jax.devices()).reshape(2, 4), ("cross", "intra"))
 
 
-def _hier_wire_costs(compression_name):
-    m2 = _mesh2d()
-    hvd.shutdown()
-    hvd.init(mesh=m2, config=Config(
-        hierarchical_allreduce=True,
-        hierarchical_compression=compression_name))
-    x = jnp.asarray(np.random.RandomState(5).randn(8, 64).astype(np.float32))
-    f = shard_map(lambda t: ops.allreduce(t, hvd.Sum), mesh=m2,
-                  in_specs=P(("cross", "intra")),
-                  out_specs=P(("cross", "intra")))
-    return collective_wire_costs(jax.jit(f).lower(x).as_text())
-
-
 def test_hierarchical_compression_bf16_cross_hop_only():
     """HOROVOD_HIERARCHICAL_COMPRESSION=bf16 halves the cross-slice (DCN)
     all_reduce payload and ONLY that payload: the ICI reduce-scatter and
-    all-gather stay f32-sized."""
-    B = 64 * 4  # per-device payload bytes (f32)
-    off = {c["op"]: c for c in _hier_wire_costs("none")}
-    on = {c["op"]: c for c in _hier_wire_costs("bf16")}
-    assert set(on) == {"reduce_scatter", "all_reduce", "all_gather"}
-
-    # Uncompressed baseline: the cross hop carries B/n_intra in f32.
-    assert off["all_reduce"]["operand_bytes"] == B // 4
-    # Compressed: same element count at 2 bytes — the DCN bytes halve.
-    assert on["all_reduce"]["operand_bytes"] == B // 4 // 2
-    # The ICI phases are untouched in both runs (full-precision psum
-    # accumulate over the 4-way axis; the convert pair wraps ONLY the
-    # cross psum).
-    for hop, key in (("reduce_scatter", "operand_bytes"),
-                     ("all_gather", "result_bytes")):
-        assert off[hop][key] == B
-        assert on[hop][key] == B
+    all-gather stay f32-sized (the ``hierarchical-allreduce`` contract)."""
+    findings = contracts.check_family("hierarchical-allreduce")
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
 def test_hierarchical_compression_env_var():

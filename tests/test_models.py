@@ -252,46 +252,15 @@ def test_dlrm_sparse_layout_pin_budget():
     ``build_sparse_training`` are load-bearing: without them XLA's
     entry-layout heuristic transposes the WHOLE embedding tables around
     the row gathers/scatters (4 × ~666 MB copies/step at the criteo
-    config, r4). Regression rail: the compiled sparse step contains ZERO
-    transpose/copy instructions at the table shape (full or per-shard),
-    and the overall copy/transpose counts stay under a pinned bound."""
-    import flax.linen as nn
-    from horovod_tpu.models.dlrm import (DLRM, build_sparse_training,
-                                         dlrm_tiny)
-    from horovod_tpu.train import rules_for_mesh
+    config, r4). Regression rail, declared as the ``dlrm-layout-pin``
+    contract: the compiled sparse step contains ZERO transpose/copy
+    instructions at the table shape (full or per-shard), and the overall
+    copy/transpose counts stay under a pinned bound (observed 51/17 on
+    the 8-dev CPU mesh, budget 102/34)."""
+    from horovod_tpu.analysis import contracts
 
-    cfg = dlrm_tiny()
-    model = DLRM(cfg)
-    rng = np.random.RandomState(0)
-    B = 16
-    dense = jnp.asarray(rng.randn(B, cfg.dense_features).astype(np.float32))
-    sparse = jnp.asarray(rng.randint(0, cfg.rows_per_table,
-                                     (B, cfg.num_tables)))
-    labels = jnp.asarray((rng.rand(B) < 0.3).astype(np.float32))
-    mesh = create_mesh({"ep": N})
-    rules = rules_for_mesh(mesh, LOGICAL_RULES)
-    params = nn.meta.unbox(
-        model.init(jax.random.PRNGKey(0), dense, sparse)["params"])
-    jitted, dp, tables, accum, opt_state = build_sparse_training(
-        model, cfg, mesh, rules, params)
-
-    txt = jitted.lower(dp, tables, accum, opt_state, dense, sparse,
-                       labels).compile().as_text()
-    nrows = cfg.num_tables * cfg.rows_per_table
-    table_shapes = (f"f32[{nrows},{cfg.embed_dim}]",
-                    f"f32[{nrows // N},{cfg.embed_dim}]")
-    lines = txt.splitlines()
-    table_moves = [
-        ln for ln in lines
-        if ("transpose(" in ln or " copy(" in ln)
-        and any(s in ln for s in table_shapes)]
-    assert not table_moves, (
-        "table-sized transpose/copy crept back into the sparse step — "
-        "the entry-layout pin regressed:\n" + "\n".join(table_moves[:4]))
-    # Coarse budget on the whole program (observed 51/17 on the 8-dev CPU
-    # mesh): catches a layout regression that moves data at ANY shape.
-    assert sum("transpose(" in ln for ln in lines) <= 102
-    assert sum(" copy(" in ln for ln in lines) <= 34
+    findings = contracts.check_family("dlrm-layout-pin")
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
 def test_dlrm_sparse_step_matches_dense_adagrad():

@@ -1,218 +1,40 @@
-"""Point-to-point wire contracts over ``collective_permute`` (VERDICT r5
-#6): the three ppermute-built algorithms each promise a specific
-topology × payload × hop-count, asserted against the LOWERED stablehlo
-via ``wire_accounting.collective_wire_costs`` — no second chip needed:
+"""Wire-contract drivers (VERDICT r5 #6 / ISSUE 14 / ISSUE 16 → ISSUE 17).
 
-- **Adasum butterfly** (collectives/adasum.py): log₂(n) rounds, round d
-  exchanging the FULL working buffer with XOR partner ``r ^ d``;
-- **ring attention** (parallel/ring.py): the K and V shards rotate the
-  +1 ring once per loop trip — fori_loop(0, n) ⇒ n−1 productive
-  rotations per step plus the homecoming hop, each moving exactly one
-  local K + one local V shard and nothing else;
-- **pipeline handoff** (parallel/pipeline.py): ONE activation permute
-  per schedule tick, stage i → i+1 around the ring.
+The topology × payload × hop-count invariants these tests used to spell
+out inline now live in the contract registry
+(``horovod_tpu/analysis/contracts.py``), declared once and checked both
+here and by ``python -m horovod_tpu.analysis --contracts``:
+
+- **adasum-butterfly**: log₂(n) permute rounds, FULL working buffer,
+  XOR-partner topology (collectives/adasum.py);
+- **ring-attention**: exactly the K and V shards rotate the +1 ring,
+  nothing else rides the step (parallel/ring.py);
+- **pipeline-handoff**: ONE activation permute per schedule tick,
+  stage i → i+1 (parallel/pipeline.py);
+- **decode-tp / verify-tp / prefill-tp** (tp ∈ {1, 2, 4}) and
+  **decode-tp8 / verify-tp8** (llama + mixtral at tp = 8): exactly
+  ``2·n_layers`` activation all-reduces over the full tp group — zero
+  permutes, zero resharding (models/decode.py).
+
+Builds are memoized in the registry, so these drivers and the full
+``--contracts`` matrix (tests/test_contracts.py) share one lowering per
+family per pytest process.
 """
 
-import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
-import horovod_tpu as hvd
+import horovod_tpu  # noqa: F401  (compat shims before any jax use)
+from horovod_tpu.analysis import contracts
 from wire_accounting import collective_wire_costs
 
-from jax import shard_map
-from jax.sharding import PartitionSpec as P
 
-N = 8
-
-
-def _permutes(hlo: str):
-    return [c for c in collective_wire_costs(hlo)
-            if c["op"] == "collective_permute"]
-
-
-def test_adasum_butterfly_wire_contract():
-    """n=8 ⇒ exactly log₂(8)=3 permute rounds, each moving the FULL
-    working vector (no halving — the butterfly trades 2× wire vs
-    recursive-halving for O(1) memory), with XOR-partner topology."""
-    from horovod_tpu.collectives.adasum import _butterfly
-
-    x = jnp.ones((64,), jnp.float32)
-    f = jax.jit(shard_map(lambda t: _butterfly(t, hvd.RANK_AXIS),
-                          mesh=hvd.mesh(), in_specs=P(), out_specs=P(),
-                          check_vma=False))
-    perms = _permutes(f.lower(x).as_text())
-    assert len(perms) == int(np.log2(N)), \
-        f"butterfly must lower to log2({N}) permutes, got {len(perms)}"
-    full_buffer = 64 * 4
-    for d, c in zip((1, 2, 4), perms):
-        assert c["operand_bytes"] == full_buffer, c
-        assert c["ring_bytes"] == full_buffer, c
-        assert {tuple(p) for p in c["pairs"]} == \
-            {(r, r ^ d) for r in range(N)}, (d, c["pairs"])
-        assert c["n_links"] == N
-
-
-def test_ring_attention_wire_contract():
-    """Per loop trip exactly TWO permutes ride the ring — the local K
-    shard and the local V shard, +1 topology — and NO other collective
-    rides the step at all. fori_loop(0, n) gives n trips: n−1 productive
-    KV rotations per attention step (the (n−1)·(K+V) wire bill) plus the
-    final homecoming hop."""
-    from horovod_tpu.parallel.ring import ring_attention
-
-    B, T_local, H, D = 1, 4, 2, 8
-    q = jnp.ones((B, N * T_local, H, D), jnp.float32)  # global sequence
-    f = jax.jit(shard_map(
-        lambda q, k, v: ring_attention(q, k, v, hvd.RANK_AXIS, impl="jnp"),
-        mesh=hvd.mesh(),
-        in_specs=(P(None, hvd.RANK_AXIS), P(None, hvd.RANK_AXIS),
-                  P(None, hvd.RANK_AXIS)),
-        out_specs=P(None, hvd.RANK_AXIS), check_vma=False))
-    hlo = f.lower(q, q, q).as_text()
-    perms = _permutes(hlo)
-    assert len(perms) == 2, f"K and V rotations only, got {len(perms)}"
-    shard_bytes = B * T_local * H * D * 4
-    ring = {(r, (r + 1) % N) for r in range(N)}
-    for c in perms:
-        assert c["operand_bytes"] == shard_bytes, c
-        assert {tuple(p) for p in c["pairs"]} == ring, c["pairs"]
-    # Nothing else rides the fabric inside the step.
-    others = [c for c in collective_wire_costs(hlo)
-              if c["op"] != "collective_permute"]
-    assert not others, others
-    # The contract figure the bench methodology uses: productive KV wire
-    # per attention step per device.
-    per_step_bytes = (N - 1) * 2 * shard_bytes
-    assert per_step_bytes == (N - 1) * sum(
-        c["ring_bytes"] for c in perms)
-
-
-def test_pipeline_handoff_wire_contract():
-    """One activation permute per schedule tick (the scan body), stage
-    i → i+1 around the ring, payload = one microbatch activation."""
-    from horovod_tpu.parallel.pipeline import pipeline
-
-    M, F = 4, 16                 # microbatches, feature width
-    x = jnp.ones((M, 2, F), jnp.float32)
-    params = jnp.ones((F, F), jnp.float32)
-
-    def stage(p, t):
-        return jnp.tanh(t @ p)
-
-    f = jax.jit(shard_map(
-        lambda p, t: pipeline(stage, p, t, hvd.RANK_AXIS),
-        mesh=hvd.mesh(), in_specs=(P(), P()), out_specs=P(),
-        check_vma=False))
-    perms = _permutes(f.lower(params, x).as_text())
-    assert len(perms) == 1, \
-        f"one handoff permute per tick, got {len(perms)}"
-    c = perms[0]
-    assert c["operand_bytes"] == 2 * F * 4, c   # one [2, F] activation
-    assert {tuple(p) for p in c["pairs"]} == \
-        {(r, (r + 1) % N) for r in range(N)}, c["pairs"]
-
-
-# ---------------------------------------------- tensor-parallel decode
-
-@pytest.mark.parametrize("kind", ["llama", "mixtral"])
-def test_tp_decode_wire_contract(kind):
-    """ISSUE 14: the shard_map'd decode step lowers to EXACTLY two
-    all-reduces per layer — the [S, D] activation psums after
-    attention-out and after MLP/expert-down, before each residual — and
-    nothing else rides the fabric: zero collective-permutes, zero
-    resharding gathers/scatters (the KV pool stays head-sharded; reads
-    stay per-shard gathers)."""
-    import dataclasses
-
-    from flax import linen as nn
-    from jax.sharding import NamedSharding
-
-    from horovod_tpu.models import decode as MD
-    from horovod_tpu.parallel import create_mesh
-
-    if kind == "llama":
-        from horovod_tpu.models.llama import Llama, llama_tiny
-        cfg = dataclasses.replace(llama_tiny(), n_heads=8, n_kv_heads=8)
-        model = Llama(cfg)
-    else:
-        from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
-        cfg = dataclasses.replace(mixtral_tiny(), n_heads=8, n_kv_heads=8,
-                                  capacity_factor=8.0)
-        model = Mixtral(cfg)
-    params = nn.meta.unbox(jax.jit(model.init)(
-        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)))["params"]
-
-    S, bs, bmax = 2, 4, 8
-    mesh = create_mesh({"tp": N}, devices=jax.devices()[:N])
-    kp, vp = MD.init_kv_pools(cfg, 16, bs)
-    pool_nd = NamedSharding(mesh, MD.kv_pool_spec())
-    kp, vp = jax.device_put(kp, pool_nd), jax.device_put(vp, pool_nd)
-    step = jax.jit(MD.make_decode_step_tp(cfg, bs, mesh))
-    hlo = step.lower(
-        params, kp, vp, jnp.zeros((S,), jnp.int32),
-        jnp.zeros((S,), jnp.int32), jnp.zeros((S, bmax), jnp.int32),
-        jnp.zeros((S,), jnp.bool_)).as_text()
-
-    costs = collective_wire_costs(hlo)
-    assert [c["op"] for c in costs] == ["all_reduce"] * (2 * cfg.n_layers), \
-        [c["op"] for c in costs]
-    act_bytes = S * cfg.dim * 4                  # one [S, D] f32 activation
-    for c in costs:
-        assert c["group_size"] == N, c
-        assert c["operand_bytes"] == act_bytes, c
-        assert c["ring_bytes"] == 2 * (N - 1) / N * act_bytes, c
-    assert not _permutes(hlo)
-
-
-@pytest.mark.parametrize("kind", ["llama", "mixtral"])
-def test_tp_verify_wire_contract(kind):
-    """ISSUE 16: the K-wide verify step keeps the decode wire contract —
-    still EXACTLY two all-reduces per layer, the operand grown to the
-    [S·K, D] window activation (k-fold amortization of the same two
-    fabric crossings, the whole point of one-shot verification), zero
-    collective-permutes, zero resharding."""
-    import dataclasses
-
-    from flax import linen as nn
-    from jax.sharding import NamedSharding
-
-    from horovod_tpu.models import decode as MD
-    from horovod_tpu.parallel import create_mesh
-
-    if kind == "llama":
-        from horovod_tpu.models.llama import Llama, llama_tiny
-        cfg = dataclasses.replace(llama_tiny(), n_heads=8, n_kv_heads=8)
-        model = Llama(cfg)
-    else:
-        from horovod_tpu.models.mixtral import Mixtral, mixtral_tiny
-        cfg = dataclasses.replace(mixtral_tiny(), n_heads=8, n_kv_heads=8,
-                                  capacity_factor=8.0)
-        model = Mixtral(cfg)
-    params = nn.meta.unbox(jax.jit(model.init)(
-        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)))["params"]
-
-    S, K, bs, bmax = 2, 4, 4, 8
-    mesh = create_mesh({"tp": N}, devices=jax.devices()[:N])
-    kp, vp = MD.init_kv_pools(cfg, 16, bs)
-    pool_nd = NamedSharding(mesh, MD.kv_pool_spec())
-    kp, vp = jax.device_put(kp, pool_nd), jax.device_put(vp, pool_nd)
-    step = jax.jit(MD.make_verify_step_tp(cfg, bs, mesh))
-    hlo = step.lower(
-        params, kp, vp, jnp.zeros((S, K), jnp.int32),
-        jnp.zeros((S,), jnp.int32), jnp.zeros((S, bmax), jnp.int32),
-        jnp.zeros((S,), jnp.bool_)).as_text()
-
-    costs = collective_wire_costs(hlo)
-    assert [c["op"] for c in costs] == ["all_reduce"] * (2 * cfg.n_layers), \
-        [c["op"] for c in costs]
-    act_bytes = S * K * cfg.dim * 4          # one [S·K, D] f32 window
-    for c in costs:
-        assert c["group_size"] == N, c
-        assert c["operand_bytes"] == act_bytes, c
-        assert c["ring_bytes"] == 2 * (N - 1) / N * act_bytes, c
-    assert not _permutes(hlo)
+@pytest.mark.parametrize("family", [
+    "adasum-butterfly", "ring-attention", "pipeline-handoff",
+    "decode-tp", "verify-tp", "prefill-tp", "decode-tp8", "verify-tp8",
+])
+def test_wire_contract(family):
+    findings = contracts.check_family(family)
+    assert not findings, "\n".join(f.format() for f in findings)
 
 
 def test_permute_parse_single_pair():
@@ -224,7 +46,8 @@ def test_permute_parse_single_pair():
       source_target_pairs = dense<[[0, 1]]> : tensor<1x2xi64>}> :
       (tensor<4x2xf32>) -> tensor<4x2xf32>
     '''.replace("\n      ", " ")
-    perms = _permutes(hlo)
+    perms = [c for c in collective_wire_costs(hlo)
+             if c["op"] == "collective_permute"]
     assert len(perms) == 1
     assert perms[0]["pairs"] == [[0, 1]]
     assert perms[0]["n_links"] == 1
