@@ -163,6 +163,23 @@ CLAUDE.md "Environment traps"):
   engine's ``broadcast_object`` early-return, where both branches call
   the same collective) carries the pragma.
 
+- ``lint-unverified-peer-blob`` (WARNING): a function that receives
+  bytes from a peer (binds the result of a ``.read()``/``.recv()`` on a
+  network path — the function also calls ``urlopen``/``recv``) and
+  writes those SAME bytes into the content-addressed store with
+  ``put_blob`` while showing no digest-verification evidence anywhere in
+  the function (no ``blob_digest``/``check``/``compare_digest`` call, no
+  ``verify`` name, no ``BlobIntegrityError`` reference).  The store
+  content-addresses what it is GIVEN — ``put_blob`` on corrupt peer
+  bytes mints a valid-looking blob under the corrupt bytes' own digest,
+  and the corruption is only discovered when a LATER reader compares
+  against the manifest digest (or never, if the bad digest is then
+  recorded).  Verify at the fetch seam instead: re-hash the body against
+  the requested digest and raise ``BlobIntegrityError`` on mismatch so
+  the fetcher re-elects a source
+  (``elastic/blobmesh.py::BlobPeerClient.fetch``,
+  docs/checkpointing.md "Peer-sourced resume").
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -276,6 +293,31 @@ def _mentions_draft(node) -> bool:
 # many host-side uses) to keep the rule precise.
 COMMIT_CALL_NAMES = frozenset({"commit"})
 COMMIT_FETCH_NAMES = frozenset({"device_get"})
+
+# lint-unverified-peer-blob vocabulary: the network receive whose result
+# is peer-provided bytes, the receive binding that names them, the store
+# write that must only ever see verified bytes, and the calls/names that
+# count as digest-verification evidence.
+PEER_NET_CALL_NAMES = frozenset({"urlopen", "recv", "recvfrom"})
+PEER_RECV_BIND_NAMES = frozenset({"read", "recv", "recvfrom"})
+BLOB_WRITE_NAMES = frozenset({"put_blob"})
+BLOB_VERIFY_NAMES = frozenset({"blob_digest", "check", "compare_digest"})
+
+
+def _is_blob_verify_evidence(node) -> bool:
+    """True when a subtree shows digest-verification awareness: a verify
+    vocabulary call, any name/attr mentioning 'verify', or a reference to
+    BlobIntegrityError (the raise-on-mismatch pattern)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and _dotted(sub.func).split(".")[-1] in BLOB_VERIFY_NAMES:
+            return True
+        tok = sub.attr if isinstance(sub, ast.Attribute) else (
+            sub.id if isinstance(sub, ast.Name) else None)
+        if tok is not None and ("verify" in tok.lower()
+                                or tok == "BlobIntegrityError"):
+            return True
+    return False
 
 # lint-recompile-in-request-path vocabulary: calls that mark a loop as
 # draining requests (distinctive names count bare; the generic ``get``
@@ -468,6 +510,9 @@ class _Lint(ast.NodeVisitor):
         # lint-rank-conditional-collective: collective call sites already
         # attributed to an enclosing (outermost) rank-conditional.
         self._rank_cond_handled: set = set()
+        # lint-unverified-peer-blob: put_blob sites already attributed to
+        # the smallest enclosing recv-and-store function.
+        self._peer_blob_handled: set = set()
         # lint-late-platform-pin state
         self.sets_jax_platforms_cpu: Optional[int] = None  # line
         self.calls_platform_update = False
@@ -951,8 +996,54 @@ class _Lint(ast.NodeVisitor):
         self._check_unguarded_apply(node)
         self._check_monolithic_psum(node)
         self._check_replicated_kv_pool(node)
+        self._check_unverified_peer_blob(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_unverified_peer_blob(self, node):
+        """lint-unverified-peer-blob: peer-received bytes landed in the
+        blob store without digest verification.  Innermost-first like the
+        other function checks: the smallest enclosing function that both
+        receives and stores owns the finding."""
+        if _is_blob_verify_evidence(node):
+            return
+        recv_bound, has_net = set(), False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                last = _dotted(sub.func).split(".")[-1]
+                if last in PEER_NET_CALL_NAMES:
+                    has_net = True
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call) \
+                    and (_dotted(sub.value.func).split(".")[-1]
+                         in PEER_RECV_BIND_NAMES):
+                recv_bound.update(t.id for t in sub.targets
+                                  if isinstance(t, ast.Name))
+        if not has_net or not recv_bound:
+            return
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and _dotted(sub.func).split(".")[-1] in BLOB_WRITE_NAMES
+                    and id(sub) not in self._peer_blob_handled):
+                continue
+            stored = {n.id for arg in sub.args for n in ast.walk(arg)
+                      if isinstance(n, ast.Name)}
+            if stored & recv_bound:
+                self._peer_blob_handled.add(id(sub))
+                self._add(
+                    "lint-unverified-peer-blob", Severity.WARNING, sub,
+                    "bytes received from a peer are written into the "
+                    "content-addressed store without digest verification: "
+                    "put_blob mints a valid-looking blob under corrupt "
+                    "bytes' OWN digest, deferring (or hiding) the "
+                    "corruption until a later manifest read — re-hash the "
+                    "body against the requested digest at the fetch seam "
+                    "and raise BlobIntegrityError on mismatch so the "
+                    "fetcher re-elects a source (elastic/blobmesh.py::"
+                    "BlobPeerClient.fetch, docs/checkpointing.md "
+                    "'Peer-sourced resume'), or pragma a store whose "
+                    "caller verifiably hashed the bytes already",
+                    {"names": sorted(stored & recv_bound)})
 
     def _check_replicated_kv_pool(self, node):
         """lint-replicated-kv-pool: KV pools allocated in a function that

@@ -1,0 +1,324 @@
+"""Peer blob mesh: fault-tolerant point-to-point blob fetch for resume.
+
+Reference parity (SURVEY.md §3.4): upstream's elastic recovery re-ships
+the WHOLE state from the new rank 0 in one broadcast
+(``horovod/common/elastic.py`` ``State.sync`` — broadcast-on-reset).
+PR 9 turned that into a content-addressed delta fetch but kept the
+single-source shape: ONE owner elected by ``argmax(seqs)`` served the
+union of every rank's missing blobs in one unguarded collective, so an
+owner death, a hung peer, or one corrupt blob mid-resume killed the
+exact recovery path that exists to survive failures.
+
+This module removes the single point of failure:
+
+* **Possession-based election** (:func:`assign_sources`): every rank
+  allgathers which of the needed digests it already possesses; each
+  missing digest is then deterministically assigned an ordered candidate
+  list over its possessors — load spread by a per-(digest, rank) hash,
+  the manifest owner used only as a tie-break — so N fetching ranks do
+  not herd on one source and ANY surviving possessor can serve.
+* **Point-to-point fetch with failover** (:func:`fetch_missing` /
+  :class:`BlobPeerClient`): each rank fetches only ITS OWN missing
+  digests over HTTP from elected peers, riding the coordinator's
+  :class:`~.service.RetryPolicy` (bounded attempts, exponential backoff
+  with decorrelated jitter). A dead source (socket error), a tampered
+  reply (HMAC mismatch) or a corrupt blob
+  (:class:`~..checkpoint.store.BlobIntegrityError` on the verify-at-read
+  re-hash) triggers re-election to the next possessor instead of
+  aborting; bytes are only written into the local store AFTER the
+  content address verified.
+* **Deadline escalation**: the whole resume runs under
+  ``HOROVOD_RESUME_TIMEOUT_SECONDS`` — exhausted sources or a breached
+  deadline raise ``HorovodInternalError`` (the driver relaunches) with a
+  ``resume_failed`` flight-ring record explaining WHY the generation
+  never came up.
+* **Chaos seam**: the serving side counts requests and consults
+  ``testing/faults.py`` (``resume_kill`` / ``resume_corrupt`` /
+  ``resume_delay`` on the ``fetch=`` axis) so every failure mode above
+  is reproducible on demand (tests/test_integration_run.py np=3 chaos
+  tier).
+
+The mesh is resume-scoped: ``elastic/state.py::load_persisted_world``
+starts one :class:`BlobPeerService` per process, exchanges addresses and
+possession sets over the existing engine collectives (whose stall
+watchdog bounds a dead peer out), fetches, barriers, and closes it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import signal as _signal
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from ..checkpoint.store import BlobIntegrityError, blob_digest
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from ..runner import secret as _secret
+from . import constants as C
+
+
+def resume_deadline_s() -> float:
+    """The configured resume deadline (seconds); 0 disables."""
+    try:
+        return max(0.0, float(os.environ.get(
+            C.RESUME_TIMEOUT_ENV, str(C.DEFAULT_RESUME_TIMEOUT_S))))
+    except ValueError:
+        return C.DEFAULT_RESUME_TIMEOUT_S
+
+
+def mesh_key(commit_dir: str) -> bytes:
+    """HMAC key authenticating blob replies: the launcher's secret when
+    this worker was launched by hvdrun (``HOROVOD_SECRET_KEY``), else a
+    key derived from the commit-dir path — identical across ranks (the
+    driver exports one path string to every process) so standalone
+    multi-process worlds still authenticate."""
+    key_s = os.environ.get(_secret.ENV_VAR)
+    if key_s:
+        return _secret.decode(key_s)
+    return hashlib.blake2b(("hvd-blobmesh:" + commit_dir).encode(),
+                           digest_size=32).digest()
+
+
+def advertise_host() -> str:
+    """The address peers reach this process's blob service at: the
+    launcher's host assignment when present (exec_run.py stamps it —
+    loopback multi-host tests depend on the 127.x identity), else the
+    machine hostname."""
+    return os.environ.get("HOROVOD_HOSTNAME") or socket.gethostname()
+
+
+class BlobPeerService:
+    """Per-process HTTP service serving ``GET /blob/<digest>`` from the
+    local :class:`~..checkpoint.store.BlobStore` during a resume window.
+
+    Replies carry an HMAC signature (same ``X-HVD-Sig`` discipline as the
+    coordinator service) so a stray process cannot feed state into a
+    restoring world; the blob itself is additionally content-verified by
+    the fetcher. Each request bumps the serve counter — the ``fetch=``
+    schedule axis of the resume_* chaos faults, applied SERVER-side so
+    the fetching peer exercises its real failure handling."""
+
+    def __init__(self, store, key: bytes, bind_host: str = "0.0.0.0",
+                 rank: Optional[int] = None):
+        self._store = store
+        self._key = key
+        self._rank = rank
+        self._lock = threading.Lock()
+        self._serve_count = 0
+        svc = self
+
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply_bytes(self, body: bytes, code=200):
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("X-HVD-Sig",
+                                     _secret.sign(svc._key, body))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (OSError, ValueError):
+                    pass        # fetcher gave up; its retry loop handles it
+
+            def do_GET(self):
+                if not self.path.startswith("/blob/"):
+                    self._reply_bytes(b"not found", 404)
+                    return
+                digest = self.path[len("/blob/"):]
+                with svc._lock:
+                    n = svc._serve_count
+                    svc._serve_count += 1
+                fault = None
+                if os.environ.get("HOROVOD_FAULT_SPEC"):
+                    from ..testing import faults as _faults
+                    fault = _faults.on_blob_serve(n, svc._rank)
+                if fault is not None and fault.kind == "resume_kill":
+                    get_logger().warning(
+                        "fault: killing self while serving blob %s "
+                        "(serve request %d)", digest[:12], n)
+                    os.kill(os.getpid(), _signal.SIGKILL)
+                if fault is not None and fault.kind == "resume_delay":
+                    time.sleep(float(fault.params.get("seconds", "5.0")))
+                try:
+                    data = svc._store.get_blob(digest)
+                except (BlobIntegrityError, OSError, ValueError) as err:
+                    get_logger().warning(
+                        "blob mesh: cannot serve %s: %s", digest[:12], err)
+                    self._reply_bytes(b"unavailable", 404)
+                    return
+                if fault is not None and fault.kind == "resume_corrupt":
+                    # Garble in flight but SIGN the garbled body: the
+                    # transport looks healthy and only the fetcher's
+                    # content-address re-hash catches it — the nastiest
+                    # corruption class.
+                    data = bytes([data[0] ^ 0xFF]) + data[1:]
+                self._reply_bytes(data)
+
+        self._server = ThreadingHTTPServer((bind_host, 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="hvd-blob-peer", daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def addr(self) -> str:
+        return f"{advertise_host()}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+
+
+class BlobPeerClient:
+    """Single-fetch half: one signed, digest-verified blob GET."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def fetch(self, addr: str, digest: str, timeout_s: float) -> bytes:
+        """Fetch one blob from ``addr``; raises ``OSError`` (dead/refusing
+        source, HTTP error) or :class:`BlobIntegrityError` (tampered or
+        corrupt reply). The returned bytes HAVE been verified against the
+        content address — safe to ``put_blob`` as-is."""
+        url = f"http://{addr}/blob/{digest}"
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            body = r.read()
+            sig = r.headers.get("X-HVD-Sig", "")
+        if not _secret.check(self._key, body, sig):
+            raise BlobIntegrityError(
+                f"blob {digest[:12]} reply from {addr} failed HMAC "
+                "verification")
+        if blob_digest(body) != digest:
+            raise BlobIntegrityError(
+                f"blob {digest[:12]} from {addr} failed content-address "
+                "verification (corrupt source or in-flight corruption)")
+        return body
+
+
+def assign_sources(missing: Iterable[str],
+                   possession: Dict[int, Iterable[str]],
+                   owner: int) -> Dict[str, List[int]]:
+    """Ordered candidate sources for each missing digest.
+
+    Deterministic across ranks (pure function of the allgathered
+    possession sets): candidates are the possessing ranks ordered by a
+    per-(digest, rank) hash so concurrent fetchers spread across
+    possessors instead of herding on one source; the manifest ``owner``
+    wins hash ties (then lowest rank). A digest NO rank possesses maps
+    to ``[]`` — the caller escalates."""
+    have = {r: set(ds) for r, ds in possession.items()}
+
+    def _spread(digest: str, r: int) -> int:
+        return int(hashlib.blake2b(f"{digest}:{r}".encode(),
+                                   digest_size=8).hexdigest(), 16)
+
+    out: Dict[str, List[int]] = {}
+    for digest in missing:
+        possessors = [r for r, ds in have.items() if digest in ds]
+        out[digest] = sorted(
+            possessors,
+            key=lambda r: (_spread(digest, r), r != owner, r))
+    return out
+
+
+def _resume_failed(reason: str, **fields: Any):
+    """Land the why in the flight ring (incident_*.json) and return the
+    error to raise — a generation that never comes up must leave a
+    record, not just a hung collective."""
+    from ..core.exceptions import HorovodInternalError
+    _telemetry.inc("hvd_resume_failures_total")
+    _telemetry.record_event("resume_failed", reason=reason, **fields)
+    get_logger().error("peer-sourced resume failed: %s %s", reason, fields)
+    return HorovodInternalError(f"peer-sourced resume failed: {reason}")
+
+
+def fetch_missing(store, missing: List[str],
+                  sources: Dict[str, List[int]],
+                  addrs: Dict[int, str], key: bytes,
+                  policy=None,
+                  deadline: Optional[float] = None,
+                  clock: Callable[[], float] = time.monotonic,
+                  sleep: Callable[[float], None] = time.sleep,
+                  rng=None) -> Dict[str, Any]:
+    """Fetch every digest in ``missing`` point-to-point and write the
+    verified bytes into ``store``. Per digest: walk the elected candidate
+    order (re-election on dead/corrupt source), then sleep one backoff
+    and walk again, up to the policy's attempt budget — all under
+    ``deadline`` (absolute ``clock()`` time; None = unbounded). Raises
+    ``HorovodInternalError`` on exhausted sources or a breached deadline.
+    Returns per-rank byte/source accounting."""
+    from .service import RetryPolicy
+    policy = policy or RetryPolicy.for_resume()
+    client = BlobPeerClient(key)
+    stats: Dict[str, Any] = {"blobs_fetched": 0, "bytes_fetched": 0,
+                             "retries": 0, "sources": {}}
+
+    def _remaining() -> Optional[float]:
+        if deadline is None:
+            return None
+        left = deadline - clock()
+        if left <= 0:
+            raise _resume_failed(
+                "deadline exceeded",
+                deadline_s=round(deadline, 3),
+                fetched=stats["blobs_fetched"], missing=len(missing))
+        return left
+
+    for digest in missing:
+        cands = sources.get(digest) or []
+        if not cands:
+            raise _resume_failed("no surviving possessor", digest=digest)
+        data = None
+        delays = policy.delays(rng)
+        while data is None:
+            for r in cands:
+                left = _remaining()
+                timeout = policy.timeout_s if left is None \
+                    else max(0.001, min(policy.timeout_s, left))
+                try:
+                    data = client.fetch(addrs[r], digest, timeout_s=timeout)
+                    src = r
+                    break
+                except (OSError, BlobIntegrityError, KeyError) as err:
+                    stats["retries"] += 1
+                    _telemetry.inc("hvd_resume_retries_total")
+                    get_logger().warning(
+                        "blob mesh: fetch of %s from rank %s failed (%s) "
+                        "— re-electing next possessor", digest[:12], r, err)
+            if data is None:
+                pause = next(delays, None)
+                if pause is None:
+                    raise _resume_failed(
+                        "sources exhausted", digest=digest,
+                        candidates=list(cands),
+                        retries=stats["retries"])
+                left = _remaining()
+                if left is not None and pause > left:
+                    raise _resume_failed(
+                        "deadline exceeded in backoff", digest=digest,
+                        retries=stats["retries"])
+                sleep(pause)
+        store.put_blob(data)
+        stats["blobs_fetched"] += 1
+        stats["bytes_fetched"] += len(data)
+        stats["sources"][src] = stats["sources"].get(src, 0) + 1
+        _telemetry.inc("hvd_resume_bytes_fetched", float(len(data)))
+        _telemetry.inc("hvd_resume_sources", source=str(src))
+    return stats

@@ -135,6 +135,27 @@ COORD_LOST_TIMEOUT_ENV = "HOROVOD_COORDINATOR_LOST_TIMEOUT_SECONDS"
 #: polling for the rest of the stall window.
 DEFAULT_COORD_LOST_TIMEOUT_S = 120.0
 
+#: env: overall deadline (seconds) of a peer-sourced resume
+#: (elastic/state.py load_persisted_world → elastic/blobmesh.py): source
+#: election, every point-to-point blob fetch including retries and
+#: re-elections, and the final completion barrier must all land inside
+#: it, else the resume escalates to HorovodInternalError and the driver
+#: relaunches the generation — a dead peer mid-resume must not hang the
+#: recovery path that exists to survive dead peers. 0 disables.
+RESUME_TIMEOUT_ENV = "HOROVOD_RESUME_TIMEOUT_SECONDS"
+
+#: Default resume deadline. Sized to cover a multi-GB delta fetch over a
+#: pod interconnect plus the full retry envelope of one failed source
+#: (attempts x backoff cap), but far below the stall-shutdown ceiling so
+#: a wedged resume turns into a relaunch, not a stall-window wait.
+DEFAULT_RESUME_TIMEOUT_S = 120.0
+
+#: env: per-attempt deadline (seconds) of one peer blob fetch during
+#: resume. Larger than the coordinator RPC timeout — a blob can be a
+#: whole model shard, not a JSON world view.
+RESUME_FETCH_TIMEOUT_ENV = "HOROVOD_RESUME_FETCH_TIMEOUT_SECONDS"
+DEFAULT_RESUME_FETCH_TIMEOUT_S = 30.0
+
 #: env: RPC attempts per logical coordinator call (>=1; 1 = no retry).
 RPC_RETRIES_ENV = "HOROVOD_COORDINATOR_RPC_RETRIES"
 DEFAULT_RPC_RETRIES = 3

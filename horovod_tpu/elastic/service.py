@@ -158,6 +158,21 @@ class RetryPolicy:
                                       C.DEFAULT_RPC_BACKOFF_BASE_S),
         )
 
+    @classmethod
+    def for_resume(cls) -> "RetryPolicy":
+        """The peer-blob-fetch variant (elastic/blobmesh.py): same
+        attempt/backoff schedule as coordinator RPCs, but a per-attempt
+        deadline sized for shipping blobs (a whole model shard), not a
+        JSON world view (``HOROVOD_RESUME_FETCH_TIMEOUT_SECONDS``)."""
+        return cls(
+            attempts=max(1, _env_int(C.RPC_RETRIES_ENV,
+                                     C.DEFAULT_RPC_RETRIES)),
+            timeout_s=_env_float(C.RESUME_FETCH_TIMEOUT_ENV,
+                                 C.DEFAULT_RESUME_FETCH_TIMEOUT_S),
+            backoff_base_s=_env_float(C.RPC_BACKOFF_BASE_ENV,
+                                      C.DEFAULT_RPC_BACKOFF_BASE_S),
+        )
+
     def delays(self, rng: Optional[random.Random] = None) -> Iterator[float]:
         """The ``attempts - 1`` sleeps between attempts. Deterministic
         under an injected seeded ``rng`` (the fake-clock unit tests);

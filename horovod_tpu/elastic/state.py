@@ -633,20 +633,100 @@ class _CommitWriter:
         _fire_commit_hooks(self.commit_dir, int(job["seq"]))
 
 
-def _unpack_manifest(store, manifest: Dict[str, Any]) -> Dict[str, Any]:
-    """Materialize a payload from a manifest. Every blob read re-hashes
-    against its content address (verify-at-restore); a mismatch raises
-    ``BlobIntegrityError`` upward and the caller walks to an older
-    manifest."""
+def _path_name(entry) -> str:
+    """One jax tree-path entry as a plain name (DictKey.key /
+    GetAttrKey.name / SequenceKey.idx) — the shared leaf-keying scheme of
+    the per-shard CAS layer (serving/publisher.py writes ``shards`` with
+    it; the registry and the resume path select with it)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _select_parts(manifest: Dict[str, Any], digest: str, names: tuple,
+                  shard_selector) -> Optional[List[int]]:
+    """Part indices of leaf ``digest`` the target sharding wants, or None
+    for the whole-leaf blob (no shards entry, no selector, or the
+    selector declined — e.g. the manifest was sharded for a DIFFERENT
+    topology and read-compatibility demands the whole-leaf fallback)."""
+    if shard_selector is None:
+        return None
+    meta = (manifest.get("shards") or {}).get(digest)
+    if meta is None:
+        return None
+    sel = shard_selector(names, meta)
+    if sel is None:
+        return None
+    return [int(i) for i in sel] or None
+
+
+def _manifest_need(store, manifest: Dict[str, Any],
+                   shard_selector=None) -> List[str]:
+    """The digests THIS rank must hold to materialize the manifest under
+    ``shard_selector`` — whole-leaf blobs by default; for a shard-selected
+    leaf only the selected PART blobs (the topology-change delta: a
+    resharded target pulls its slices, never the whole tensor). Requires
+    the skeleton blob to be local (fetch it first)."""
     skeleton = pickle.loads(store.get_blob(manifest["skeleton"]))
-    refs, treedef = jax.tree_util.tree_flatten(skeleton)
+    flat, _ = jax.tree_util.tree_flatten_with_path(skeleton)
     entries = manifest["leaves"]
-    leaves = []
-    for ref in refs:
+    need: List[str] = [manifest["skeleton"]]
+    for path, ref in flat:
         if not isinstance(ref, _LeafRef):
             raise ValueError("manifest skeleton holds a non-ref leaf "
                              f"({type(ref).__name__})")
-        leaves.append(pickle.loads(store.get_blob(entries[ref.index][0])))
+        digest = entries[ref.index][0]
+        names = tuple(_path_name(p) for p in path)
+        sel = _select_parts(manifest, digest, names, shard_selector)
+        if sel is None:
+            need.append(digest)
+        else:
+            meta = manifest["shards"][digest]
+            need.extend(meta["parts"][i][0] for i in sel)
+    return list(dict.fromkeys(need))
+
+
+def _unpack_manifest(store, manifest: Dict[str, Any],
+                     shard_selector=None) -> Dict[str, Any]:
+    """Materialize a payload from a manifest. Every blob read re-hashes
+    against its content address (verify-at-restore); a mismatch raises
+    ``BlobIntegrityError`` upward and the caller walks to an older
+    manifest. With ``shard_selector`` (topology-change restore), a leaf
+    with a manifest ``shards`` entry the selector claims is assembled
+    from its selected PART blobs (concatenated along the shard axis,
+    mirroring serving/registry.py ``_materialize``) instead of the
+    whole-leaf blob."""
+    skeleton = pickle.loads(store.get_blob(manifest["skeleton"]))
+    if shard_selector is None:
+        refs, treedef = jax.tree_util.tree_flatten(skeleton)
+        entries = manifest["leaves"]
+        leaves = []
+        for ref in refs:
+            if not isinstance(ref, _LeafRef):
+                raise ValueError("manifest skeleton holds a non-ref leaf "
+                                 f"({type(ref).__name__})")
+            leaves.append(pickle.loads(store.get_blob(entries[ref.index][0])))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    import numpy as np
+    flat, treedef = jax.tree_util.tree_flatten_with_path(skeleton)
+    entries = manifest["leaves"]
+    leaves = []
+    for path, ref in flat:
+        if not isinstance(ref, _LeafRef):
+            raise ValueError("manifest skeleton holds a non-ref leaf "
+                             f"({type(ref).__name__})")
+        digest = entries[ref.index][0]
+        names = tuple(_path_name(p) for p in path)
+        sel = _select_parts(manifest, digest, names, shard_selector)
+        if sel is None:
+            leaves.append(pickle.loads(store.get_blob(digest)))
+            continue
+        meta = manifest["shards"][digest]
+        parts = [np.asarray(pickle.loads(
+            store.get_blob(meta["parts"][i][0]))) for i in sel]
+        leaves.append(parts[0] if len(parts) == 1 else np.concatenate(
+            parts, axis=int(meta.get("axis", 0))))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -723,25 +803,54 @@ def load_persisted(commit_dir: str) -> Optional[Dict[str, Any]]:
     return None if local is None else local["payload"]
 
 
-def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:
+#: Per-rank accounting of the last peer-sourced resume (bytes fetched,
+#: retries, per-source blob counts, topology delta) — chaos workers and
+#: the byte-accounting tests read it after ``load_latest``.
+_LAST_RESUME_STATS: Dict[str, Any] = {}
+
+
+def last_resume_stats() -> Dict[str, Any]:
+    """Accounting of this process's most recent ``load_persisted_world``
+    peer fetch (empty before the first resume)."""
+    return dict(_LAST_RESUME_STATS)
+
+
+def load_persisted_world(commit_dir: str,
+                         shard_selector=None) -> Optional[Dict[str, Any]]:
     """The newest persisted commit across ALL processes of the (re)launched
     world. A relaunched generation may have a different process 0 whose
     disk never saw a commit (lost-host recovery); every process reports its
     local commit sequence number and the highest one wins.
 
-    Content-addressed fast resume: the winning rank ships only its small
-    MANIFEST; every rank then materializes leaves from its LOCAL blob
-    store (shared disks and peer-identical content make most blobs local
-    hits) and only the union of genuinely missing blobs moves — fetched
-    from the surviving owner's store in one broadcast. Legacy
-    single-frame owners fall back to the upstream-style whole-payload
-    broadcast-on-reset."""
+    Fault-tolerant peer-sourced resume (elastic/blobmesh.py): the winning
+    rank ships only its small MANIFEST; every rank then materializes
+    leaves from its LOCAL blob store (shared disks and peer-identical
+    content make most blobs local hits) and fetches ONLY ITS OWN missing
+    digests point-to-point from digest-elected peers — sources spread
+    across every rank that possesses a blob (the former single owner is
+    just a tie-break), with retry/backoff, re-election away from dead or
+    corrupt sources, and the whole resume bounded by
+    ``HOROVOD_RESUME_TIMEOUT_SECONDS``. With ``shard_selector``
+    (topology-change restore — regrown process count, reshaped tp), a
+    leaf carried in the manifest ``shards`` map moves as the selected
+    PART blobs only; mismatched plans fall back to the whole-leaf blob.
+    Legacy single-frame owners fall back to the upstream-style
+    whole-payload broadcast-on-reset."""
     local = _load_local_commit(commit_dir) if commit_dir else None
     if jax.process_count() == 1:
-        return None if local is None else local["payload"]
+        if local is None:
+            return None
+        if shard_selector is not None and local["manifest"] is not None:
+            return _unpack_manifest(_cas_store(commit_dir),
+                                    local["manifest"], shard_selector)
+        return local["payload"]
     import numpy as np
     from jax.experimental import multihost_utils
     from ..optimizer.functions import allgather_object, broadcast_object
+    from . import blobmesh as _mesh
+    t_start = time.monotonic()
+    deadline_s = _mesh.resume_deadline_s()
+    deadline = None if deadline_s <= 0 else t_start + deadline_s
     seq = -1 if local is None else int(local["seq"])
     seqs = multihost_utils.process_allgather(np.asarray([seq], np.int64))
     seqs = np.asarray(seqs).reshape(-1)
@@ -762,22 +871,75 @@ def load_persisted_world(commit_dir: str) -> Optional[Dict[str, Any]]:
         return broadcast_object(
             None if local is None else local["payload"], root_rank=owner)
     store = _cas_store(commit_dir)
-    needed = [manifest["skeleton"]] + [e[0] for e in manifest["leaves"]]
-    needed = list(dict.fromkeys(needed))
-    missing = [d for d in needed if not store.has_blob(d)]
-    union = sorted(set().union(*[set(m) for m in allgather_object(missing)]))
-    if union:
-        blobs = broadcast_object(
-            {d: store.get_blob(d) for d in union} if me == owner else None,
-            root_rank=owner)
-        for digest, data in (blobs or {}).items():
-            if not store.has_blob(digest):
-                store.put_blob(data)
+    topo = manifest.get("topology") or {}
+    topo_np = int(topo.get("process_count", 0) or 0)
+    if topo_np and topo_np != jax.process_count():
+        get_logger().info(
+            "topology-change restore: manifest seq=%s committed by a "
+            "%d-process world, restoring into %d processes",
+            manifest.get("seq"), topo_np, jax.process_count())
+    # Any digest the manifest can reference, selector-independent — the
+    # possession exchange covers the superset so election never needs a
+    # second collective round once the skeleton lands.
+    all_digests = [manifest["skeleton"]] + [e[0] for e in manifest["leaves"]]
+    for meta in (manifest.get("shards") or {}).values():
+        all_digests.extend(p[0] for p in meta["parts"])
+    all_digests = list(dict.fromkeys(all_digests))
+    possessed = [d for d in all_digests if store.has_blob(d)]
+    key = _mesh.mesh_key(commit_dir)
+    service = _mesh.BlobPeerService(store, key, rank=me)
+    stats: Dict[str, Any] = {"blobs_fetched": 0, "bytes_fetched": 0,
+                             "retries": 0, "sources": {},
+                             "topology_from": topo_np or None,
+                             "shard_selected": 0, "whole_leaf": 0}
+    try:
+        world = allgather_object({"rank": me, "addr": service.addr,
+                                  "possess": possessed})
+        possession = {int(w["rank"]): set(w["possess"]) for w in world}
+        addrs = {int(w["rank"]): w["addr"] for w in world}
+        # The skeleton names the leaves; without it the selector cannot
+        # run — fetch it first if missing (tiny blob, same failover).
+        if not store.has_blob(manifest["skeleton"]):
+            skel = [manifest["skeleton"]]
+            s = _mesh.fetch_missing(
+                store, skel, _mesh.assign_sources(skel, possession, owner),
+                addrs, key, deadline=deadline)
+            for k in ("blobs_fetched", "bytes_fetched", "retries"):
+                stats[k] += s[k]
+            for r, n in s["sources"].items():
+                stats["sources"][r] = stats["sources"].get(r, 0) + n
+        needed = _manifest_need(store, manifest, shard_selector)
+        missing = [d for d in needed if not store.has_blob(d)]
+        s = _mesh.fetch_missing(
+            store, missing, _mesh.assign_sources(missing, possession, owner),
+            addrs, key, deadline=deadline)
+        for k in ("blobs_fetched", "bytes_fetched", "retries"):
+            stats[k] += s[k]
+        for r, n in s["sources"].items():
+            stats["sources"][r] = stats["sources"].get(r, 0) + n
+        # Completion barrier: keep every peer's service up until ALL
+        # ranks finished fetching (a dead peer bounds out through the
+        # engine's stall watchdog, not a hang).
+        allgather_object({"rank": me, "done": True})
+    finally:
+        service.close()
+    whole = set(e[0] for e in manifest["leaves"])
+    stats["shard_selected"] = sum(1 for d in needed
+                                  if d not in whole
+                                  and d != manifest["skeleton"])
+    stats["whole_leaf"] = sum(1 for d in needed if d in whole)
+    stats["blobs_needed"] = len(needed)
+    stats["blobs_missing"] = len(missing)
+    _LAST_RESUME_STATS.clear()
+    _LAST_RESUME_STATS.update(stats)
     _telemetry.record_event(
         "resume_fetch", manifest_seq=int(manifest["seq"]),
         blobs_total=len(needed), blobs_missing=len(missing),
-        blobs_union=len(union))
-    return _unpack_manifest(store, manifest)
+        bytes_fetched=stats["bytes_fetched"], retries=stats["retries"],
+        sources=len(stats["sources"]),
+        topology_from=topo_np or jax.process_count(),
+        topology_to=jax.process_count())
+    return _unpack_manifest(store, manifest, shard_selector)
 
 
 class _CommitterMixin:
@@ -895,13 +1057,16 @@ class FrameworkState(_CommitterMixin, State):
             self._framework_restore(self._saved_fw)
         self._scalars = dict(self._saved_scalars)
 
-    def load_latest(self) -> bool:
+    def load_latest(self, shard_selector=None) -> bool:
         """Adopt the newest persisted commit across the (re)launched
-        world; returns True if one was found."""
+        world; returns True if one was found. ``shard_selector`` (see
+        ``load_persisted_world``) enables topology-change restore via the
+        manifest ``shards`` map."""
         if not self._commit_dir:
             return False
         t0 = time.perf_counter()
-        payload = load_persisted_world(self._commit_dir)
+        payload = load_persisted_world(self._commit_dir,
+                                       shard_selector=shard_selector)
         if payload is None:
             return False
         self._commit_seq = int(payload.get("seq", 0))
@@ -991,14 +1156,17 @@ class ObjectState(_CommitterMixin, State):
             setattr(self, k, copy.deepcopy(v) if not isinstance(v, jax.Array)
                     else v)
 
-    def load_latest(self) -> bool:
+    def load_latest(self, shard_selector=None) -> bool:
         """Adopt the newest persisted commit across the world (process-
         restart resume; survives losing the former process 0's disk).
-        Returns True if one was found."""
+        Returns True if one was found. ``shard_selector`` (see
+        ``load_persisted_world``) enables topology-change restore via the
+        manifest ``shards`` map."""
         if not self._commit_dir:
             return False
         t0 = time.perf_counter()
-        payload = load_persisted_world(self._commit_dir)
+        payload = load_persisted_world(self._commit_dir,
+                                       shard_selector=shard_selector)
         if payload is None:
             return False
         self._commit_seq = int(payload.get("seq", 0))

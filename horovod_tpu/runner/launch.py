@@ -99,7 +99,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "e.g. 'kill:rank=1,step=3'; control-plane kinds "
                         "rpc_drop/rpc_delay/rpc_refuse/rpc_garble/"
                         "rpc_badsig schedule on the coordinator RPC "
-                        "attempt counter, e.g. 'rpc_refuse:rank=0,call=2')")
+                        "attempt counter, e.g. 'rpc_refuse:rank=0,call=2'; "
+                        "resume-path kinds resume_kill/resume_corrupt/"
+                        "resume_delay schedule on the blob peer service's "
+                        "serve counter, e.g. 'resume_kill:rank=1,fetch=0')")
     p.add_argument("--coordinator-lost-timeout-seconds", type=float,
                    dest="coordinator_lost_timeout_seconds",
                    help="seconds of continuous coordinator-RPC failure "

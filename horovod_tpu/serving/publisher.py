@@ -42,19 +42,9 @@ from ..checkpoint.store import BLOB_DIGEST_SIZE, BlobIntegrityError
 from ..core import telemetry as _telemetry
 from ..core.logging import get_logger
 from ..core import sentinel as _sentinel
-from ..elastic.state import _CAS_SUBDIR, _cas_store, register_commit_hook, \
-    unregister_commit_hook
+from ..elastic.state import _CAS_SUBDIR, _cas_store, _path_name, \
+    register_commit_hook, unregister_commit_hook
 from . import constants as SC
-
-
-def _path_name(entry) -> str:
-    """One jax tree-path entry as a plain name (DictKey.key /
-    GetAttrKey.name / SequenceKey.idx), shared with the registry so both
-    ends of the per-shard layer key leaves identically."""
-    for attr in ("key", "name", "idx"):
-        if hasattr(entry, attr):
-            return str(getattr(entry, attr))
-    return str(entry)
 
 
 def leaves_digest(manifest: Dict) -> str:

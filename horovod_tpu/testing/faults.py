@@ -40,6 +40,18 @@ Spec grammar (``HOROVOD_FAULT_SPEC``)::
                                                  (fails HMAC verification)
                rpc_badsig  call=<int>            response signature replaced
                                                  (body intact, HMAC fails)
+    resume kinds (peer blob mesh; schedule on fetch=<int>, the blob peer
+    SERVICE's request counter — elastic/blobmesh.py applies them on the
+    SOURCE side of a peer-sourced resume fetch):
+               resume_kill    fetch=<int>        SIGKILL the elected blob
+                                                 source mid-fetch
+               resume_corrupt fetch=<int>        served blob corrupted in
+                                                 flight (fails the digest
+                                                 verify-at-read; the
+                                                 fetcher re-elects)
+               resume_delay   fetch=<int> [seconds=<float>]  stall one
+                                                 serve past the resume
+                                                 deadline
 
 Examples::
 
@@ -50,6 +62,8 @@ Examples::
     corrupt:rank=0,step=4,path=/tmp/commits # truncate newest commit
     rpc_refuse:rank=0,call=2                # 3rd coordinator RPC refused
     rpc_badsig:call=0                       # first reply arrives tampered
+    resume_kill:rank=1,fetch=0              # kill rank 1 serving its 1st blob
+    resume_corrupt:fetch=1                  # 2nd served blob garbled in flight
 
 One-shot semantics: each fault fires at most once per PROCESS LIFETIME
 GENERATION — a marker file in ``HOROVOD_FAULT_MARKER_DIR`` (default: the
@@ -94,8 +108,13 @@ FAULT_MARKER_DIR_ENV = "HOROVOD_FAULT_MARKER_DIR"
 _RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_refuse", "rpc_garble",
               "rpc_badsig")
 
+#: resume_* kinds fire at the blob-peer-service seam (elastic/blobmesh.py),
+#: scheduled on the SOURCE's blob-serve request counter (``fetch=``) — the
+#: resume-path analog of the coordinator-RPC axis.
+_RESUME_KINDS = ("resume_kill", "resume_corrupt", "resume_delay")
+
 _KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan",
-          "desync", "torn") + _RPC_KINDS
+          "desync", "torn") + _RPC_KINDS + _RESUME_KINDS
 
 
 @dataclass
@@ -105,6 +124,7 @@ class Fault:
     step: Optional[int] = None
     round: Optional[int] = None
     call: Optional[int] = None
+    fetch: Optional[int] = None
     params: Dict[str, str] = field(default_factory=dict)
     index: int = 0
 
@@ -113,18 +133,19 @@ class Fault:
         """Does this fault fire for (rank, count)? ``counter`` selects
         which schedule axis applies: "step" faults only match on_step
         calls; "round" faults only match engine rounds; "call" faults
-        only match coordinator RPC attempts."""
+        only match coordinator RPC attempts; "fetch" faults only match
+        blob-serve requests."""
         if self.rank is not None and rank is not None and self.rank != rank:
             return False
         want = {"step": self.step, "round": self.round,
-                "call": self.call}[counter]
+                "call": self.call, "fetch": self.fetch}[counter]
         if want is None:
             # A kind with no schedule on this axis never fires on it.
             return False
         return count == want
 
     def _sched(self) -> "int | None":
-        for v in (self.step, self.round, self.call):
+        for v in (self.step, self.round, self.call, self.fetch):
             if v is not None:
                 return v
         return None
@@ -168,6 +189,8 @@ class FaultSpec:
                     f.round = int(v)
                 elif k == "call":
                     f.call = int(v)
+                elif k == "fetch":
+                    f.fetch = int(v)
                 else:
                     f.params[k] = v
             if kind in ("delay", "drop") and f.round is None and \
@@ -180,6 +203,11 @@ class FaultSpec:
                     raise ValueError(f"fault {part!r} needs call=<int> "
                                      "(rpc faults schedule on the "
                                      "coordinator-RPC attempt counter)")
+            elif kind in _RESUME_KINDS:
+                if f.fetch is None:
+                    raise ValueError(f"fault {part!r} needs fetch=<int> "
+                                     "(resume faults schedule on the blob "
+                                     "peer service's request counter)")
             elif kind in ("delay", "drop"):
                 if f.round is None:
                     raise ValueError(f"fault {part!r} needs round=<int>")
@@ -239,6 +267,8 @@ class FaultHarness:
         wall-clock coordination."""
         if kind in _RPC_KINDS:
             counter = "call"
+        elif kind in _RESUME_KINDS:
+            counter = "fetch"
         elif kind in ("delay", "drop"):
             counter = "round"
         else:
@@ -393,6 +423,28 @@ class FaultHarness:
             return f
         return None
 
+    # -- blob-serve-axis faults (peer-sourced resume) ----------------------
+
+    def on_blob_serve(self, fetch: int,
+                      rank: Optional[int] = None) -> Optional[Fault]:
+        """Blob-peer-service hook (elastic/blobmesh.py): returns the armed
+        resume_* fault for this (rank, serve-request counter) — marking it
+        fired — or None. Mirrors :meth:`on_rpc_call`: the SERVICE applies
+        the action (kill self / garble the reply / stall) so the fetching
+        peer exercises its real failure handling — retry, re-election to
+        the next possessor, deadline escalation."""
+        rank = rank if rank is not None else _env_rank()
+        for f in self.spec.faults:
+            if f.kind not in _RESUME_KINDS:
+                continue
+            if not f.matches(rank, fetch, "fetch") or self._fired(f):
+                continue
+            self._mark_fired(f)
+            get_logger().warning("fault: %s on blob serve request %d "
+                                 "(rank=%s)", f.kind, fetch, rank)
+            return f
+        return None
+
     # -- engine-round-axis faults ------------------------------------------
 
     def before_engine_round(self, what: str = "") -> None:
@@ -489,3 +541,11 @@ def on_rpc_call(call: int, rank: Optional[int] = None) -> Optional[Fault]:
     """Module-level convenience for the coordinator-client fault seam."""
     h = fault_harness()
     return None if h is None else h.on_rpc_call(call, rank)
+
+
+def on_blob_serve(fetch: int,
+                  rank: Optional[int] = None) -> Optional[Fault]:
+    """Module-level convenience for the blob-peer-service fault seam
+    (elastic/blobmesh.py ``BlobPeerService``)."""
+    h = fault_harness()
+    return None if h is None else h.on_blob_serve(fetch, rank)

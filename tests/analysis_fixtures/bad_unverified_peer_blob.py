@@ -1,0 +1,34 @@
+"""lint-unverified-peer-blob fixture: a resume fetcher that reads a
+blob body off the wire and hands it straight to ``put_blob`` — the
+store content-addresses the corrupt bytes under their OWN digest, so
+the corruption surfaces only at a later manifest read (or never).
+Exactly ONE finding: the verified fetcher and the local repack below
+must stay clean.
+"""
+from urllib.request import urlopen
+
+
+def fetch_blob_unverified(store, addr, digest):
+    with urlopen(f"http://{addr}/blob/{digest}", timeout=5) as resp:
+        data = resp.read()
+    store.put_blob(data)  # <- lint-unverified-peer-blob
+    return data
+
+
+def fetch_blob_verified(store, addr, digest, blob_digest):
+    # Clean: the body is re-hashed against the requested digest before
+    # it can land in the store (elastic/blobmesh.py::BlobPeerClient.fetch).
+    with urlopen(f"http://{addr}/blob/{digest}", timeout=5) as resp:
+        data = resp.read()
+    if blob_digest(data) != digest:
+        raise ValueError(f"peer blob {digest} failed verification")
+    store.put_blob(data)
+    return data
+
+
+def repack_local(store, path):
+    # Clean: locally-produced bytes — no peer in the loop, the store's
+    # own hashing IS the authority for what the digest should be.
+    with open(path, "rb") as fh:
+        data = fh.read()
+    return store.put_blob(data)

@@ -223,6 +223,7 @@ LINT_CASES = [
     ("bad_replicated_kv_pool.py", "lint-replicated-kv-pool", "warning"),
     ("bad_rank_conditional_collective.py",
      "lint-rank-conditional-collective", "error"),
+    ("bad_unverified_peer_blob.py", "lint-unverified-peer-blob", "warning"),
 ]
 
 

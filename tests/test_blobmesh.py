@@ -1,0 +1,274 @@
+"""Peer blob mesh unit tests (horovod_tpu/elastic/blobmesh.py): the
+signed blob service/client pair, possession-based source election, and
+the fetch loop's failover / deadline / escalation semantics — all
+single-process with real HTTP over loopback. The np=3 cross-process
+chaos tier lives in tests/test_integration_run.py."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from horovod_tpu.checkpoint.store import (BlobIntegrityError, BlobStore,
+                                          blob_digest)
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.elastic import blobmesh
+from horovod_tpu.elastic.service import RetryPolicy
+
+KEY = b"k" * 32
+
+#: nothing listens here — connection refused, instantly (loopback).
+DEAD_ADDR = "127.0.0.1:9"
+
+
+def _store_with(tmp_path, name, blobs):
+    store = BlobStore(str(tmp_path / name))
+    return store, [store.put_blob(b)[0] for b in blobs]
+
+
+@pytest.fixture
+def service(tmp_path):
+    """One serving store with three blobs + a fetching (empty) store."""
+    src, digests = _store_with(tmp_path, "src",
+                               [b"alpha" * 40, b"beta" * 30, b"gamma" * 20])
+    svc = blobmesh.BlobPeerService(src, KEY, bind_host="127.0.0.1", rank=0)
+    dst = BlobStore(str(tmp_path / "dst"))
+    yield svc, src, dst, digests
+    svc.close()
+
+
+def _addr(svc) -> str:
+    # Loopback, not advertise_host(): these tests must not depend on the
+    # machine hostname resolving.
+    return f"127.0.0.1:{svc.port}"
+
+
+# -- service/client pair -----------------------------------------------------
+
+def test_fetch_roundtrip_verified(service):
+    svc, src, _dst, digests = service
+    client = blobmesh.BlobPeerClient(KEY)
+    for d in digests:
+        body = client.fetch(_addr(svc), d, timeout_s=5)
+        assert blob_digest(body) == d
+        assert body == src.get_blob(d)
+
+
+def test_fetch_unknown_blob_is_oserror(service):
+    svc, _src, _dst, _digests = service
+    client = blobmesh.BlobPeerClient(KEY)
+    with pytest.raises(OSError):        # HTTP 404 → HTTPError (an OSError)
+        client.fetch(_addr(svc), "0" * 32, timeout_s=5)
+    with pytest.raises(OSError):
+        client.fetch(DEAD_ADDR, "0" * 32, timeout_s=1)
+
+
+def test_fetch_rejects_wrong_hmac_key(service):
+    """A reply signed with a different secret is not state this world may
+    adopt — BlobIntegrityError, same failover class as corruption."""
+    svc, _src, _dst, digests = service
+    stranger = blobmesh.BlobPeerClient(b"x" * 32)
+    with pytest.raises(BlobIntegrityError):
+        stranger.fetch(_addr(svc), digests[0], timeout_s=5)
+
+
+def test_service_refuses_unservable_blob(service, tmp_path):
+    """A source whose own blob fails verify-at-read serves 404 (OSError
+    at the client) — never corrupt bytes with a valid signature."""
+    svc, src, _dst, digests = service
+    with open(src.blob_path(digests[0]), "r+b") as fh:
+        fh.seek(1)
+        fh.write(b"\xff")
+    client = blobmesh.BlobPeerClient(KEY)
+    with pytest.raises(OSError):
+        client.fetch(_addr(svc), digests[0], timeout_s=5)
+
+
+# -- source election ---------------------------------------------------------
+
+def test_assign_sources_deterministic_and_complete():
+    missing = [blob_digest(bytes([i]) * 10) for i in range(24)]
+    possession = {0: set(missing), 1: set(missing[:12]), 2: set()}
+    out = blobmesh.assign_sources(missing, possession, owner=0)
+    assert out == blobmesh.assign_sources(missing, possession, owner=0)
+    for d in missing[:12]:
+        assert sorted(out[d]) == [0, 1]     # every possessor is a candidate
+    for d in missing[12:]:
+        assert out[d] == [0]
+    assert 2 not in {r for c in out.values() for r in c}
+
+
+def test_assign_sources_spreads_load_across_possessors():
+    missing = [blob_digest(bytes([i]) * 10) for i in range(32)]
+    possession = {r: set(missing) for r in range(3)}
+    out = blobmesh.assign_sources(missing, possession, owner=0)
+    first = [c[0] for c in out.values()]
+    # Per-(digest, rank) hash ordering: the primary source must not herd
+    # on one rank (the pre-mesh design's single owner).
+    assert len(set(first)) >= 2, first
+
+
+def test_assign_sources_no_possessor_is_empty():
+    out = blobmesh.assign_sources(["ab" * 16], {0: set(), 1: set()}, owner=0)
+    assert out == {"ab" * 16: []}
+
+
+# -- fetch loop --------------------------------------------------------------
+
+def test_fetch_missing_happy_path_stats(service):
+    svc, src, dst, digests = service
+    sources = {d: [0] for d in digests}
+    stats = blobmesh.fetch_missing(dst, digests, sources, {0: _addr(svc)},
+                                   KEY)
+    assert stats["blobs_fetched"] == 3 and stats["retries"] == 0
+    assert stats["sources"] == {0: 3}
+    assert stats["bytes_fetched"] == sum(
+        len(src.get_blob(d)) for d in digests)
+    for d in digests:           # landed verified in the local store
+        assert dst.get_blob(d, verify=True) == src.get_blob(d)
+
+
+def test_fetch_missing_fails_over_from_dead_source(service):
+    svc, _src, dst, digests = service
+    sources = {d: [1, 0] for d in digests}      # elected source 1 is dead
+    stats = blobmesh.fetch_missing(
+        dst, digests, sources, {0: _addr(svc), 1: DEAD_ADDR}, KEY)
+    assert stats["blobs_fetched"] == 3
+    assert stats["retries"] >= 3                # one refused conn per digest
+    assert stats["sources"] == {0: 3}           # all re-elected to rank 0
+
+
+def test_fetch_missing_corrupt_source_reelects(tmp_path, monkeypatch):
+    """resume_corrupt garbles one served blob IN FLIGHT (signed, so only
+    the content-address re-hash catches it): the fetcher re-elects the
+    next possessor and completes; the fault is one-shot."""
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC", "resume_corrupt:rank=7,fetch=0")
+    monkeypatch.setenv("HOROVOD_FAULT_MARKER_DIR", str(tmp_path / "markers"))
+    blob = b"payload" * 50
+    a, (d,) = _store_with(tmp_path, "a", [blob])
+    b, _ = _store_with(tmp_path, "b", [blob])
+    dst = BlobStore(str(tmp_path / "dst"))
+    svc_a = blobmesh.BlobPeerService(a, KEY, bind_host="127.0.0.1", rank=7)
+    svc_b = blobmesh.BlobPeerService(b, KEY, bind_host="127.0.0.1", rank=8)
+    try:
+        stats = blobmesh.fetch_missing(
+            dst, [d], {d: [7, 8]},
+            {7: f"127.0.0.1:{svc_a.port}", 8: f"127.0.0.1:{svc_b.port}"},
+            KEY)
+        assert stats == {"blobs_fetched": 1, "bytes_fetched": len(blob),
+                         "retries": 1, "sources": {8: 1}}
+        assert dst.get_blob(d, verify=True) == blob
+        # one-shot: rank 7's next serve (request counter 1, and a replay
+        # of 0 is marker-blocked anyway) returns clean bytes
+        client = blobmesh.BlobPeerClient(KEY)
+        assert client.fetch(f"127.0.0.1:{svc_a.port}", d, timeout_s=5) \
+            == blob
+    finally:
+        svc_a.close()
+        svc_b.close()
+
+
+def test_fetch_missing_delay_fault_hits_deadline(tmp_path, monkeypatch):
+    """resume_delay stalls the only source past the resume deadline: the
+    fetch escalates to HorovodInternalError instead of hanging."""
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC",
+                       "resume_delay:fetch=0,seconds=30")
+    monkeypatch.setenv("HOROVOD_FAULT_MARKER_DIR", str(tmp_path / "m2"))
+    a, (d,) = _store_with(tmp_path, "a", [b"slow" * 10])
+    dst = BlobStore(str(tmp_path / "dst"))
+    svc = blobmesh.BlobPeerService(a, KEY, bind_host="127.0.0.1", rank=0)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(HorovodInternalError):
+            blobmesh.fetch_missing(
+                dst, [d], {d: [0]}, {0: f"127.0.0.1:{svc.port}"}, KEY,
+                policy=RetryPolicy(attempts=3, timeout_s=5,
+                                   backoff_base_s=0.05),
+                deadline=time.monotonic() + 0.8)
+    finally:
+        svc.close()
+    assert time.monotonic() - t0 < 10   # bounded by the deadline, not 30s
+
+
+def test_fetch_missing_exhausted_sources_escalates(tmp_path):
+    dst = BlobStore(str(tmp_path / "dst"))
+    d = blob_digest(b"nobody-serves-this")
+    with pytest.raises(HorovodInternalError):
+        blobmesh.fetch_missing(
+            dst, [d], {d: [0]}, {0: DEAD_ADDR}, KEY,
+            policy=RetryPolicy(attempts=2, timeout_s=1,
+                               backoff_base_s=0.01, backoff_cap_s=0.02))
+
+
+def test_fetch_missing_no_possessor_escalates(tmp_path):
+    dst = BlobStore(str(tmp_path / "dst"))
+    d = blob_digest(b"lost-forever")
+    with pytest.raises(HorovodInternalError):
+        blobmesh.fetch_missing(dst, [d], {d: []}, {}, KEY)
+
+
+# -- config / telemetry ------------------------------------------------------
+
+def test_resume_deadline_env(monkeypatch):
+    monkeypatch.delenv("HOROVOD_RESUME_TIMEOUT_SECONDS", raising=False)
+    assert blobmesh.resume_deadline_s() == 120.0
+    monkeypatch.setenv("HOROVOD_RESUME_TIMEOUT_SECONDS", "7.5")
+    assert blobmesh.resume_deadline_s() == 7.5
+    monkeypatch.setenv("HOROVOD_RESUME_TIMEOUT_SECONDS", "0")
+    assert blobmesh.resume_deadline_s() == 0.0  # disabled
+    monkeypatch.setenv("HOROVOD_RESUME_TIMEOUT_SECONDS", "bogus")
+    assert blobmesh.resume_deadline_s() == 120.0
+
+
+def test_retry_policy_for_resume_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RESUME_FETCH_TIMEOUT_SECONDS", "3.5")
+    p = RetryPolicy.for_resume()
+    assert p.timeout_s == 3.5
+    assert p.attempts >= 1
+    monkeypatch.delenv("HOROVOD_RESUME_FETCH_TIMEOUT_SECONDS")
+    assert RetryPolicy.for_resume().timeout_s == 30.0
+
+
+def test_mesh_key_secret_env_wins(monkeypatch, tmp_path):
+    from horovod_tpu.runner import secret
+    monkeypatch.delenv(secret.ENV_VAR, raising=False)
+    derived = blobmesh.mesh_key(str(tmp_path))
+    assert len(derived) == 32
+    assert derived == blobmesh.mesh_key(str(tmp_path))      # rank-identical
+    assert derived != blobmesh.mesh_key(str(tmp_path) + "2")
+    monkeypatch.setenv(secret.ENV_VAR,
+                       secret.encode(secret.make_secret_key()))
+    assert blobmesh.mesh_key(str(tmp_path)) != derived
+
+
+def test_fetch_telemetry_counters(service):
+    from horovod_tpu.core import telemetry as _telemetry
+    sess = _telemetry.active()
+    if not sess.enabled:
+        pytest.skip("telemetry disabled in this session")
+    svc, _src, dst, digests = service
+    stats = blobmesh.fetch_missing(
+        dst, digests, {d: [1, 0] for d in digests},
+        {0: _addr(svc), 1: DEAD_ADDR}, KEY)
+    assert stats["retries"] >= 3
+    snap = sess.registry.export()
+    keys = set(snap["c"])
+    assert any(k.startswith("hvd_resume_bytes_fetched") for k in keys)
+    assert any(k.startswith("hvd_resume_retries_total") for k in keys)
+    assert any(k.startswith("hvd_resume_sources") for k in keys)
+
+
+def test_failed_resume_lands_flight_record(tmp_path):
+    """A resume that cannot complete must leave a flight-ring record (the
+    incident report's WHY), not just an exception."""
+    from horovod_tpu.core import telemetry as _telemetry
+    sess = _telemetry.active()
+    if not sess.enabled:
+        pytest.skip("telemetry disabled in this session")
+    dst = BlobStore(str(tmp_path / "dst"))
+    d = blob_digest(b"gone")
+    with pytest.raises(HorovodInternalError):
+        blobmesh.fetch_missing(dst, [d], {d: []}, {}, KEY)
+    kinds = [ev.get("kind") for ev in sess.ring.events()]
+    assert "resume_failed" in kinds

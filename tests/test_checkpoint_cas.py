@@ -436,6 +436,158 @@ def test_gc_keeps_shard_part_blobs_of_live_manifests(tmp_path):
     assert not store.has_blob(p1) and not store.has_blob(p2)
 
 
+# --- peer-sourced resume (ISSUE 18) -----------------------------------------
+
+_MESH_KEY = b"k" * 32
+
+
+def _peer_restore(src_store, manifest, dst_dir, shard_selector=None):
+    """Simulate a fresh rank restoring over the blob mesh: an EMPTY local
+    store, the need set computed from the manifest under the selector,
+    every blob fetched point-to-point from a real loopback
+    ``BlobPeerService`` — exactly the multi-process resume path of
+    ``load_persisted_world`` minus the collectives. Returns
+    ``(payload, bytes_fetched)``."""
+    from horovod_tpu.elastic import blobmesh
+    dst = BlobStore(str(dst_dir))
+    svc = blobmesh.BlobPeerService(src_store, _MESH_KEY,
+                                   bind_host="127.0.0.1", rank=0)
+    addr = {0: f"127.0.0.1:{svc.port}"}
+    fetched = 0
+    try:
+        skel = [manifest["skeleton"]]
+        s = blobmesh.fetch_missing(dst, skel, {skel[0]: [0]}, addr,
+                                   _MESH_KEY)
+        fetched += s["bytes_fetched"]
+        need = state_mod._manifest_need(dst, manifest, shard_selector)
+        missing = [d for d in need if not dst.has_blob(d)]
+        s = blobmesh.fetch_missing(
+            dst, missing,
+            blobmesh.assign_sources(missing, {0: set(missing)}, 0),
+            addr, _MESH_KEY)
+        fetched += s["bytes_fetched"]
+    finally:
+        svc.close()
+    return state_mod._unpack_manifest(dst, manifest, shard_selector), fetched
+
+
+def _leaves_bytes(tree):
+    import jax
+    return [np.asarray(l).tobytes() for l in jax.tree_util.tree_leaves(tree)]
+
+
+def test_peer_resume_equals_local_restore_bit_identical(tmp_path):
+    """Property (same topology AND the regrown-world case — a brand-new
+    rank owns NO blobs): a payload materialized entirely over the peer
+    mesh is bit-identical to the committing rank's local restore, and the
+    fetched bytes account for exactly skeleton + every whole leaf."""
+    d = str(tmp_path / "commits")
+    s = elastic.JaxState(commit_dir=d, commit_async=False,
+                         params={"w": jnp.arange(256.0),
+                                 "b": jnp.ones(32)}, step=0)
+    s.save()
+    store = state_mod._cas_store(d)
+    manifest = store.read_manifest(s._commit_seq)
+    local = state_mod._unpack_manifest(store, manifest)
+    peer, fetched = _peer_restore(store, manifest, tmp_path / "fresh")
+    assert _leaves_bytes(peer) == _leaves_bytes(local)
+    expected = len(store.get_blob(manifest["skeleton"])) \
+        + sum(e[1] for e in manifest["leaves"])
+    assert fetched == expected
+
+
+def test_peer_resume_resharded_world_delta_and_identity(tmp_path):
+    """Topology-change restore (serving-style tp reshape): each target
+    shard fetches ONLY its part blobs for planned leaves — byte
+    accounting proves the delta — and the selected slices re-concatenate
+    bit-identically to the whole-leaf restore."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    tp = 4
+    params = _decode_like_params(11)
+    _state, pub, rec = _publish_params(tmp_path, "cas", params,
+                                       shard_plan=tp_shard_plan(tp))
+    manifest = pub.store.read_manifest(rec["manifest_seq"])
+    full, full_bytes = _peer_restore(pub.store, manifest,
+                                     tmp_path / "full")
+    got_wq = []
+    for idx in range(tp):
+        part, part_bytes = _peer_restore(
+            pub.store, manifest, tmp_path / f"shard{idx}",
+            shard_selector=tp_shard_selector(tp, idx))
+        assert 0 < part_bytes < full_bytes / 2, (part_bytes, full_bytes)
+        # unplanned leaves ride whole (bit-identical to the full restore)
+        emb = part["attrs"]["params"]["tok_embeddings"]["embedding"]
+        assert np.asarray(emb).tobytes() == np.asarray(
+            full["attrs"]["params"]["tok_embeddings"]["embedding"]).tobytes()
+        got_wq.append(np.asarray(
+            part["attrs"]["params"]["block_0"]["attn"]["wq"]["kernel"]))
+    wq_full = np.asarray(
+        full["attrs"]["params"]["block_0"]["attn"]["wq"]["kernel"])
+    np.testing.assert_array_equal(np.concatenate(got_wq, axis=1), wq_full)
+    assert np.concatenate(got_wq, axis=1).tobytes() == wq_full.tobytes()
+
+
+def test_peer_resume_topology_mismatch_whole_leaf_fallback(tmp_path):
+    """A selector whose tp does not divide the manifest's shard count
+    falls back to whole leaves: the need set names no part blobs, the
+    fetched bytes equal the full restore, and the payload is complete."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    params = _decode_like_params(12)
+    _state, pub, rec = _publish_params(tmp_path, "cas", params,
+                                       shard_plan=tp_shard_plan(4))
+    manifest = pub.store.read_manifest(rec["manifest_seq"])
+    part_digests = {p[0] for m in manifest["shards"].values()
+                    for p in m["parts"]}
+    need = state_mod._manifest_need(pub.store, manifest,
+                                    tp_shard_selector(2, 1))
+    assert not (set(need) & part_digests)
+    full, full_bytes = _peer_restore(pub.store, manifest, tmp_path / "f")
+    mism, mism_bytes = _peer_restore(pub.store, manifest, tmp_path / "m",
+                                     shard_selector=tp_shard_selector(2, 1))
+    assert mism_bytes == full_bytes
+    assert _leaves_bytes(mism) == _leaves_bytes(full)
+
+
+def test_load_persisted_world_single_process_selector(tmp_path):
+    """``load_persisted_world`` (single-process path) honors the shard
+    selector: planned leaves come back as the target shard's slice,
+    bit-identical to slicing the whole-leaf restore."""
+    from horovod_tpu.serving.decode import tp_shard_plan, tp_shard_selector
+    tp, idx = 4, 2
+    params = _decode_like_params(13)
+    _state, pub, rec = _publish_params(tmp_path, "cas", params,
+                                       shard_plan=tp_shard_plan(tp))
+    d = str(tmp_path / "cas")
+    whole = state_mod.load_persisted_world(d)
+    sliced = state_mod.load_persisted_world(
+        d, shard_selector=tp_shard_selector(tp, idx))
+    wq_whole = np.asarray(
+        whole["attrs"]["params"]["block_0"]["attn"]["wq"]["kernel"])
+    wq_slice = np.asarray(
+        sliced["attrs"]["params"]["block_0"]["attn"]["wq"]["kernel"])
+    np.testing.assert_array_equal(
+        wq_slice, np.split(wq_whole, tp, axis=1)[idx])
+    assert wq_slice.tobytes() \
+        == np.split(wq_whole, tp, axis=1)[idx].tobytes()
+
+
+def test_load_persisted_world_legacy_single_frame_fallback(tmp_path):
+    """A commit dir holding only a legacy single-frame commit (no CAS
+    manifest) still restores through ``load_persisted_world`` — with or
+    without a selector (the selector needs a manifest to act on)."""
+    d = str(tmp_path / "legacy")
+    os.makedirs(d)
+    payload = {"seq": 3, "attrs": {"w": np.arange(16.0)}}
+    state_mod._persist(d, payload)
+    got = state_mod.load_persisted_world(d)
+    assert got["seq"] == 3
+    np.testing.assert_array_equal(got["attrs"]["w"], np.arange(16.0))
+    got2 = state_mod.load_persisted_world(
+        d, shard_selector=lambda names, meta: None)
+    assert np.asarray(got2["attrs"]["w"]).tobytes() \
+        == np.asarray(got["attrs"]["w"]).tobytes()
+
+
 # --- torn commit (crash between blob write and manifest publish) ------------
 
 _TORN_WORKER = textwrap.dedent("""

@@ -174,6 +174,55 @@ def test_corrupt_truncates_newest_file(tmp_path):
     assert old.stat().st_size == 100            # older commit untouched
 
 
+def test_parse_resume_grammar():
+    """resume_* kinds schedule on the blob peer service's serve-request
+    counter (``fetch=``), not steps or rounds."""
+    spec = FaultSpec.parse(
+        "resume_kill:rank=1,fetch=0;"
+        "resume_corrupt:fetch=1;"
+        "resume_delay:fetch=2,seconds=0.25")
+    kinds = [f.kind for f in spec.faults]
+    assert kinds == ["resume_kill", "resume_corrupt", "resume_delay"]
+    assert (spec.faults[0].rank, spec.faults[0].fetch) == (1, 0)
+    assert spec.faults[1].rank is None          # any serving rank
+    assert spec.faults[2].params["seconds"] == "0.25"
+
+
+@pytest.mark.parametrize("bad", [
+    "resume_kill:rank=1",       # resume kind without a fetch schedule
+    "resume_corrupt:step=2",    # wrong axis
+    "resume_delay:seconds=1",
+])
+def test_parse_rejects_resume_without_fetch(bad):
+    with pytest.raises(ValueError):
+        FaultSpec.parse(bad)
+
+
+def test_on_blob_serve_schedule_and_one_shot(tmp_path):
+    h = _harness("resume_corrupt:rank=1,fetch=2", tmp_path)
+    assert h.will_fire("resume_corrupt", 1, 2)
+    assert h.on_blob_serve(2, rank=0) is None       # wrong rank
+    assert h.on_blob_serve(1, rank=1) is None       # wrong serve count
+    f = h.on_blob_serve(2, rank=1)
+    assert f is not None and f.kind == "resume_corrupt"
+    # one-shot: the SAME source replaying serve request 2 (relaunched
+    # generation re-fetching) must not re-garble
+    assert h.on_blob_serve(2, rank=1) is None
+    # ...and the marker survives a harness rebuild (relaunched process)
+    h2 = _harness("resume_corrupt:rank=1,fetch=2", tmp_path)
+    assert h2.on_blob_serve(2, rank=1) is None
+    assert not h2.will_fire("resume_corrupt", 1, 2)
+
+
+def test_on_blob_serve_returns_params_to_the_service(tmp_path):
+    """The SERVICE applies the action (mirrors on_rpc_call): the harness
+    only schedules and hands back the fault with its params."""
+    h = _harness("resume_delay:fetch=0,seconds=0.25", tmp_path)
+    f = h.on_blob_serve(0, rank=3)                  # rank=None matches any
+    assert f is not None and f.kind == "resume_delay"
+    assert float(f.params["seconds"]) == 0.25
+
+
 def test_delay_and_drop_on_engine_round_axis(tmp_path, monkeypatch):
     monkeypatch.setenv("HOROVOD_RANK", "0")
     h = _harness("delay:rank=0,round=1,seconds=0.1", tmp_path)

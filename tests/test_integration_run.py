@@ -690,6 +690,144 @@ def test_elastic_host_add_graceful_reset_two_workers(tmp_path):
     assert lat and max(lat) < 1.0, (lat, combined[-2000:])
 
 
+RESUME_MESH_WORKER = """
+import json
+import os
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.elastic.state import last_resume_stats
+from horovod_tpu.optimizer import allgather_object
+
+hvd.init()
+# Per-HOST commit dirs: each loopback "host" owns a private disk, so the
+# freshly-grown third host holds NO blobs and must restore over the peer
+# blob mesh (elastic/blobmesh.py) — the seam the resume_* faults target.
+_dir = os.path.join(os.environ["MESH_DIR"],
+                    os.environ.get("HOROVOD_HOSTNAME", "local"))
+state = elastic.ObjectState(commit_dir=_dir, step=0)
+
+@elastic.run
+def train(state):
+    while state.step < 8:
+        allgather_object(float(state.step))
+        if (hvd.rank() == 0 and state.step == 2
+                and not os.path.exists(os.environ["GROW_MARKER"])):
+            with open(os.environ["GROW_MARKER"], "w") as f:
+                f.write("grown")
+            with open(os.environ["GROW_HOSTS_FILE"], "w") as f:
+                f.write("localhost:1\\n127.0.0.2:1\\n127.0.0.3:1\\n")
+        time.sleep(0.2)
+        state.step += 1
+        state.commit()
+    return state.step
+
+train(state)
+stats = last_resume_stats()
+print(json.dumps({"rank": hvd.rank(), "size": hvd.size(),
+                  "final_step": state.step,
+                  "host": os.environ.get("HOROVOD_HOSTNAME"),
+                  "resume_latency_s": getattr(
+                      state, "_last_resume_latency_s", None),
+                  "bytes_fetched": stats.get("bytes_fetched"),
+                  "retries": stats.get("retries"),
+                  "topology_from": stats.get("topology_from")}), flush=True)
+"""
+
+
+def _run_resume_mesh_chaos(tmp_path, fault_spec, extra_env=None):
+    hosts_file = tmp_path / "mesh_hosts"
+    hosts_file.write_text("localhost:1\n127.0.0.2:1\n")
+    disco = tmp_path / "discover.sh"
+    disco.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    disco.chmod(0o755)
+    script = tmp_path / "mesh_worker.py"
+    script.write_text(RESUME_MESH_WORKER)
+    env = {"MESH_DIR": str(tmp_path / "mesh"),
+           "GROW_MARKER": str(tmp_path / "grown"),
+           "GROW_HOSTS_FILE": str(hosts_file),
+           "HOROVOD_FAULT_MARKER_DIR": str(tmp_path / "fault_markers"),
+           "HOROVOD_LOG_LEVEL": "INFO"}
+    env.update(extra_env or {})
+    return _run_hvdrun(["-np", "2", "--min-np", "2", "--max-np", "3",
+                        "--host-discovery-script", str(disco),
+                        "--fault-spec", fault_spec,
+                        sys.executable, str(script)], timeout=420,
+                       env_extra=env)
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_resume_mesh_corrupt_source_reelects_np3(tmp_path):
+    """ISSUE 18 chaos tier: the world grows 2→3 hosts with per-host
+    disks; the new host's first peer-fetched blob is garbled IN FLIGHT
+    (``resume_corrupt`` — HMAC-valid, so only the content-address re-hash
+    catches it). The fetcher re-elects the surviving possessor, the
+    restored state is digest-verified, and training completes at np=3
+    with NO extra generation. Per-rank byte accounting: only the blobless
+    new host fetched; the old hosts' need sets were empty (the PR 9
+    union-broadcast over-delivery is gone)."""
+    r = _run_resume_mesh_chaos(tmp_path, "resume_corrupt:fetch=0")
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3, (lines, r.stdout)
+    assert all(l["size"] == 3 and l["final_step"] == 8 for l in lines), lines
+    combined = r.stdout + r.stderr
+    assert "re-electing next possessor" in combined, combined[-3000:]
+    by_host = {l["host"]: l for l in lines}
+    fresh = by_host["127.0.0.3"]
+    # the corrupt reply cost at least one re-election, then verified bytes
+    assert fresh["retries"] >= 1, fresh
+    assert fresh["bytes_fetched"] > 0, fresh
+    # old hosts possess every blob — their own need sets fetched nothing
+    for host in ("localhost", "127.0.0.2"):
+        assert by_host[host]["bytes_fetched"] == 0, by_host[host]
+        assert by_host[host]["retries"] == 0, by_host[host]
+    # topology-change restore: the adopted manifest came from the np=2 world
+    assert fresh["topology_from"] == 2, fresh
+    # happy-path latency bound survives the failover (loopback fetches)
+    for l in lines:
+        assert l["resume_latency_s"] is not None, l
+        assert l["resume_latency_s"] < 5.0, l
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_resume_mesh_source_sigkill_mid_fetch_np3(tmp_path):
+    """ISSUE 18 chaos tier: SIGKILL the ELECTED blob source while it
+    serves the new host's first fetch (``resume_kill``). The fetcher
+    re-elects the surviving possessor and finishes its fetch; the dead
+    peer bounds the resume barrier out (stall watchdog, under the resume
+    deadline ceiling), the driver relaunches, and the one-shot marker
+    lets the next generation resume clean — training still completes at
+    np=3."""
+    r = _run_resume_mesh_chaos(
+        tmp_path, "resume_kill:fetch=0",
+        extra_env={"HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "8"})
+    assert r.returncode == 0, f"{r.stdout[-3000:]}\n{r.stderr[-3000:]}"
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3, (lines, r.stdout)
+    assert all(l["size"] == 3 and l["final_step"] == 8 for l in lines), lines
+    combined = r.stdout + r.stderr
+    assert "fault: killing self while serving blob" in combined, \
+        combined[-3000:]
+    assert "re-electing next possessor" in combined, combined[-3000:]
+    # the kill retired a generation: np=3 was launched at least twice
+    assert combined.count("(np=3)") >= 2, combined[-3000:]
+    # The final generation's resume went through the mesh path too. The
+    # new host may fetch ZERO bytes this time — everything it pulled
+    # before the barrier stalled persisted in its store, which is the
+    # point of landing verified bytes immediately — so assert the
+    # topology-change restore, not a byte count.
+    by_host = {l["host"]: l for l in lines}
+    assert by_host["127.0.0.3"]["topology_from"] == 2, by_host
+    assert by_host["127.0.0.3"]["resume_latency_s"] is not None, by_host
+
+
 @pytest.mark.integration
 def test_hvdrun_timeline_flag_reaches_worker(tmp_path):
     """--timeline-filename → HOROVOD_TIMELINE in the worker env → init
