@@ -34,7 +34,13 @@ CPU mesh. Same interleaving discipline: both arms inside every
 recorded: decode tokens/s/chip, TTFT at admission, steady-state decode
 compile count (must be ZERO after warmup — the no-recompile contract),
 and the p99 per-step latency while ≥2 weight hot-swaps land mid-decode
-(the refill-policy block-table remap cost).
+(the refill-policy block-table remap cost). A shed probe (ISSUE 19
+satellite) rides in the decode record: a burst of concurrent requests
+against a server pinned to a tiny admission queue
+(``HOROVOD_SERVING_QUEUE_MAX=2``) while the worker is held busy, so the
+shedding path has a measured baseline — ``shed_fraction`` must land
+strictly inside (0, 1) (some requests shed with 429 + Retry-After, the
+accepted ones all complete ok, none fail any other way).
 
 The **sharded_decode** segment (ISSUE 14) scales the decode plane over
 a ``tp`` mesh: tp=1 vs tp=4/8 arms at a FIXED per-device KV budget
@@ -113,6 +119,7 @@ from benchmarks import common  # noqa: E402,F401  (forces cpu backend)
 from horovod_tpu.elastic.state import ObjectState              # noqa: E402
 from horovod_tpu.serving import (InferenceServer, ModelRegistry,  # noqa: E402
                                  Publisher)
+from horovod_tpu.serving import constants as SC                # noqa: E402
 
 HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "serving_history.jsonl")
@@ -330,6 +337,122 @@ def run_staleness_segment(*, commits: int, cadence_s: float,
         }
 
 
+# -- overload shed probe (ISSUE 19 satellite) ---------------------------------
+
+
+def run_shed_probe(*, burst: int = 16, queue_max: int = 2,
+                   service_s: float = 0.15) -> dict:
+    """Induced overload against one :class:`InferenceServer`: pin the
+    admission queue to ``queue_max``, hold the batch worker busy
+    (``service_s`` per forward), and land a ``burst`` of concurrent
+    requests. The contract under fire: some requests MUST shed (429 +
+    ``Retry-After`` — the queue is tiny), every accepted request MUST
+    complete ok, and nothing may hang or 500. ``shed_fraction`` is the
+    measured baseline the fleet bench (benchmarks/fleet.py) builds on.
+    """
+    saved = {k: os.environ.get(k) for k in
+             (SC.QUEUE_MAX_ENV, SC.SHED_RETRY_AFTER_ENV)}
+    os.environ[SC.QUEUE_MAX_ENV] = str(queue_max)
+    os.environ[SC.SHED_RETRY_AFTER_ENV] = "0.05"
+    try:
+        with tempfile.TemporaryDirectory(prefix="hvd_shed_probe_") as d:
+            state = ObjectState(commit_dir=d, commit_async=False,
+                                **_leaves(2, 64, 0, "frozen"))
+            pub = Publisher(d, every=1, counters=_counters_clean)
+            reg = ModelRegistry(store=pub.store)
+            state.commit()
+            reg.adopt(pub.maybe_publish(state._commit_seq))
+
+            def forward(payload, inputs, padded_n):
+                time.sleep(service_s)
+                return [float(q["x"]) for q in inputs]
+
+            srv = InferenceServer(reg, forward, window_s=0.002,
+                                  request_timeout_s=30.0)
+            results = {"attempted": 0, "accepted": 0, "shed": 0,
+                       "failed": 0}
+            retry_afters: List[float] = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(burst + 1)
+
+            def one_request(i: int) -> None:
+                body = json.dumps({"x": float(i)}).encode()
+                req = urllib.request.Request(
+                    f"http://{srv.addr()}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                barrier.wait()
+                # Tiny stagger: HTTPServer's listen backlog is 5, so a
+                # perfectly simultaneous burst can get connects RESET at
+                # the socket — a transport artifact, not a shed. Spread
+                # the connects (the queue still overflows: service_s per
+                # batch dwarfs the whole spread) and retry one reset.
+                time.sleep(i * 0.002)
+                outcome, ra = "failed", None
+                for attempt in range(2):
+                    try:
+                        with urllib.request.urlopen(req, timeout=30) as r:
+                            out = json.loads(r.read())
+                        if out.get("ok") and out.get("result") == float(i):
+                            outcome = "accepted"
+                        break
+                    except urllib.error.HTTPError as e:
+                        e.read()
+                        if e.code == 429:
+                            outcome = "shed"
+                            try:
+                                ra = float(e.headers.get("Retry-After"))
+                            except (TypeError, ValueError):
+                                pass
+                        break
+                    except OSError:
+                        continue
+                with lock:
+                    results["attempted"] += 1
+                    results[outcome] += 1
+                    if ra is not None:
+                        retry_afters.append(ra)
+
+            try:
+                # Occupy the worker first so the burst meets a busy
+                # server, then release the whole burst at once — the
+                # tiny queue admits ~queue_max of it, sheds the rest.
+                seed = threading.Thread(
+                    target=lambda: urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"http://{srv.addr()}/predict",
+                            data=json.dumps({"x": -1.0}).encode(),
+                            headers={"Content-Type": "application/json"}),
+                        timeout=30).read(), daemon=True)
+                seed.start()
+                time.sleep(service_s / 3)   # seed is mid-forward
+                threads = [threading.Thread(target=one_request, args=(i,),
+                                            daemon=True)
+                           for i in range(burst)]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                for t in threads:
+                    t.join(timeout=60)
+                seed.join(timeout=60)
+            finally:
+                srv.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "burst": burst, "queue_max": queue_max,
+        "service_s": service_s,
+        **results,
+        "shed_fraction": round(results["shed"]
+                               / max(results["attempted"], 1), 4),
+        "retry_after_advertised_s": max(retry_afters)
+        if retry_afters else None,
+    }
+
+
 # -- continuous decode vs bucketed full-forward (ISSUE 13) --------------------
 
 
@@ -422,6 +545,7 @@ def run_decode_segment(*, rounds: int = 5, slots: int = 8,
     steady_compiles = eng.compile_counts["decode"] - warm["decode"]
     ratios = [r["full8"] / r["decode8"] for r in rnds]
     swap = _run_swap_probe(cfg, params, slots=slots)
+    shed = run_shed_probe()
     return {
         "model": "llama_tiny", "slots": slots, "block_size": bs,
         "devices_used": 1, "prompt_len": len(prompt),
@@ -446,6 +570,8 @@ def run_decode_segment(*, rounds: int = 5, slots: int = 8,
         "steady_decode_compiles": steady_compiles,
         "compile_counts": dict(eng.compile_counts),
         "swap": swap,
+        "shed_fraction": shed["shed_fraction"],
+        "shed": shed,
     }
 
 
@@ -987,6 +1113,24 @@ def check_history(path: str = HISTORY_PATH) -> dict:
          and isinstance(p99, (int, float)) and 0 < p99 < MAX_DECODE_P99_S
          and dswap.get("steady_decode_compiles") == 0,
          f"decode swap probe incomplete or out of rails: {dswap}")
+    # Shed probe (ISSUE 19 satellite): induced overload must actually
+    # shed (fraction strictly inside (0, 1)), every accepted request
+    # must come back ok, nothing may fail any other way, and the 429s
+    # must advertise a Retry-After pace.
+    sf = dec.get("shed_fraction")
+    dshed = dec.get("shed") or {}
+    need(isinstance(sf, (int, float)) and 0 < sf < 1,
+         f"decode shed_fraction={sf} outside (0, 1) — overload probe "
+         f"did not exercise the shedding path")
+    need(dshed.get("failed") == 0
+         and dshed.get("accepted", 0) > 0
+         and dshed.get("accepted", 0) + dshed.get("shed", 0)
+         == dshed.get("attempted"),
+         f"shed probe lost requests (accepted+shed != attempted, or "
+         f"failures): {dshed}")
+    ra = dshed.get("retry_after_advertised_s")
+    need(isinstance(ra, (int, float)) and ra > 0,
+         f"shed probe 429s carried no Retry-After: {ra}")
     shd = rec.get("sharded_decode") or {}
     need(isinstance(shd.get("normalized_unit"), str)
          and "timeshare" in shd.get("normalized_unit", ""),

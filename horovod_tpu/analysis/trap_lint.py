@@ -180,6 +180,19 @@ CLAUDE.md "Environment traps"):
   (``elastic/blobmesh.py::BlobPeerClient.fetch``,
   docs/checkpointing.md "Peer-sourced resume").
 
+- ``lint-unbounded-admission`` (WARNING): an HTTP request handler
+  (``do_GET``/``do_POST``/``do_PUT`` on a class deriving from a
+  ``*HTTPRequestHandler``) enqueues work — ``.put``/``.put_nowait`` on a
+  queue-ish receiver, or any ``*enqueue*`` call — while neither the
+  method nor its class shows any shed evidence (a ``qsize``/``full``
+  check, a comparison against a ``*max*``/``*cap*`` bound, a 429
+  constant, or a ``shed``/``admit`` name).  An unbounded admission queue
+  turns a traffic spike into unbounded latency for EVERY queued request,
+  then timeout storms and retry amplification; bound the queue and shed
+  past the bound with 429 + ``Retry-After`` so clients back off instead
+  of piling on (``serving/server.py::InferenceServer._admit``,
+  docs/fleet.md "Overload containment").
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -327,6 +340,41 @@ REQUEST_DRAIN_NAMES = frozenset({"get_nowait", "recv", "recv_json",
                                  "accept"})
 REQUEST_DRAIN_GENERIC = frozenset({"get"})
 REQUEST_RECEIVER_TOKENS = ("queue", "request", "req", "inbox", "pending")
+
+
+# lint-unbounded-admission vocabulary: the handler methods that admit
+# traffic, the enqueue spellings (``put``/``put_nowait`` need a queue-ish
+# receiver so dict/env puts stay clean; ``*enqueue*`` counts bare), and
+# the tokens that count as shed/bounding evidence.
+ADMISSION_HANDLER_METHODS = frozenset({"do_GET", "do_POST", "do_PUT"})
+ADMISSION_ENQUEUE_NAMES = frozenset({"put", "put_nowait"})
+ADMISSION_RECEIVER_TOKENS = ("queue", "pending", "inbox", "backlog",
+                             "work", "req")
+ADMISSION_EVIDENCE_EXACT = frozenset({"qsize", "full"})
+
+
+def _admission_shed_evidence(node) -> bool:
+    """True when a subtree shows bounded-admission awareness: a queue
+    depth/capacity probe, a 429 constant, a shed/admit name, or a
+    comparison against a ``*max*``/``*cap*`` bound."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value == 429:
+            return True
+        tok = sub.attr if isinstance(sub, ast.Attribute) else (
+            sub.id if isinstance(sub, ast.Name) else None)
+        if tok is not None:
+            t = tok.lower()
+            if t in ADMISSION_EVIDENCE_EXACT or "shed" in t or "admit" in t:
+                return True
+        if isinstance(sub, ast.Compare):
+            for side in [sub.left] + list(sub.comparators):
+                for n in ast.walk(side):
+                    st = n.attr if isinstance(n, ast.Attribute) else (
+                        n.id if isinstance(n, ast.Name) else None)
+                    if st is not None and ("max" in st.lower()
+                                           or "cap" in st.lower()):
+                        return True
+    return False
 
 
 # lint-xplane-umbrella vocabulary: the umbrella prefixes whose presence
@@ -999,6 +1047,50 @@ class _Lint(ast.NodeVisitor):
         self._check_unverified_peer_blob(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._check_unbounded_admission(node)
+        self.generic_visit(node)
+
+    def _check_unbounded_admission(self, node):
+        """lint-unbounded-admission: a request-handler class whose
+        do_* methods enqueue work with no shed evidence anywhere in the
+        class (a bounding helper method on the same class counts — the
+        bound does not have to live inside the handler method)."""
+        if not any("HTTPRequestHandler" in _dotted(b) for b in node.bases):
+            return
+        if _admission_shed_evidence(node):
+            return
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name not in ADMISSION_HANDLER_METHODS:
+                continue
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                last = dotted.split(".")[-1]
+                enqueue = "enqueue" in last.lower() or (
+                    last in ADMISSION_ENQUEUE_NAMES
+                    and any(tok in dotted.lower()
+                            for tok in ADMISSION_RECEIVER_TOKENS))
+                if not enqueue:
+                    continue
+                self._add(
+                    "lint-unbounded-admission", Severity.WARNING, sub,
+                    f"{meth.name} enqueues work with no queue bound or "
+                    "shed path anywhere in the handler class: an "
+                    "unbounded admission queue turns a traffic spike "
+                    "into unbounded latency for EVERY queued request "
+                    "(each waits behind the spike), then timeout storms "
+                    "and retry amplification as clients give up and "
+                    "resend — check depth against a configured max and "
+                    "shed past it with 429 + Retry-After so callers back "
+                    "off (serving/server.py::InferenceServer._admit, "
+                    "HOROVOD_SERVING_QUEUE_MAX, docs/fleet.md 'Overload "
+                    "containment'), or pragma a queue bounded elsewhere",
+                    {"call": dotted, "method": meth.name})
 
     def _check_unverified_peer_blob(self, node):
         """lint-unverified-peer-blob: peer-received bytes landed in the

@@ -1,0 +1,214 @@
+"""Fleet arbiter: traffic-aware capacity re-bidding between training
+and serving (ROADMAP item 5; docs/fleet.md).
+
+No upstream analog (SURVEY.md §2: ``horovod/runner/elastic/driver.py``
+only ever arbitrates TRAINING hosts — serving does not exist there).
+Here the coordinator already merges everything a policy needs: per-rank
+training step walls and serving queue-depth/staleness gauges arrive
+piggybacked on the existing polls (core/telemetry.py →
+``CoordinatorService._record_metrics``), and the elastic world can
+grow/shrink via the graceful reset. This module closes the loop.
+
+Design:
+
+- **Policy is pure hysteresis** (:class:`ArbiterPolicy`): the worst
+  per-replica queue depth must stay at or above ``queue_high`` (or
+  staleness above ``staleness_high_s``) for ``sustain`` consecutive
+  evaluations before serving scales OUT by one replica, and at or below
+  ``queue_low`` just as long before a replica is reclaimed for training
+  — with a ``cooldown_s`` dead time between decisions so the fleet never
+  flaps faster than a graceful reset + replica warmup can complete.
+  Bounds: serving never exceeds ``max_replicas`` and training never
+  shrinks below ``min_training_np``; serving never drops below
+  ``min_replicas``.
+- **Every decision is a journal record**: :meth:`FleetArbiter.evaluate`
+  lands decisions through
+  :meth:`~.service.CoordinatorService.record_arbiter_decision`, which
+  appends an ``op:"arbiter"`` record (elastic/journal.py) under the
+  arbiter's own monotonic ``seq``. A coordinator crash-restart replays
+  the journal and the next :class:`FleetArbiter` seeds itself from
+  :meth:`~.service.CoordinatorService.fleet_view` — the fleet resumes
+  the SAME shape mid-rebalance instead of re-deciding from zero (chaos
+  proof: tests/test_fleet_chaos.py).
+- **Decide, don't enact**: the arbiter outputs a target shape
+  ``{serving_target, training_np}``. Enactment — starting/draining
+  replicas (``InferenceServer.drain()``), shrinking the training world
+  via the existing graceful reset — belongs to the hosting harness
+  (benchmarks/fleet.py, the driver), whose moves land as their own
+  world/replica journal records. Keeping the decision separate from the
+  move is what makes replay deterministic: the journal holds intents,
+  not side effects.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from . import constants as C
+
+
+def _env_float(name: str, default: float) -> float:
+    import os
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ArbiterPolicy:
+    """Hysteresis bounds for capacity re-bidding (docs/fleet.md lists
+    each knob's failure mode when mis-set)."""
+
+    #: Scale serving OUT when the worst replica queue depth sustains here.
+    queue_high: float = C.DEFAULT_ARBITER_QUEUE_HIGH
+    #: Reclaim a replica for training when it sustains at or below this.
+    queue_low: float = C.DEFAULT_ARBITER_QUEUE_LOW
+    #: Staleness that also triggers scale-out (0 = queue depth only).
+    staleness_high_s: float = C.DEFAULT_ARBITER_STALENESS_HIGH_S
+    #: The training world never shrinks below this.
+    min_training_np: int = C.DEFAULT_ARBITER_MIN_TRAINING_NP
+    #: Serving replica-count bounds.
+    min_replicas: int = C.DEFAULT_ARBITER_MIN_REPLICAS
+    max_replicas: int = C.DEFAULT_ARBITER_MAX_REPLICAS
+    #: Dead time between decisions (a graceful reset + replica warmup
+    #: must complete before the signals are trustworthy again).
+    cooldown_s: float = C.DEFAULT_ARBITER_COOLDOWN_S
+    #: Consecutive evaluations a signal must sustain before it counts.
+    sustain: int = C.DEFAULT_ARBITER_SUSTAIN
+
+    @classmethod
+    def from_env(cls) -> "ArbiterPolicy":
+        return cls(
+            queue_high=_env_float(C.ARBITER_QUEUE_HIGH_ENV,
+                                  C.DEFAULT_ARBITER_QUEUE_HIGH),
+            queue_low=_env_float(C.ARBITER_QUEUE_LOW_ENV,
+                                 C.DEFAULT_ARBITER_QUEUE_LOW),
+            staleness_high_s=_env_float(C.ARBITER_STALENESS_HIGH_ENV,
+                                        C.DEFAULT_ARBITER_STALENESS_HIGH_S),
+            min_training_np=max(1, _env_int(
+                C.ARBITER_MIN_TRAINING_NP_ENV,
+                C.DEFAULT_ARBITER_MIN_TRAINING_NP)),
+            min_replicas=max(0, _env_int(C.ARBITER_MIN_REPLICAS_ENV,
+                                         C.DEFAULT_ARBITER_MIN_REPLICAS)),
+            max_replicas=max(1, _env_int(C.ARBITER_MAX_REPLICAS_ENV,
+                                         C.DEFAULT_ARBITER_MAX_REPLICAS)),
+            cooldown_s=max(0.0, _env_float(C.ARBITER_COOLDOWN_ENV,
+                                           C.DEFAULT_ARBITER_COOLDOWN_S)),
+            sustain=max(1, _env_int(C.ARBITER_SUSTAIN_ENV,
+                                    C.DEFAULT_ARBITER_SUSTAIN)),
+        )
+
+
+class FleetArbiter:
+    """The policy loop the coordinator hosts.
+
+    ``total_hosts`` is the capacity being bid over: at every decision
+    ``serving_target + training_np == total_hosts`` (one host per
+    serving replica — the granularity the graceful reset moves in).
+    ``clock`` is injectable so hysteresis/cooldown tests run on a fake
+    clock, no real sleeps in tier-1.
+    """
+
+    def __init__(self, service, total_hosts: int,
+                 policy: Optional[ArbiterPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if total_hosts < 1:
+            raise ValueError(f"total_hosts must be >= 1, got {total_hosts}")
+        self._service = service
+        self._policy = policy or ArbiterPolicy.from_env()
+        self._clock = clock
+        self._total = int(total_hosts)
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_decision_t: Optional[float] = None
+        # Crash-restart seam: adopt the journal-replayed shape (and its
+        # seq) so the resumed arbiter continues the SAME rebalance. A
+        # fresh world starts at min_replicas serving.
+        view = service.fleet_view()
+        fleet = view.get("fleet")
+        if fleet is not None:
+            self._serving = int(fleet["serving_target"])
+            self._training = int(fleet["training_np"])
+        else:
+            self._serving = min(self._policy.max_replicas,
+                                max(self._policy.min_replicas, 1))
+            self._training = max(self._policy.min_training_np,
+                                 self._total - self._serving)
+        _telemetry.set_gauge("hvd_fleet_serving_target",
+                             float(self._serving))
+        _telemetry.set_gauge("hvd_fleet_training_np", float(self._training))
+
+    @property
+    def shape(self) -> dict:
+        """The current target fleet shape."""
+        return {"serving_target": self._serving,
+                "training_np": self._training}
+
+    # -- the policy ----------------------------------------------------------
+
+    def _in_cooldown(self, now: float) -> bool:
+        return (self._last_decision_t is not None
+                and now - self._last_decision_t < self._policy.cooldown_s)
+
+    def evaluate(self, now: Optional[float] = None) -> Optional[dict]:
+        """Run one policy evaluation against the coordinator-merged
+        signals. Returns the decision dict (journaled, with its ``seq``)
+        when the fleet shape changes, else None. Call on the hosting
+        loop's cadence — every evaluation advances the sustain streaks,
+        so cadence × ``sustain`` is the real reaction time."""
+        p = self._policy
+        now = self._clock() if now is None else now
+        sig = self._service.serving_signals()
+        overloaded = sig["queue_depth"] >= p.queue_high or (
+            p.staleness_high_s > 0
+            and sig["staleness_s"] > p.staleness_high_s)
+        idle = sig["queue_depth"] <= p.queue_low
+        self._high_streak = self._high_streak + 1 if overloaded else 0
+        self._low_streak = self._low_streak + 1 if idle else 0
+        if self._in_cooldown(now):
+            return None
+        serving, training = self._serving, self._training
+        reason = ""
+        if self._high_streak >= p.sustain and serving < p.max_replicas \
+                and training - 1 >= p.min_training_np:
+            serving, training = serving + 1, training - 1
+            reason = (f"overload: queue={sig['queue_depth']:.1f} "
+                      f"staleness={sig['staleness_s']:.1f}s sustained "
+                      f"{self._high_streak} evals")
+        elif self._low_streak >= p.sustain and serving > p.min_replicas \
+                and serving - 1 >= 0:
+            serving, training = serving - 1, training + 1
+            reason = (f"drained: queue={sig['queue_depth']:.1f} sustained "
+                      f"{self._low_streak} evals")
+        if (serving, training) == (self._serving, self._training):
+            return None
+        seq = self._service.record_arbiter_decision(serving, training,
+                                                    reason)
+        self._serving, self._training = serving, training
+        self._high_streak = self._low_streak = 0
+        self._last_decision_t = now
+        _telemetry.set_gauge("hvd_fleet_serving_target", float(serving))
+        _telemetry.set_gauge("hvd_fleet_training_np", float(training))
+        get_logger().info("arbiter: decision #%d serving=%d training=%d "
+                          "(%s)", seq, serving, training, reason)
+        return {"seq": seq, "serving_target": serving,
+                "training_np": training, "reason": reason}

@@ -214,27 +214,41 @@ class BlobPeerClient:
 
 def assign_sources(missing: Iterable[str],
                    possession: Dict[int, Iterable[str]],
-                   owner: int) -> Dict[str, List[int]]:
+                   owner: int,
+                   hosts: Optional[Dict[int, str]] = None,
+                   local_host: Optional[str] = None) -> Dict[str, List[int]]:
     """Ordered candidate sources for each missing digest.
 
     Deterministic across ranks (pure function of the allgathered
     possession sets): candidates are the possessing ranks ordered by a
     per-(digest, rank) hash so concurrent fetchers spread across
     possessors instead of herding on one source; the manifest ``owner``
-    wins hash ties (then lowest rank). A digest NO rank possesses maps
-    to ``[]`` — the caller escalates."""
+    wins hash ties (then lowest rank). When ``hosts`` (rank → hostname,
+    from the persisted world's addrs) and ``local_host`` are given,
+    SAME-HOST possessors are elected first — a pod-local copy crosses
+    loopback/ICI, not the data-center fabric — with the hash spread
+    ordering within each host class, and cross-host possessors still
+    listed after them as fallback (a pod whose local possessors all died
+    must not strand the fetch). A digest NO rank possesses maps to
+    ``[]`` — the caller escalates."""
     have = {r: set(ds) for r, ds in possession.items()}
+    pod_aware = bool(hosts) and local_host is not None
 
     def _spread(digest: str, r: int) -> int:
         return int(hashlib.blake2b(f"{digest}:{r}".encode(),
                                    digest_size=8).hexdigest(), 16)
+
+    def _remote(r: int) -> bool:
+        # False (sorts first) for same-host possessors in pod-aware mode;
+        # constant otherwise, leaving the classic ordering untouched.
+        return pod_aware and hosts.get(r) != local_host
 
     out: Dict[str, List[int]] = {}
     for digest in missing:
         possessors = [r for r, ds in have.items() if digest in ds]
         out[digest] = sorted(
             possessors,
-            key=lambda r: (_spread(digest, r), r != owner, r))
+            key=lambda r: (_remote(r), _spread(digest, r), r != owner, r))
     return out
 
 

@@ -156,6 +156,41 @@ DEFAULT_RESUME_TIMEOUT_S = 120.0
 RESUME_FETCH_TIMEOUT_ENV = "HOROVOD_RESUME_FETCH_TIMEOUT_SECONDS"
 DEFAULT_RESUME_FETCH_TIMEOUT_S = 30.0
 
+#: env: seconds a registered serving replica may go without a heartbeat
+#: (any ``/world?replica=<id>`` arrival or reply bumps it) before the
+#: coordinator health-gates it OUT of the ``/replicas`` list. The gate is
+#: journaled as an ``op:"replica"`` deregister so a crash-restarted
+#: coordinator replays to the same fleet membership; a replica restored
+#: from the journal gets one fresh grace window to re-heartbeat. Replica
+#: agents pace their long-poll bound to ``grace / 3`` so a healthy
+#: replica's parked poll can never be mistaken for a missed deadline.
+REPLICA_GRACE_ENV = "HOROVOD_REPLICA_GRACE_SECONDS"
+DEFAULT_REPLICA_GRACE_S = 10.0
+
+#: Fleet-arbiter hysteresis knobs (elastic/arbiter.py; docs/fleet.md).
+#: Scale serving OUT when the worst per-replica queue depth stays at or
+#: above QUEUE_HIGH (or staleness above STALENESS_HIGH) for SUSTAIN
+#: consecutive evaluations; reclaim a replica for training when the worst
+#: queue stays at or below QUEUE_LOW just as long. COOLDOWN seconds must
+#: pass between decisions so the fleet never flaps host-moves faster than
+#: a graceful reset + replica warmup can complete.
+ARBITER_QUEUE_HIGH_ENV = "HOROVOD_ARBITER_QUEUE_HIGH"
+DEFAULT_ARBITER_QUEUE_HIGH = 8.0
+ARBITER_QUEUE_LOW_ENV = "HOROVOD_ARBITER_QUEUE_LOW"
+DEFAULT_ARBITER_QUEUE_LOW = 1.0
+ARBITER_STALENESS_HIGH_ENV = "HOROVOD_ARBITER_STALENESS_HIGH_SECONDS"
+DEFAULT_ARBITER_STALENESS_HIGH_S = 0.0   # 0 = staleness does not trigger
+ARBITER_MIN_TRAINING_NP_ENV = "HOROVOD_ARBITER_MIN_TRAINING_NP"
+DEFAULT_ARBITER_MIN_TRAINING_NP = 1
+ARBITER_MIN_REPLICAS_ENV = "HOROVOD_ARBITER_MIN_REPLICAS"
+DEFAULT_ARBITER_MIN_REPLICAS = 1
+ARBITER_MAX_REPLICAS_ENV = "HOROVOD_ARBITER_MAX_REPLICAS"
+DEFAULT_ARBITER_MAX_REPLICAS = 4
+ARBITER_COOLDOWN_ENV = "HOROVOD_ARBITER_COOLDOWN_SECONDS"
+DEFAULT_ARBITER_COOLDOWN_S = 30.0
+ARBITER_SUSTAIN_ENV = "HOROVOD_ARBITER_SUSTAIN"
+DEFAULT_ARBITER_SUSTAIN = 2
+
 #: env: RPC attempts per logical coordinator call (>=1; 1 = no retry).
 RPC_RETRIES_ENV = "HOROVOD_COORDINATOR_RPC_RETRIES"
 DEFAULT_RPC_RETRIES = 3

@@ -55,6 +55,7 @@ def empty_state() -> Dict[str, Any]:
         "failures": [], "failure_seq": 0, "registrations": {},
         "metrics": {},
         "publish": None, "publish_seq": 0,
+        "replicas": {}, "arbiter_seq": 0, "fleet": None,
     }
 
 
@@ -98,6 +99,37 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         # publish_seq is the serving processes' own long-poll cursor.
         state["publish"] = dict(rec["record"])
         state["publish_seq"] = int(state.get("publish_seq", 0)) + 1
+    elif op == "replica":
+        # Serving-replica registry mutation (serving/fleet.py via the
+        # coordinator's /replica endpoint). Like publish/metrics it never
+        # bumps version/failure_seq — replica churn is not a membership
+        # event for the TRAINING world. Heartbeats are deliberately NOT
+        # journaled (too chatty; liveness is re-proven after a restart) —
+        # only register / drain / deregister reach the journal, so replay
+        # lands on the same fleet membership the live service had.
+        reps = state.setdefault("replicas", {})
+        action = rec.get("action", "register")
+        rid = str(rec["replica_id"])
+        if action == "deregister":
+            reps.pop(rid, None)
+        elif action == "drain":
+            if rid in reps:
+                reps[rid]["draining"] = True
+        else:
+            reps[rid] = {"addr": str(rec["addr"]),
+                         "rank": int(rec.get("rank", 0)),
+                         "draining": False}
+    elif op == "arbiter":
+        # One fleet-arbiter decision (elastic/arbiter.py): the target
+        # fleet shape it bid for, under its own monotonic sequence.
+        # Replaying the journal therefore lands a crash-restarted
+        # coordinator on EXACTLY the fleet shape its predecessor last
+        # decided — the arbiter resumes from there instead of from zero
+        # (the chaos-tier "kill the coordinator mid-rebalance" proof).
+        state["arbiter_seq"] = int(rec["seq"])
+        state["fleet"] = {"serving_target": int(rec["serving_target"]),
+                          "training_np": int(rec["training_np"]),
+                          "reason": str(rec.get("reason", ""))}
     elif op == "snapshot":
         # Compaction marker: reset to the embedded live state.
         snap = rec["state"]
@@ -116,6 +148,11 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         pub = snap.get("publish")
         state["publish"] = dict(pub) if pub is not None else None
         state["publish_seq"] = int(snap.get("publish_seq", 0))
+        state["replicas"] = {str(k): dict(v) for k, v
+                             in snap.get("replicas", {}).items()}
+        state["arbiter_seq"] = int(snap.get("arbiter_seq", 0))
+        fleet = snap.get("fleet")
+        state["fleet"] = dict(fleet) if fleet is not None else None
     else:
         return False
     return True

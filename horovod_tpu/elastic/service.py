@@ -196,8 +196,10 @@ class CoordinatorService:
     would silently disable the peer-liveness rescue)."""
 
     def __init__(self, secret_key: bytes, bind_host: str = "0.0.0.0",
-                 journal_path: Optional[str] = None, restore: bool = False):
+                 journal_path: Optional[str] = None, restore: bool = False,
+                 clock: Callable[[], float] = time.monotonic):
         self._key = secret_key
+        self._clock = clock
         self._lock = threading.Lock()
         # Long-poll park/wake shares the service lock: mutators already
         # hold it, so notify_all from inside their critical sections is
@@ -242,6 +244,22 @@ class CoordinatorService:
         # process learns of a publish immediately without new RPCs.
         self._publish: Optional[dict] = None
         self._publish_seq = 0
+        # Serving-replica registry (serving/fleet.py): replica_id ->
+        # {"addr", "rank", "draining", "last_seen"}. Registration/drain/
+        # deregistration are journaled (op:"replica"); heartbeats —
+        # ``last_seen`` bumps from ``/world?replica=<id>`` arrivals and
+        # replies — are ephemeral. A replica silent past
+        # ``HOROVOD_REPLICA_GRACE_SECONDS`` is health-gated out of
+        # ``/replicas`` (journaled, so replay agrees). Replica churn never
+        # bumps version/failure_seq: the TRAINING world's delta cursors
+        # must not move for serving-plane membership.
+        self._replicas: Dict[str, dict] = {}
+        # Fleet-arbiter decision state (elastic/arbiter.py): its own
+        # monotonic sequence plus the last decided fleet shape, both
+        # journal-replayed so a coordinator crash-restart resumes the
+        # SAME rebalance instead of forgetting it mid-move.
+        self._arbiter_seq = 0
+        self._fleet: Optional[dict] = None
         self._journal = CoordinatorJournal(journal_path) if journal_path \
             else None
         if restore and journal_path:
@@ -257,6 +275,19 @@ class CoordinatorService:
                 self._metrics = state.get("metrics", {})
                 self._publish = state.get("publish")
                 self._publish_seq = int(state.get("publish_seq", 0))
+                # Restored replicas get ONE fresh grace window from the
+                # restart (last_seen is liveness, not membership — the
+                # journal cannot know who survived the coordinator).
+                now = self._clock()
+                self._replicas = {
+                    str(k): {"addr": v["addr"],
+                             "rank": int(v.get("rank", 0)),
+                             "draining": bool(v.get("draining", False)),
+                             "last_seen": now}
+                    for k, v in state.get("replicas", {}).items()}
+                self._arbiter_seq = int(state.get("arbiter_seq", 0))
+                fleet = state.get("fleet")
+                self._fleet = dict(fleet) if fleet is not None else None
                 get_logger().info(
                     "coordinator state restored from journal %s "
                     "(version=%d failure_seq=%d hosts=%s)", journal_path,
@@ -313,6 +344,9 @@ class CoordinatorService:
                     # signature header is still set for our own client).
                     self._reply_text(svc.metrics_text())
                     return
+                if parsed.path == "/replicas":
+                    self._reply(svc.replicas_view())
+                    return
                 if parsed.path != "/world":
                     get_logger().debug(
                         "coordinator: unknown GET path %s from %s",
@@ -330,11 +364,21 @@ class CoordinatorService:
                 since_v = _qnum("since_v", int)
                 since_s = _qnum("since_s", int)
                 since_p = _qnum("since_p", int)
+                replica_id = None
+                try:
+                    replica_id = q["replica"][0] or None
+                except (KeyError, IndexError):
+                    pass
                 wait_s = min(max(_qnum("wait", float) or 0.0, 0.0),
                              C.LONG_POLL_CAP_S)
                 cursor = (since_v + since_s) \
                     if since_v is not None and since_s is not None else None
                 with svc._cond:
+                    # Replica heartbeat rides the existing poll: touch at
+                    # arrival AND at reply, so a request parked in the
+                    # long-poll below still proves liveness on both ends
+                    # of the park.
+                    svc._touch_replica_locked(replica_id)
                     if (cursor is not None or since_p is not None) \
                             and wait_s > 0:
                         svc._cond.wait_for(
@@ -344,6 +388,7 @@ class CoordinatorService:
                             (since_p is not None and
                              svc._publish_seq != since_p),
                             timeout=wait_s)
+                    svc._touch_replica_locked(replica_id)
                     reply = svc._world_reply_locked(since_v, since_s)
                     if since_p is not None:
                         # Publish extras ride as reply-level keys the
@@ -383,6 +428,11 @@ class CoordinatorService:
                     # (serving/publisher.py): journaled, wakes publish
                     # long-pollers, never bumps version/failure_seq.
                     ok = svc._record_publish(msg)
+                    self._reply({"ok": ok})
+                elif self.path == "/replica":
+                    # Serving-replica lifecycle (serving/fleet.py):
+                    # register / drain / deregister, journaled.
+                    ok = svc._record_replica(msg)
                     self._reply({"ok": ok})
                 else:
                     get_logger().debug(
@@ -462,6 +512,13 @@ class CoordinatorService:
             state["publish"] = dict(self._publish) \
                 if self._publish is not None else None
             state["publish_seq"] = self._publish_seq
+            state["replicas"] = {
+                k: {"addr": v["addr"], "rank": v["rank"],
+                    "draining": v["draining"]}
+                for k, v in self._replicas.items()}
+            state["arbiter_seq"] = self._arbiter_seq
+            state["fleet"] = dict(self._fleet) \
+                if self._fleet is not None else None
             self._journal.compact(state)
 
     def _record_register(self, process_id: int, ts: float) -> None:
@@ -540,6 +597,174 @@ class CoordinatorService:
         with self._lock:
             rec = dict(self._publish) if self._publish is not None else None
             return self._publish_seq, rec
+
+    # -- serving-replica registry (serving/fleet.py; docs/fleet.md) ----------
+
+    def _replica_grace_s(self) -> float:
+        return max(0.0, _env_float(C.REPLICA_GRACE_ENV,
+                                   C.DEFAULT_REPLICA_GRACE_S))
+
+    def _touch_replica_locked(self, replica_id: Optional[str]) -> None:
+        """Heartbeat: bump ``last_seen`` for a replica riding its poll.
+        Unknown ids are ignored (a pruned replica must re-register, not
+        resurrect itself through a stale poll loop)."""
+        if replica_id is None:
+            return
+        rep = self._replicas.get(str(replica_id))
+        if rep is not None:
+            rep["last_seen"] = self._clock()
+
+    def _prune_replicas_locked(self, now: float) -> None:
+        """Health gate: drop replicas silent past the grace window.
+        Journaled as deregisters so a crash-restart replays to the same
+        membership the live service was serving."""
+        grace = self._replica_grace_s()
+        if grace <= 0:
+            return
+        for rid in [r for r, v in self._replicas.items()
+                    if now - v["last_seen"] > grace]:
+            self._replicas.pop(rid)
+            _telemetry.inc("hvd_fleet_replica_expired_total")
+            get_logger().warning(
+                "coordinator: replica %s health-gated out (no heartbeat "
+                "for > %.1fs)", rid, grace)
+            if self._journal:
+                self._journal.append({"op": "replica",
+                                      "action": "deregister",
+                                      "replica_id": rid,
+                                      "reason": "grace"})
+                self._maybe_compact_locked()
+
+    def _record_replica(self, msg: dict) -> bool:
+        """Apply one replica lifecycle mutation (POST /replica):
+        ``{"action": "register"|"drain"|"deregister", "replica_id": ...,
+        "addr": ..., "rank": ...}``. Journaled; never bumps
+        version/failure_seq."""
+        try:
+            action = str(msg.get("action", "register"))
+            rid = str(msg["replica_id"])
+            if action not in ("register", "drain", "deregister"):
+                raise ValueError(action)
+            if action == "register":
+                addr = str(msg["addr"])
+                rank = int(msg.get("rank", 0))
+        except (KeyError, TypeError, ValueError):
+            get_logger().debug("coordinator: malformed replica message "
+                               "ignored: %r", msg)
+            return False
+        with self._lock:
+            if action == "register":
+                self._replicas[rid] = {"addr": addr, "rank": rank,
+                                       "draining": False,
+                                       "last_seen": self._clock()}
+                rec = {"op": "replica", "action": "register",
+                       "replica_id": rid, "addr": addr, "rank": rank}
+            elif action == "drain":
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    return False
+                rep["draining"] = True
+                rec = {"op": "replica", "action": "drain",
+                       "replica_id": rid}
+            else:
+                if self._replicas.pop(rid, None) is None:
+                    return True     # idempotent: already gone
+                rec = {"op": "replica", "action": "deregister",
+                       "replica_id": rid,
+                       "reason": str(msg.get("reason", ""))}
+            if self._journal:
+                self._journal.append(rec)
+                self._maybe_compact_locked()
+        get_logger().info("coordinator: replica %s %s", rid, action)
+        return True
+
+    def replicas_view(self) -> dict:
+        """The ``GET /replicas`` payload: currently-healthy replicas
+        (expired ones pruned right here — the list a client fails over
+        against must never name a dead replica for longer than the grace
+        window), plus the arbiter's fleet shape for observability."""
+        with self._lock:
+            self._prune_replicas_locked(self._clock())
+            reps = [{"id": rid, "addr": v["addr"], "rank": v["rank"],
+                     "draining": v["draining"]}
+                    for rid, v in sorted(self._replicas.items())]
+            fleet = dict(self._fleet) if self._fleet is not None else None
+            return {"replicas": reps, "fleet": fleet,
+                    "arbiter_seq": self._arbiter_seq}
+
+    def replicas_snapshot(self) -> Dict[str, dict]:
+        """Raw registry copy (tests / driver observability) — no pruning."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._replicas.items()}
+
+    # -- fleet arbiter decisions (elastic/arbiter.py) ------------------------
+
+    def record_arbiter_decision(self, serving_target: int, training_np: int,
+                                reason: str = "") -> int:
+        """Journal one arbiter decision under the arbiter's own monotonic
+        sequence and adopt it as the current fleet shape. Returns the new
+        sequence number. Never bumps version/failure_seq — enacting the
+        shape (graceful training reset, replica start/stop) is the
+        harness's move and lands as its own world/replica records."""
+        with self._lock:
+            self._arbiter_seq += 1
+            self._fleet = {"serving_target": int(serving_target),
+                           "training_np": int(training_np),
+                           "reason": str(reason)}
+            if self._journal:
+                self._journal.append({"op": "arbiter",
+                                      "seq": self._arbiter_seq,
+                                      "serving_target": int(serving_target),
+                                      "training_np": int(training_np),
+                                      "reason": str(reason)})
+                self._maybe_compact_locked()
+            seq = self._arbiter_seq
+        _telemetry.inc("hvd_fleet_arbiter_decisions_total")
+        get_logger().info(
+            "coordinator: arbiter decision #%d -> serving=%d training=%d "
+            "(%s)", seq, serving_target, training_np, reason)
+        return seq
+
+    def fleet_view(self) -> dict:
+        """``{"arbiter_seq", "fleet"}`` — the last decided shape (None
+        before any decision). The arbiter seeds itself from this after a
+        coordinator crash-restart."""
+        with self._lock:
+            return {"arbiter_seq": self._arbiter_seq,
+                    "fleet": dict(self._fleet)
+                    if self._fleet is not None else None}
+
+    def serving_signals(self) -> dict:
+        """The arbiter's inputs, read from the coordinator-merged metrics
+        (core/telemetry.py wire shape): worst per-rank serving queue
+        depth and staleness across ranks >= the serving rank band, and
+        the median training step wall time across the rest."""
+        with self._lock:
+            ranks = {int(r): v for r, v in self._metrics.items()}
+        from ..serving import constants as SC
+        band = SC.serving_rank()
+
+        def _vals(g: dict, name: str) -> list:
+            # Series ids are ``name`` or ``name{labels}`` (telemetry.py
+            # _series_id) — match both.
+            return [float(v) for k, v in g.items()
+                    if k == name or k.startswith(name + "{")]
+
+        queue_depth = 0.0
+        staleness = 0.0
+        steps = []
+        for rank, m in ranks.items():
+            g = m.get("g", {})
+            if rank >= band:
+                queue_depth = max([queue_depth] + _vals(
+                    g, "hvd_serving_queue_depth"))
+                staleness = max([staleness] + _vals(
+                    g, "hvd_serving_staleness_seconds"))
+            else:
+                steps.extend(_vals(g, "hvd_step_wall_seconds"))
+        steps.sort()
+        return {"queue_depth": queue_depth, "staleness_s": staleness,
+                "step_wall_s": steps[len(steps) // 2] if steps else None}
 
     def metrics_snapshot(self) -> Dict[str, dict]:
         """Per-rank compact snapshots (deep-copied) — the incident
@@ -661,9 +886,14 @@ class CoordinatorClient:
                  sleep: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[random.Random] = None,
-                 delta: bool = True, watch_publish: bool = False):
+                 delta: bool = True, watch_publish: bool = False,
+                 replica_id: Optional[str] = None):
         self._base = f"http://{addr}"
         self._key = secret_key
+        #: Serving-replica identity (serving/fleet.py): when set, every
+        #: ``/world`` poll carries ``replica=<id>`` so the poll doubles as
+        #: the replica's heartbeat — no extra RPC surface for liveness.
+        self.replica_id = replica_id
         #: False = never send a cursor: every /world is a full fetch (the
         #: pre-delta wire protocol — the A/B baseline arm of
         #: benchmarks/control_plane.py; no production caller sets this).
@@ -983,6 +1213,8 @@ class CoordinatorClient:
                        f"since_s={w['failure_seq']}"]
         if self._watch_publish:
             params.append(f"since_p={self.publish_seq}")
+        if self.replica_id:
+            params.append(f"replica={self.replica_id}")
         if params and wait is not None and wait > 0:
             bound = min(float(wait), C.LONG_POLL_CAP_S)
             params.append(f"wait={bound:g}")
@@ -1021,6 +1253,40 @@ class CoordinatorClient:
         body = json.dumps({"record": dict(record)}).encode()
         reply = self._call("/publish", data=body)
         return bool(reply and reply.get("ok"))
+
+    def register_replica(self, replica_id: str, addr: str,
+                         rank: int = 0) -> bool:
+        """Register one serving replica (serving/fleet.py ReplicaAgent).
+        Journaled server-side; the replica then stays in ``/replicas``
+        for as long as its polls keep heartbeating inside
+        ``HOROVOD_REPLICA_GRACE_SECONDS``."""
+        body = json.dumps({"action": "register",
+                           "replica_id": str(replica_id),
+                           "addr": str(addr), "rank": int(rank)}).encode()
+        reply = self._call("/replica", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def drain_replica(self, replica_id: str) -> bool:
+        """Mark a replica draining: it stays registered (in-flight work
+        finishes) but failover clients stop routing NEW traffic to it."""
+        body = json.dumps({"action": "drain",
+                           "replica_id": str(replica_id)}).encode()
+        reply = self._call("/replica", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def deregister_replica(self, replica_id: str, reason: str = "") -> bool:
+        """Remove a replica from the registry (graceful drain complete,
+        or the hosting agent shutting down). Idempotent server-side."""
+        body = json.dumps({"action": "deregister",
+                           "replica_id": str(replica_id),
+                           "reason": str(reason)}).encode()
+        reply = self._call("/replica", data=body)
+        return bool(reply and reply.get("ok"))
+
+    def get_replicas(self) -> Optional[dict]:
+        """The coordinator's current healthy-replica list + fleet shape
+        (``GET /replicas``), or None on transient failure."""
+        return self._call("/replicas")
 
     def register_batch(self, process_ids: Iterable[int]) -> bool:
         """Announce a whole host's workers in ONE request (and one journal

@@ -897,12 +897,19 @@ def load_persisted_world(commit_dir: str,
                                   "possess": possessed})
         possession = {int(w["rank"]): set(w["possess"]) for w in world}
         addrs = {int(w["rank"]): w["addr"] for w in world}
+        # Pod-local preference: elect same-host possessors first (the
+        # copy crosses loopback, not the fabric); host = the addr's host
+        # part, mine taken from my own advertised serve addr.
+        hosts = {r: a.rsplit(":", 1)[0] for r, a in addrs.items()}
+        local_host = hosts.get(me)
         # The skeleton names the leaves; without it the selector cannot
         # run — fetch it first if missing (tiny blob, same failover).
         if not store.has_blob(manifest["skeleton"]):
             skel = [manifest["skeleton"]]
             s = _mesh.fetch_missing(
-                store, skel, _mesh.assign_sources(skel, possession, owner),
+                store, skel,
+                _mesh.assign_sources(skel, possession, owner,
+                                     hosts=hosts, local_host=local_host),
                 addrs, key, deadline=deadline)
             for k in ("blobs_fetched", "bytes_fetched", "retries"):
                 stats[k] += s[k]
@@ -911,7 +918,9 @@ def load_persisted_world(commit_dir: str,
         needed = _manifest_need(store, manifest, shard_selector)
         missing = [d for d in needed if not store.has_blob(d)]
         s = _mesh.fetch_missing(
-            store, missing, _mesh.assign_sources(missing, possession, owner),
+            store, missing,
+            _mesh.assign_sources(missing, possession, owner,
+                                 hosts=hosts, local_host=local_host),
             addrs, key, deadline=deadline)
         for k in ("blobs_fetched", "bytes_fetched", "retries"):
             stats[k] += s[k]

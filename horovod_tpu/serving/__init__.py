@@ -11,10 +11,16 @@ Three parts, layered on the elastic/CAS infrastructure:
   bucketed dynamic batching and ``hvd_serving_*`` telemetry;
 - :mod:`~horovod_tpu.serving.decode` — continuous-batching LLM decode
   over the paged KV-cache (models/decode.py): slot admit/retire,
-  block allocator, swap-aware engine behind ``POST /generate``.
+  block allocator, swap-aware engine behind ``POST /generate``;
+- :mod:`~horovod_tpu.serving.fleet` — multi-replica membership
+  (coordinator-journaled register/heartbeat/drain) and the failover
+  client that retries traffic across the live replica set
+  (docs/fleet.md).
 """
 
 from .decode import BlockAllocator, DecodeEngine, DecodeRequest  # noqa: F401
+from .fleet import (FleetClient, FleetOverloadedError,           # noqa: F401
+                    FleetRequestError, ReplicaAgent)
 from .publisher import Publisher, attach, detach, leaves_digest  # noqa: F401
 from .registry import ModelRegistry, ServedModel                 # noqa: F401
 from .server import InferenceServer, pad_to_bucket               # noqa: F401

@@ -88,6 +88,29 @@ DEFAULT_DECODE_SWAP_POLICY = "refill"
 DECODE_TP_ENV = "HOROVOD_DECODE_TP"
 DEFAULT_DECODE_TP = 0
 
+#: Admission-queue bound (docs/fleet.md "Overload containment"): a
+#: ``/predict`` arriving while this many requests are already queued is
+#: SHED — 429 + ``Retry-After`` — instead of admitted. Bounding the
+#: queue is what keeps overload from cascading: an unbounded queue turns
+#: a traffic spike into unbounded latency for EVERY request (each waits
+#: behind the spike), then into timeout storms and retry amplification.
+#: 0 = unbounded (the pre-fleet behavior; the
+#: ``lint-unbounded-admission`` trap flags handler code written that
+#: way).
+QUEUE_MAX_ENV = "HOROVOD_SERVING_QUEUE_MAX"
+DEFAULT_QUEUE_MAX = 256
+
+#: ``Retry-After`` seconds advertised on shed (429) replies.
+SHED_RETRY_AFTER_ENV = "HOROVOD_SERVING_RETRY_AFTER_SECONDS"
+DEFAULT_SHED_RETRY_AFTER_S = 1.0
+
+#: Readiness gate (GET /healthz): a replica whose served model is staler
+#: than this is NOT ready (503) — the fleet's replica list must never
+#: route traffic to a replica that lost its publish feed. 0 disables the
+#: staleness gate (liveness stays on GET /livez either way).
+MAX_STALENESS_ENV = "HOROVOD_SERVING_MAX_STALENESS_SECONDS"
+DEFAULT_MAX_STALENESS_S = 0.0
+
 #: Speculative-decode window width (docs/serving.md "Speculative
 #: decode"): tokens scored per verify call = 1 pending token + K-1
 #: host-drafted candidates. 0 (or 1) disables speculation — the engine
@@ -200,3 +223,16 @@ def decode_tp() -> int:
 
 def decode_spec_k() -> int:
     return max(0, _env_int(DECODE_SPEC_K_ENV, DEFAULT_DECODE_SPEC_K))
+
+
+def queue_max() -> int:
+    return max(0, _env_int(QUEUE_MAX_ENV, DEFAULT_QUEUE_MAX))
+
+
+def shed_retry_after_s() -> float:
+    return max(0.0, _env_float(SHED_RETRY_AFTER_ENV,
+                               DEFAULT_SHED_RETRY_AFTER_S))
+
+
+def max_staleness_s() -> float:
+    return max(0.0, _env_float(MAX_STALENESS_ENV, DEFAULT_MAX_STALENESS_S))

@@ -1,0 +1,313 @@
+"""Multi-replica serving fleet: registration, heartbeat, failover
+(docs/fleet.md; ROADMAP item 3(c)'s registry + item 5's fleet substrate).
+
+No upstream analog (SURVEY.md §2: upstream elastic only ever served
+training). Two halves:
+
+- :class:`ReplicaAgent` — the replica side. Registers its
+  :class:`~.server.InferenceServer` with the coordinator (``POST
+  /replica``, journaled), then runs the ONE watch loop the serving plane
+  already needed: a publish long-poll (``/world?since_p=...``) that now
+  also carries ``replica=<id>`` so every poll doubles as the heartbeat —
+  liveness costs zero extra RPCs. The poll bound is paced to
+  ``HOROVOD_REPLICA_GRACE_SECONDS / 3`` so a healthy replica can never
+  miss its deadline just by being parked. Per-replica ``hvd_serving_*``
+  gauges are pushed on the same cadence (coordinator ``/metrics`` rolls
+  them up). ``drain()`` runs the arbiter's reclaim sequence: mark
+  draining at the coordinator (routing stops), drain the server
+  (in-flight finishes), deregister.
+- :class:`FleetClient` — the traffic side. Keeps a cached copy of the
+  coordinator's ``/replicas`` list and retries each request across
+  healthy replicas: a dead or wedged replica (socket error, timeout,
+  5xx) triggers refresh + failover to the next, so a ``replica_kill``
+  mid-traffic costs a retry, not a lost request. A 429 shed from one
+  replica fails over too (another may have queue room); only when EVERY
+  healthy replica sheds does the request surface as
+  :class:`FleetOverloadedError` — backpressure the caller must hear,
+  never a hang, never a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import telemetry as _telemetry
+from ..core.logging import get_logger
+from ..elastic import constants as EC
+from . import constants as SC
+
+
+def _replica_grace_s() -> float:
+    try:
+        return max(0.0, float(os.environ.get(
+            EC.REPLICA_GRACE_ENV, str(EC.DEFAULT_REPLICA_GRACE_S))))
+    except ValueError:
+        return EC.DEFAULT_REPLICA_GRACE_S
+
+
+class FleetRequestError(RuntimeError):
+    """No replica could answer (every candidate dead/erroring)."""
+
+
+class FleetOverloadedError(FleetRequestError):
+    """Every healthy replica shed the request (429) — the fleet is at
+    admission capacity. Carries the server-advertised ``retry_after_s``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaAgent:
+    """Joins one :class:`~.server.InferenceServer` to the fleet.
+
+    ``client`` must be a :class:`~..elastic.service.CoordinatorClient`
+    built with ``watch_publish=True`` (the agent's loop is the publish
+    watcher); the agent stamps its ``replica_id`` onto it so every poll
+    heartbeats. ``rank`` defaults to the serving rank band
+    (``HOROVOD_SERVING_RANK``) — give concurrent replicas distinct ranks
+    (band + index) so the coordinator's rollup keeps them separable.
+    """
+
+    def __init__(self, server, client, replica_id: Optional[str] = None,
+                 rank: Optional[int] = None):
+        self.server = server
+        self.client = client
+        self.replica_id = replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+        self._rank = SC.serving_rank() if rank is None else int(rank)
+        client.replica_id = self.replica_id
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        self.registered = bool(client.register_replica(
+            self.replica_id, server.addr(), self._rank))
+        # Deregistration is hung on the server's drain completion so ANY
+        # drain path (arbiter reclaim, shutdown) leaves the routing set.
+        server.add_drained_callback(
+            lambda: client.deregister_replica(self.replica_id,
+                                              reason="drained"))
+
+    # -- the watch loop ------------------------------------------------------
+
+    def _wait_bound(self) -> float:
+        grace = _replica_grace_s()
+        bound = SC.serving_long_poll_s()
+        if grace > 0:
+            # Heartbeat inside the grace window with margin: a poll parks
+            # at most grace/3, so even one dropped round leaves slack.
+            bound = min(bound, grace / 3.0)
+        return max(0.05, bound)
+
+    def start(self) -> None:
+        """Spawn the watch thread: publish adoption + heartbeat +
+        metrics push, one long-poll per round."""
+
+        def _watch() -> None:
+            while not self._closing:
+                try:
+                    self.server.registry.poll_coordinator(
+                        self.client, wait=self._wait_bound())
+                except Exception as err:  # noqa: BLE001 — keep watching
+                    get_logger().warning(
+                        "replica %s watch round failed: %s",
+                        self.replica_id, err)
+                stale = self.server.registry.staleness_s()
+                if stale is not None:
+                    _telemetry.set_gauge("hvd_serving_staleness_seconds",
+                                         stale)
+                delta = _telemetry.export_delta()
+                if delta:
+                    try:
+                        self.client.push_metrics(self._rank, delta)
+                    except Exception as err:  # noqa: BLE001 — best-effort
+                        get_logger().debug(
+                            "replica %s metrics push failed: %s",
+                            self.replica_id, err)
+
+        self._thread = threading.Thread(
+            target=_watch, name=f"hvd-replica-{self.replica_id}",
+            daemon=True)
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """The arbiter's reclaim sequence: stop routing (coordinator
+        drain mark), stop admitting + finish in-flight (server drain —
+        which fires the deregister callback), stop watching."""
+        try:
+            self.client.drain_replica(self.replica_id)
+        except Exception as err:  # noqa: BLE001 — drain locally regardless
+            get_logger().warning("replica %s coordinator drain failed: %s",
+                                 self.replica_id, err)
+        ok = self.server.drain(timeout_s=timeout_s)
+        self._closing = True
+        return ok
+
+    def close(self, deregister: bool = True) -> None:
+        self._closing = True
+        if deregister and self.registered:
+            try:
+                self.client.deregister_replica(self.replica_id,
+                                               reason="close")
+            except Exception:   # noqa: BLE001 — teardown is best-effort
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class FleetClient:
+    """Failover HTTP client against the coordinator's replica list.
+
+    ``coord`` is a :class:`~..elastic.service.CoordinatorClient` (its
+    :meth:`get_replicas` feeds the routing set); tests may instead pass a
+    static ``replicas=[addr, ...]`` list. ``clock``/``sleep`` are
+    injectable for fake-clock tests."""
+
+    def __init__(self, coord=None, replicas: Optional[List[str]] = None,
+                 timeout_s: float = 10.0, refresh_s: float = 1.0,
+                 max_tries: int = 8,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        if coord is None and replicas is None:
+            raise ValueError("need a coordinator client or a replica list")
+        self._coord = coord
+        self._static = list(replicas) if replicas is not None else None
+        self._timeout_s = float(timeout_s)
+        self._refresh_s = float(refresh_s)
+        self._max_tries = int(max_tries)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._addrs: List[str] = list(self._static or [])
+        self._last_refresh: Optional[float] = None
+        #: Request accounting: completed, failovers absorbed, sheds seen.
+        self.stats: Dict[str, int] = {"requests": 0, "failovers": 0,
+                                      "shed_seen": 0, "refreshes": 0}
+        self._rr = 0
+        if coord is not None:
+            self.refresh(force=True)
+
+    # -- routing set ---------------------------------------------------------
+
+    def refresh(self, force: bool = False) -> None:
+        """Re-pull ``/replicas`` (throttled to ``refresh_s`` unless
+        forced — a failover forces, so a died replica leaves the routing
+        set at failure time, not at the next tick)."""
+        if self._coord is None:
+            return
+        now = self._clock()
+        with self._lock:
+            if not force and self._last_refresh is not None \
+                    and now - self._last_refresh < self._refresh_s:
+                return
+            self._last_refresh = now
+        view = self._coord.get_replicas()
+        if view is None:
+            return      # transient: keep the cached set
+        addrs = [r["addr"] for r in view.get("replicas", [])
+                 if not r.get("draining")]
+        with self._lock:
+            self._addrs = addrs
+            self.stats["refreshes"] += 1
+
+    def healthy_addrs(self) -> List[str]:
+        with self._lock:
+            return list(self._addrs)
+
+    # -- the failover request ------------------------------------------------
+
+    def _post(self, addr: str, data: bytes) -> dict:
+        req = urllib.request.Request(
+            f"http://{addr}/predict", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
+            return json.loads(r.read())
+
+    def predict(self, inputs: Any,
+                deadline_s: Optional[float] = None,
+                max_tries: Optional[int] = None) -> dict:
+        """One request, retried across healthy replicas until answered.
+
+        Raises :class:`FleetOverloadedError` when every healthy replica
+        sheds (the caller backs off — that is the contract that keeps
+        overload from cascading through retries), and
+        :class:`FleetRequestError` when no replica can answer at all.
+        A per-request ``deadline_s`` rides to the replica as the JSON
+        deadline field the server drops expired work by."""
+        body = dict(inputs) if isinstance(inputs, dict) else inputs
+        if deadline_s is not None and isinstance(body, dict):
+            body = dict(body)
+            body["deadline_s"] = float(deadline_s)
+        data = json.dumps(body).encode()
+        budget = self._max_tries if max_tries is None else int(max_tries)
+        self.refresh()
+        tries = 0
+        consecutive_sheds = 0
+        retry_afters: List[float] = []
+        last_err: Optional[BaseException] = None
+        while tries < budget:
+            addrs = self.healthy_addrs()
+            if not addrs:
+                self.refresh(force=True)
+                addrs = self.healthy_addrs()
+                if not addrs:
+                    raise FleetRequestError(
+                        "no healthy replicas in the routing set")
+            addr = addrs[self._rr % len(addrs)]
+            self._rr += 1
+            tries += 1
+            try:
+                out = self._post(addr, data)
+                self.stats["requests"] += 1
+                return out
+            except urllib.error.HTTPError as e:
+                try:
+                    e.read()
+                except OSError:
+                    pass
+                if e.code == 429:
+                    self.stats["shed_seen"] += 1
+                    consecutive_sheds += 1
+                    try:
+                        retry_afters.append(
+                            float(e.headers.get("Retry-After")))
+                    except (TypeError, ValueError):
+                        pass
+                    if consecutive_sheds >= len(addrs):
+                        # Back off by the LONGEST advertised wait — the
+                        # most loaded replica sets the fleet's pace.
+                        raise FleetOverloadedError(
+                            f"all {len(addrs)} replicas shed the request",
+                            retry_after_s=max(retry_afters)
+                            if retry_afters else 1.0) from None
+                    continue
+                consecutive_sheds = 0
+                if e.code in (500, 502, 503):
+                    last_err = e
+                    self.stats["failovers"] += 1
+                    self.refresh(force=True)
+                    continue
+                raise FleetRequestError(
+                    f"replica {addr} replied {e.code}") from e
+            except OSError as e:
+                # Dead or wedged replica (refused connect, reset,
+                # timeout): force-refresh so it leaves the routing set,
+                # fail over to the next.
+                consecutive_sheds = 0
+                last_err = e
+                self.stats["failovers"] += 1
+                _telemetry.inc("hvd_fleet_failovers_total")
+                get_logger().warning(
+                    "fleet: replica %s failed (%s) — failing over", addr, e)
+                self.refresh(force=True)
+                continue
+        raise FleetRequestError(
+            f"no replica answered after {tries} tries "
+            f"(last error: {last_err})")

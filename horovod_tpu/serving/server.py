@@ -14,6 +14,17 @@ The serve path is built around two invariants:
   and uses it for the whole device call; a swap landing mid-batch
   affects only the next batch.
 
+- **Overload degrades, never cascades** (docs/fleet.md): admission is
+  BOUNDED — a request arriving past ``HOROVOD_SERVING_QUEUE_MAX``
+  queued requests is shed with 429 + ``Retry-After`` instead of parked
+  into unbounded latency (the ``lint-unbounded-admission`` trap flags
+  handlers written the unbounded way). Requests may carry a deadline
+  (JSON ``deadline_s`` or ``X-HVD-Deadline-S`` header); expired ones are
+  dropped BEFORE batching — device time is never spent computing an
+  answer nobody is waiting for. ``drain()`` stops admission (503),
+  finishes in-flight work, then fires deregistration callbacks — the
+  primitive the fleet arbiter reclaims capacity with.
+
 The model-specific half (stacking request dicts, padding to ``n``,
 calling the jitted program, unstacking) lives in the ``forward``
 callable — ``forward(payload, inputs, padded_n) -> list of per-request
@@ -22,17 +33,29 @@ workload-agnostic.
 
 Surfaces: ``POST /predict`` (JSON request in, JSON result out),
 ``POST /generate`` (autoregressive decode through the continuous-batching
-engine when one is attached — serving/decode.py), ``GET /healthz``, and
-``GET /metrics`` — the same Prometheus text exposition the coordinator
-serves (core/telemetry.py), carrying the ``hvd_serving_*``
-swap/staleness/queue/latency series under this process's serving rank
-label.
+engine when one is attached — serving/decode.py), ``GET /healthz``
+(READINESS: 503 while draining, before a model is adopted, or when
+staleness exceeds ``HOROVOD_SERVING_MAX_STALENESS_SECONDS`` — the fleet
+replica list must never route to a replica that cannot answer),
+``GET /livez`` (LIVENESS: 200 whenever the process serves HTTP at all),
+and ``GET /metrics`` — the same Prometheus text exposition the
+coordinator serves (core/telemetry.py), carrying the ``hvd_serving_*``
+swap/staleness/queue/latency/shed series under this process's serving
+rank label.
+
+Chaos seam: when ``HOROVOD_FAULT_SPEC`` is armed, every admitted
+``/predict``/``/generate`` bumps a request counter consulted for
+``replica_kill``/``replica_hang`` faults (testing/faults.py, ``req=``
+axis) — the fleet failover tests kill/wedge a replica at an exact
+request count, deterministically.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
+import signal as _signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -64,15 +87,21 @@ def jsonable(value: Any) -> Any:
 
 
 class _Pending:
-    __slots__ = ("inputs", "event", "result", "error", "model_seq", "t0")
+    __slots__ = ("inputs", "event", "result", "error", "status",
+                 "model_seq", "t0", "deadline")
 
-    def __init__(self, inputs: Any, t0: float):
+    def __init__(self, inputs: Any, t0: float,
+                 deadline: Optional[float] = None):
         self.inputs = inputs
         self.event = threading.Event()
         self.result: Any = None
         self.error: Optional[str] = None
+        #: HTTP status the handler replies with when ``error`` is set.
+        self.status = 503
         self.model_seq: Optional[int] = None
         self.t0 = t0
+        #: Absolute ``time.monotonic()`` drop-dead time (None = none).
+        self.deadline = deadline
 
 
 class InferenceServer:
@@ -106,7 +135,17 @@ class InferenceServer:
         self._rank = SC.serving_rank() if rank is None else int(rank)
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._closing = False
+        self._draining = False
+        self._hung = False          # replica_hang fault: wedged, not dead
         self._watch_thread: Optional[threading.Thread] = None
+        # Admitted-but-unanswered requests (queued + in-flight): what
+        # drain() waits on. Separate from qsize() — a request leaves the
+        # queue when the batcher picks it up but is settled only when its
+        # event fires.
+        self._pending_lock = threading.Lock()
+        self._pending_n = 0
+        self._req_count = 0          # the replica fault schedule's axis
+        self._drained_callbacks: List[Callable[[], None]] = []
 
         srv = self
 
@@ -114,12 +153,14 @@ class InferenceServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply(self, obj, code=200):
+            def _reply(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 try:
                     self.send_response(code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
                     self.end_headers()
                     self.wfile.write(body)
                 except (OSError, ValueError):
@@ -138,18 +179,69 @@ class InferenceServer:
                     pass
 
             def do_GET(self):
+                if srv._hung:
+                    threading.Event().wait()   # wedged replica: no answer
                 if self.path == "/metrics":
                     self._reply_text(srv.metrics_text())
                     return
+                if self.path == "/livez":
+                    # Liveness only: the process is up and serving HTTP.
+                    # Restart decisions key off this; routing decisions
+                    # key off /healthz.
+                    self._reply({"ok": True})
+                    return
                 if self.path == "/healthz":
+                    # Readiness: can this replica answer a request RIGHT
+                    # NOW? Not while draining, not before a model landed,
+                    # not when the served model went stale past the
+                    # configured ceiling (a replica that lost its publish
+                    # feed must fall out of the routing set, not serve
+                    # ancient weights forever).
                     cur = srv.registry.current()
-                    self._reply({"ok": cur is not None,
-                                 "model_seq": None if cur is None
-                                 else cur.manifest_seq})
+                    stale = srv.registry.staleness_s()
+                    ceiling = SC.max_staleness_s()
+                    ready = (cur is not None and not srv._draining
+                             and not srv._closing
+                             and not (ceiling > 0 and stale is not None
+                                      and stale > ceiling))
+                    self._reply(
+                        {"ok": ready,
+                         "draining": srv._draining,
+                         "staleness_s": stale,
+                         "model_seq": None if cur is None
+                         else cur.manifest_seq},
+                        200 if ready else 503)
                     return
                 self._reply({"error": "not found"}, 404)
 
+            def _deadline_s(self, body) -> Optional[float]:
+                """Per-request deadline budget (seconds): JSON
+                ``deadline_s`` (popped — the forward never sees it) wins
+                over the ``X-HVD-Deadline-S`` header."""
+                raw = None
+                if isinstance(body, dict) and "deadline_s" in body:
+                    raw = body.pop("deadline_s")
+                else:
+                    raw = self.headers.get("X-HVD-Deadline-S")
+                if raw is None:
+                    return None
+                try:
+                    return max(0.0, float(raw))
+                except (TypeError, ValueError):
+                    return None
+
+            def _shed(self, reason: str):
+                """One shed reply: 429 + Retry-After. Never a hang, never
+                a 500 — the client's failover/backoff loop needs a crisp
+                signal, immediately."""
+                retry = SC.shed_retry_after_s()
+                self._reply({"ok": False, "error": reason,
+                             "retry_after_s": retry}, 429,
+                            headers={"Retry-After": f"{retry:g}"})
+
             def do_POST(self):
+                if srv._hung:
+                    threading.Event().wait()   # wedged replica: no answer
                 if self.path == "/generate":
                     self._do_generate()
                     return
@@ -163,14 +255,23 @@ class InferenceServer:
                     _telemetry.inc("hvd_serving_request_failures_total")
                     self._reply({"ok": False, "error": "bad json"}, 400)
                     return
-                pending = srv._enqueue(inputs)
+                deadline_s = self._deadline_s(inputs)
+                pending, refusal = srv._admit(inputs, deadline_s)
+                if pending is None:
+                    if refusal == "draining":
+                        _telemetry.inc("hvd_serving_request_failures_total")
+                        self._reply({"ok": False, "error": "draining"}, 503)
+                    else:
+                        self._shed(refusal)
+                    return
                 if not pending.event.wait(srv._request_timeout_s):
                     _telemetry.inc("hvd_serving_request_failures_total")
                     self._reply({"ok": False, "error": "timeout"}, 504)
                     return
                 if pending.error is not None:
                     _telemetry.inc("hvd_serving_request_failures_total")
-                    self._reply({"ok": False, "error": pending.error}, 503)
+                    self._reply({"ok": False, "error": pending.error},
+                                pending.status)
                     return
                 _telemetry.inc("hvd_serving_requests_total")
                 _telemetry.observe("hvd_serving_request_seconds",
@@ -184,6 +285,11 @@ class InferenceServer:
                     self._reply({"ok": False,
                                  "error": "no decode engine attached"}, 404)
                     return
+                if srv._draining or srv._closing:
+                    _telemetry.inc("hvd_serving_request_failures_total")
+                    self._reply({"ok": False, "error": "draining"}, 503)
+                    return
+                srv._count_request()
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
                     body = json.loads(self.rfile.read(n) or b"{}")
@@ -232,12 +338,66 @@ class InferenceServer:
         snap = _telemetry.active().registry.export()
         return _telemetry.render_prometheus({self._rank: snap})
 
-    def _enqueue(self, inputs: Any) -> _Pending:
-        pending = _Pending(inputs, time.perf_counter())
+    def _count_request(self) -> None:
+        """Bump the admitted-request counter and consult the fault
+        harness for ``replica_kill``/``replica_hang`` (testing/faults.py
+        ``req=`` axis). The kill is immediate and graceless — exactly the
+        failure the fleet's client failover must absorb; the hang wedges
+        EVERY subsequent handler so the replica looks alive at the socket
+        but never answers (the failure mode liveness checks miss and
+        client timeouts catch)."""
+        with self._pending_lock:
+            n = self._req_count
+            self._req_count += 1
+        if not os.environ.get("HOROVOD_FAULT_SPEC"):
+            return
+        from ..testing import faults as _faults
+        fault = _faults.on_replica_request(n, self._rank)
+        if fault is None:
+            return
+        if fault.kind == "replica_kill":
+            get_logger().warning(
+                "fault: killing replica on request %d", n)
+            os.kill(os.getpid(), _signal.SIGKILL)
+        elif fault.kind == "replica_hang":
+            get_logger().warning(
+                "fault: wedging replica from request %d on", n)
+            self._hung = True
+            threading.Event().wait()
+
+    def _admit(self, inputs: Any,
+               deadline_s: Optional[float] = None
+               ) -> Tuple[Optional[_Pending], Optional[str]]:
+        """Bounded admission. Returns ``(pending, None)`` on admit, or
+        ``(None, reason)`` — "draining" (503) when the replica is being
+        reclaimed, "overloaded" (429 + Retry-After) when the queue is at
+        ``HOROVOD_SERVING_QUEUE_MAX``. Shedding at the door is the
+        containment: past the bound, every queued request is latency
+        nobody asked for and timeout-retry amplification downstream."""
+        if self._draining or self._closing:
+            return None, "draining"
+        qmax = SC.queue_max()
+        if qmax > 0 and self._queue.qsize() >= qmax:
+            _telemetry.inc("hvd_serving_shed_total")
+            return None, "overloaded"
+        self._count_request()
+        deadline = None if deadline_s is None \
+            else time.monotonic() + deadline_s
+        pending = _Pending(inputs, time.perf_counter(), deadline)
+        with self._pending_lock:
+            self._pending_n += 1
         self._queue.put(pending)
         _telemetry.set_gauge("hvd_serving_queue_depth",
                              float(self._queue.qsize()))
-        return pending
+        return pending, None
+
+    def _settle(self, pending: _Pending) -> None:
+        """Fire the waiter and release the drain accounting — every
+        admitted request passes through exactly once (result, error, or
+        deadline drop)."""
+        pending.event.set()
+        with self._pending_lock:
+            self._pending_n -= 1
 
     # -- the batcher ---------------------------------------------------------
 
@@ -266,6 +426,22 @@ class InferenceServer:
             batch = self._collect()
             if batch is None:
                 continue
+            # Deadline propagation: drop expired requests BEFORE padding
+            # and the device call — device time spent on an answer whose
+            # waiter already gave up is pure overload amplification.
+            now = time.monotonic()
+            live = []
+            for p in batch:
+                if p.deadline is not None and now > p.deadline:
+                    _telemetry.inc("hvd_serving_deadline_dropped_total")
+                    p.error = "deadline exceeded"
+                    p.status = 504
+                    self._settle(p)
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            batch = live
             # One bucketed shape per batch: the jitted forward only ever
             # compiles len(buckets) programs, whatever the traffic does.
             padded = pad_to_bucket(len(batch), self._buckets)
@@ -283,7 +459,7 @@ class InferenceServer:
                 get_logger().error("serving batch failed: %s", err)
                 for p in batch:
                     p.error = str(err)
-                    p.event.set()
+                    self._settle(p)
                 continue
             _telemetry.inc("hvd_serving_batches_total")
             _telemetry.inc("hvd_serving_padded_examples_total",
@@ -296,7 +472,7 @@ class InferenceServer:
             for p, out in zip(batch, outs):
                 p.result = out
                 p.model_seq = cur.manifest_seq
-                p.event.set()
+                self._settle(p)
 
     # -- publish watching ----------------------------------------------------
 
@@ -329,6 +505,47 @@ class InferenceServer:
         self._watch_thread = threading.Thread(
             target=_watch, name="hvd-serve-watch", daemon=True)
         self._watch_thread.start()
+
+    # -- graceful drain (the arbiter's reclaim primitive) --------------------
+
+    def add_drained_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` once :meth:`drain` finishes (serving/fleet.py hangs
+        the coordinator deregistration here)."""
+        self._drained_callbacks.append(fn)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting (new requests get 503 and /healthz goes
+        not-ready immediately), finish every in-flight request, then fire
+        the drained callbacks (deregistration). Returns True when the
+        backlog fully settled inside ``timeout_s`` — False means
+        stragglers remain (their waiters still get answers or their own
+        timeouts; the callbacks fire either way, because a half-drained
+        replica must still leave the routing set)."""
+        self._draining = True
+        _telemetry.set_gauge("hvd_serving_draining", 1.0)
+        get_logger().info("serving: draining (pending=%d)", self._pending_n)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        settled = False
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                n = self._pending_n
+            if n <= 0 and self._queue.qsize() == 0:
+                settled = True
+                break
+            time.sleep(0.005)
+        for fn in self._drained_callbacks:
+            try:
+                fn()
+            except Exception as err:    # noqa: BLE001 — best-effort
+                get_logger().warning("drained callback failed: %s", err)
+        _telemetry.inc("hvd_serving_drains_total")
+        get_logger().info("serving: drain %s",
+                          "complete" if settled else "timed out")
+        return settled
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def close(self) -> None:
         self._closing = True

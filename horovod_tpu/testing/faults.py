@@ -52,6 +52,25 @@ Spec grammar (``HOROVOD_FAULT_SPEC``)::
                resume_delay   fetch=<int> [seconds=<float>]  stall one
                                                  serve past the resume
                                                  deadline
+    replica kinds (serving fleet; schedule on req=<int>, the inference
+    SERVER's accepted-request counter — serving/server.py applies
+    replica_kill/replica_hang; the traffic driver applies traffic_spike):
+               replica_kill   req=<int>         SIGKILL the serving replica
+                                                 while the request is live
+                                                 (failover proof: the fleet
+                                                 client must retry it)
+               replica_hang   req=<int>         replica wedges: socket stays
+                                                 open, no handler ever
+                                                 answers again (the failure
+                                                 liveness probes miss —
+                                                 only the heartbeat grace
+                                                 deadline catches it)
+               traffic_spike  req=<int> [factor=<float>] [seconds=<float>]
+                                                 traffic driver multiplies
+                                                 offered load by factor
+                                                 (default 4) for seconds
+                                                 (default 2) starting at
+                                                 this request count
 
 Examples::
 
@@ -64,6 +83,8 @@ Examples::
     rpc_badsig:call=0                       # first reply arrives tampered
     resume_kill:rank=1,fetch=0              # kill rank 1 serving its 1st blob
     resume_corrupt:fetch=1                  # 2nd served blob garbled in flight
+    replica_kill:rank=901,req=5             # kill replica 901 on its 6th req
+    traffic_spike:req=50,factor=8,seconds=3 # 8x offered QPS after req 50
 
 One-shot semantics: each fault fires at most once per PROCESS LIFETIME
 GENERATION — a marker file in ``HOROVOD_FAULT_MARKER_DIR`` (default: the
@@ -113,8 +134,15 @@ _RPC_KINDS = ("rpc_drop", "rpc_delay", "rpc_refuse", "rpc_garble",
 #: resume-path analog of the coordinator-RPC axis.
 _RESUME_KINDS = ("resume_kill", "resume_corrupt", "resume_delay")
 
+#: replica_* kinds fire at the serving-fleet seam, scheduled on the
+#: inference server's accepted-request counter (``req=``).
+#: replica_kill/replica_hang are applied by serving/server.py on the
+#: replica itself; traffic_spike is applied by the traffic driver
+#: (benchmarks/fleet.py) — the offered-load analog of the same axis.
+_REPLICA_KINDS = ("replica_kill", "replica_hang", "traffic_spike")
+
 _KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan",
-          "desync", "torn") + _RPC_KINDS + _RESUME_KINDS
+          "desync", "torn") + _RPC_KINDS + _RESUME_KINDS + _REPLICA_KINDS
 
 
 @dataclass
@@ -125,6 +153,7 @@ class Fault:
     round: Optional[int] = None
     call: Optional[int] = None
     fetch: Optional[int] = None
+    req: Optional[int] = None
     params: Dict[str, str] = field(default_factory=dict)
     index: int = 0
 
@@ -134,18 +163,20 @@ class Fault:
         which schedule axis applies: "step" faults only match on_step
         calls; "round" faults only match engine rounds; "call" faults
         only match coordinator RPC attempts; "fetch" faults only match
-        blob-serve requests."""
+        blob-serve requests; "req" faults only match the serving-request
+        counter."""
         if self.rank is not None and rank is not None and self.rank != rank:
             return False
         want = {"step": self.step, "round": self.round,
-                "call": self.call, "fetch": self.fetch}[counter]
+                "call": self.call, "fetch": self.fetch,
+                "req": self.req}[counter]
         if want is None:
             # A kind with no schedule on this axis never fires on it.
             return False
         return count == want
 
     def _sched(self) -> "int | None":
-        for v in (self.step, self.round, self.call, self.fetch):
+        for v in (self.step, self.round, self.call, self.fetch, self.req):
             if v is not None:
                 return v
         return None
@@ -191,6 +222,8 @@ class FaultSpec:
                     f.call = int(v)
                 elif k == "fetch":
                     f.fetch = int(v)
+                elif k == "req":
+                    f.req = int(v)
                 else:
                     f.params[k] = v
             if kind in ("delay", "drop") and f.round is None and \
@@ -208,6 +241,12 @@ class FaultSpec:
                     raise ValueError(f"fault {part!r} needs fetch=<int> "
                                      "(resume faults schedule on the blob "
                                      "peer service's request counter)")
+            elif kind in _REPLICA_KINDS:
+                if f.req is None:
+                    raise ValueError(f"fault {part!r} needs req=<int> "
+                                     "(replica faults schedule on the "
+                                     "inference server's accepted-request "
+                                     "counter)")
             elif kind in ("delay", "drop"):
                 if f.round is None:
                     raise ValueError(f"fault {part!r} needs round=<int>")
@@ -269,6 +308,8 @@ class FaultHarness:
             counter = "call"
         elif kind in _RESUME_KINDS:
             counter = "fetch"
+        elif kind in _REPLICA_KINDS:
+            counter = "req"
         elif kind in ("delay", "drop"):
             counter = "round"
         else:
@@ -445,6 +486,47 @@ class FaultHarness:
             return f
         return None
 
+    # -- serving-request-axis faults (fleet) --------------------------------
+
+    def on_replica_request(self, req: int,
+                           rank: Optional[int] = None) -> Optional[Fault]:
+        """Inference-server hook (serving/server.py): returns the armed
+        replica_kill/replica_hang fault for this (rank, accepted-request
+        counter) — marking it fired — or None. The SERVER applies the
+        action (SIGKILL self / wedge every handler) so the fleet client
+        exercises its real failover path against a genuinely dead or
+        wedged socket, not a simulated error."""
+        rank = rank if rank is not None else _env_rank()
+        for f in self.spec.faults:
+            if f.kind not in ("replica_kill", "replica_hang"):
+                continue
+            if not f.matches(rank, req, "req") or self._fired(f):
+                continue
+            self._mark_fired(f)
+            get_logger().warning("fault: %s on serving request %d (rank=%s)",
+                                 f.kind, req, rank)
+            return f
+        return None
+
+    def on_traffic_request(self, req: int) -> Optional[Fault]:
+        """Traffic-driver hook (benchmarks/fleet.py): returns the armed
+        traffic_spike fault at this offered-request count — marking it
+        fired — or None. The DRIVER applies the action (multiply offered
+        QPS by ``factor=`` for ``seconds=``): load is a property of the
+        offered traffic, not of any replica."""
+        for f in self.spec.faults:
+            if f.kind != "traffic_spike":
+                continue
+            if not f.matches(None, req, "req") or self._fired(f):
+                continue
+            self._mark_fired(f)
+            get_logger().warning("fault: traffic_spike at offered request "
+                                 "%d (factor=%s seconds=%s)", req,
+                                 f.params.get("factor", "4"),
+                                 f.params.get("seconds", "2"))
+            return f
+        return None
+
     # -- engine-round-axis faults ------------------------------------------
 
     def before_engine_round(self, what: str = "") -> None:
@@ -549,3 +631,18 @@ def on_blob_serve(fetch: int,
     (elastic/blobmesh.py ``BlobPeerService``)."""
     h = fault_harness()
     return None if h is None else h.on_blob_serve(fetch, rank)
+
+
+def on_replica_request(req: int,
+                       rank: Optional[int] = None) -> Optional[Fault]:
+    """Module-level convenience for the inference-server fault seam
+    (serving/server.py accepted-request counter)."""
+    h = fault_harness()
+    return None if h is None else h.on_replica_request(req, rank)
+
+
+def on_traffic_request(req: int) -> Optional[Fault]:
+    """Module-level convenience for the traffic-driver fault seam
+    (benchmarks/fleet.py offered-request counter)."""
+    h = fault_harness()
+    return None if h is None else h.on_traffic_request(req)

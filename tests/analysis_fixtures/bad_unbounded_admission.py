@@ -1,0 +1,36 @@
+"""lint-unbounded-admission fixture: an HTTP handler that enqueues every
+arriving request with no queue bound or shed path — a traffic spike
+becomes unbounded latency for every queued request, then timeout storms
+and retry amplification. Exactly ONE finding: the bounded handler class
+below (checks depth, sheds with 429) must stay clean.
+"""
+from http.server import BaseHTTPRequestHandler
+
+
+class UnboundedHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = self.rfile.read(
+            int(self.headers.get("Content-Length", "0")))
+        # Every arrival is queued no matter how deep the backlog already
+        # is — nothing ever says no.
+        self.server.work_queue.put(body)  # <- lint-unbounded-admission
+        self.send_response(202)
+        self.end_headers()
+
+
+class BoundedHandler(BaseHTTPRequestHandler):
+    # Clean: depth is checked against a configured bound and the
+    # overflow is shed with 429 so clients back off.
+    queue_max = 256
+
+    def do_POST(self):
+        body = self.rfile.read(
+            int(self.headers.get("Content-Length", "0")))
+        if self.server.work_queue.qsize() >= self.queue_max:
+            self.send_response(429)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            return
+        self.server.work_queue.put(body)
+        self.send_response(202)
+        self.end_headers()

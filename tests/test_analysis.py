@@ -224,6 +224,7 @@ LINT_CASES = [
     ("bad_rank_conditional_collective.py",
      "lint-rank-conditional-collective", "error"),
     ("bad_unverified_peer_blob.py", "lint-unverified-peer-blob", "warning"),
+    ("bad_unbounded_admission.py", "lint-unbounded-admission", "warning"),
 ]
 
 

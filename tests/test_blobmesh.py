@@ -272,3 +272,40 @@ def test_failed_resume_lands_flight_record(tmp_path):
         blobmesh.fetch_missing(dst, [d], {d: []}, {}, KEY)
     kinds = [ev.get("kind") for ev in sess.ring.events()]
     assert "resume_failed" in kinds
+
+
+def test_assign_sources_prefers_pod_local_possessors():
+    """Pod-local preference: same-host possessors are elected ahead of
+    every cross-host one (the copy crosses loopback, not the fabric),
+    with the hash-spread ordering preserved WITHIN each host class."""
+    missing = [blob_digest(bytes([i]) * 10) for i in range(32)]
+    possession = {r: set(missing) for r in range(4)}
+    hosts = {0: "pod-a", 1: "pod-a", 2: "pod-b", 3: "pod-b"}
+    out = blobmesh.assign_sources(missing, possession, owner=0,
+                                  hosts=hosts, local_host="pod-a")
+    for cands in out.values():
+        # every candidate list is [all pod-a ranks..., all pod-b ranks...]
+        assert [hosts[r] for r in cands] == ["pod-a", "pod-a",
+                                             "pod-b", "pod-b"]
+    # spread still applies within the local host class
+    assert len({c[0] for c in out.values()}) == 2
+    # and the whole assignment stays deterministic across ranks that
+    # share a host (same inputs -> same order)
+    assert out == blobmesh.assign_sources(missing, possession, owner=0,
+                                          hosts=hosts, local_host="pod-a")
+
+
+def test_assign_sources_cross_host_fallback_and_compat():
+    missing = [blob_digest(b"fallback" + bytes([i])) for i in range(4)]
+    # only remote ranks possess: the pod-local preference must not strand
+    # the fetch — cross-host possessors remain candidates
+    possession = {0: set(), 1: set(), 2: set(missing), 3: set(missing)}
+    hosts = {0: "pod-a", 1: "pod-a", 2: "pod-b", 3: "pod-c"}
+    out = blobmesh.assign_sources(missing, possession, owner=2,
+                                  hosts=hosts, local_host="pod-a")
+    for d in missing:
+        assert sorted(out[d]) == [2, 3]
+    # hosts omitted -> byte-identical to the classic ordering
+    legacy = blobmesh.assign_sources(missing, possession, owner=2)
+    assert blobmesh.assign_sources(missing, possession, owner=2,
+                                   hosts=None, local_host=None) == legacy

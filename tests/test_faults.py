@@ -242,3 +242,59 @@ def test_delay_and_drop_on_engine_round_axis(tmp_path, monkeypatch):
 
     threading.Thread(target=call, daemon=True).start()
     assert not done.wait(0.4)
+
+
+# -- replica kinds (serving fleet, req= axis) --------------------------------
+
+def test_parse_replica_grammar():
+    spec = FaultSpec.parse(
+        "replica_kill:rank=901,req=5;"
+        "replica_hang:req=3;"
+        "traffic_spike:req=50,factor=8,seconds=3")
+    kinds = [f.kind for f in spec.faults]
+    assert kinds == ["replica_kill", "replica_hang", "traffic_spike"]
+    assert (spec.faults[0].rank, spec.faults[0].req) == (901, 5)
+    assert spec.faults[1].rank is None          # any replica
+    assert spec.faults[2].params == {"factor": "8", "seconds": "3"}
+
+
+@pytest.mark.parametrize("bad", [
+    "replica_kill:rank=901",    # replica kind without a req schedule
+    "replica_hang:step=2",      # wrong axis
+    "traffic_spike:factor=4",
+])
+def test_parse_rejects_replica_without_req(bad):
+    with pytest.raises(ValueError):
+        FaultSpec.parse(bad)
+
+
+def test_on_replica_request_schedule_and_one_shot(tmp_path):
+    h = _harness("replica_kill:rank=901,req=2", tmp_path)
+    assert h.will_fire("replica_kill", 901, 2)
+    assert h.on_replica_request(2, rank=902) is None    # wrong replica
+    assert h.on_replica_request(1, rank=901) is None    # wrong req count
+    f = h.on_replica_request(2, rank=901)
+    assert f is not None and f.kind == "replica_kill"
+    # one-shot: the relaunched replica replaying request 2 must not
+    # re-die — that is what makes kill-then-failover terminating
+    assert h.on_replica_request(2, rank=901) is None
+    h2 = _harness("replica_kill:rank=901,req=2", tmp_path)
+    assert h2.on_replica_request(2, rank=901) is None
+
+
+def test_on_replica_request_ignores_traffic_spike(tmp_path):
+    """traffic_spike belongs to the DRIVER's axis: the replica seam must
+    never fire it (a server cannot multiply its own offered load)."""
+    h = _harness("traffic_spike:req=1,factor=4", tmp_path)
+    assert h.on_replica_request(1, rank=901) is None
+    f = h.on_traffic_request(1)
+    assert f is not None and f.kind == "traffic_spike"
+    assert f.params["factor"] == "4"
+    assert h.on_traffic_request(1) is None              # one-shot
+
+
+def test_on_traffic_request_ignores_replica_kinds(tmp_path):
+    h = _harness("replica_hang:req=0", tmp_path)
+    assert h.on_traffic_request(0) is None
+    f = h.on_replica_request(0, rank=901)
+    assert f is not None and f.kind == "replica_hang"

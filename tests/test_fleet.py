@@ -1,0 +1,437 @@
+"""Fleet unit tests (tier-1: injected clocks, no real sleeps on the hot
+assertions): replica registry journal replay, health-gated pruning,
+heartbeat-on-poll, the arbiter's hysteresis/cooldown/bounds and its
+crash-restart reseed, and the failover client against in-process stub
+replicas. The np=3 subprocess chaos companions live in
+tests/test_fleet_chaos.py (marked slow).
+"""
+
+import json
+import socket
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from horovod_tpu.elastic import constants as C
+from horovod_tpu.elastic import journal as journal_mod
+from horovod_tpu.elastic.arbiter import ArbiterPolicy, FleetArbiter
+from horovod_tpu.elastic.service import CoordinatorClient, CoordinatorService
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.serving.fleet import (FleetClient, FleetOverloadedError,
+                                       FleetRequestError, ReplicaAgent)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def svc(tmp_path):
+    key = _secret.make_secret_key()
+    clock = _Clock()
+    service = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=str(tmp_path / "wal.jsonl"),
+                                 clock=clock)
+    yield service, key, clock
+    service.close()
+
+
+def _client(svc_obj, key, **kw):
+    return CoordinatorClient(f"127.0.0.1:{svc_obj.port}", key,
+                             sleep=lambda s: None, **kw)
+
+
+# ------------------------------------------------- journal replica/arbiter
+
+
+def test_journal_replays_replica_lifecycle():
+    st = journal_mod.empty_state()
+    for rec in (
+            {"op": "replica", "action": "register", "replica_id": "a",
+             "addr": "127.0.0.1:1", "rank": 901},
+            {"op": "replica", "action": "register", "replica_id": "b",
+             "addr": "127.0.0.1:2", "rank": 902},
+            {"op": "replica", "action": "drain", "replica_id": "a"},
+            {"op": "replica", "action": "deregister", "replica_id": "b"},
+            {"op": "arbiter", "seq": 3, "serving_target": 2,
+             "training_np": 6, "reason": "overload"}):
+        journal_mod.apply_record(st, rec)
+    assert set(st["replicas"]) == {"a"}
+    assert st["replicas"]["a"]["draining"] is True
+    assert st["replicas"]["a"]["rank"] == 901
+    assert st["arbiter_seq"] == 3
+    assert st["fleet"] == {"serving_target": 2, "training_np": 6,
+                           "reason": "overload"}
+    # deregister is idempotent at replay too
+    journal_mod.apply_record(st, {"op": "replica", "action": "deregister",
+                                  "replica_id": "b"})
+    assert set(st["replicas"]) == {"a"}
+
+
+def test_service_crash_restart_restores_replicas_and_fleet(tmp_path, svc):
+    service, key, clock = svc
+    service._record_replica({"action": "register", "replica_id": "r1",
+                             "addr": "127.0.0.1:9001", "rank": 901})
+    service._record_replica({"action": "register", "replica_id": "r2",
+                             "addr": "127.0.0.1:9002", "rank": 902})
+    service._record_replica({"action": "drain", "replica_id": "r2"})
+    seq = service.record_arbiter_decision(2, 6, "overload")
+    jp = service._journal.path
+    service.simulate_crash()
+    rebuilt = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=jp, restore=True,
+                                 clock=clock)
+    try:
+        snap = rebuilt.replicas_snapshot()
+        assert set(snap) == {"r1", "r2"}
+        assert snap["r2"]["draining"] is True
+        assert snap["r1"]["addr"] == "127.0.0.1:9001"
+        view = rebuilt.fleet_view()
+        assert view["arbiter_seq"] == seq
+        assert view["fleet"]["serving_target"] == 2
+        assert view["fleet"]["training_np"] == 6
+    finally:
+        rebuilt.close()
+
+
+# --------------------------------------------- lifecycle + grace pruning
+
+
+def test_replica_lifecycle_over_http(svc):
+    service, key, _clock = svc
+    c = _client(service, key)
+    assert c.register_replica("rep-a", "127.0.0.1:9001", rank=901)
+    assert c.register_replica("rep-b", "127.0.0.1:9002", rank=902)
+    view = c.get_replicas()
+    assert [r["id"] for r in view["replicas"]] == ["rep-a", "rep-b"]
+    assert all(not r["draining"] for r in view["replicas"])
+    assert c.drain_replica("rep-a")
+    view = c.get_replicas()
+    drain_flags = {r["id"]: r["draining"] for r in view["replicas"]}
+    assert drain_flags == {"rep-a": True, "rep-b": False}
+    assert c.deregister_replica("rep-a", reason="drained")
+    assert c.deregister_replica("rep-a", reason="drained")  # idempotent
+    assert [r["id"] for r in c.get_replicas()["replicas"]] == ["rep-b"]
+    # drain of an unknown id is a no-op refusal, not a crash
+    assert not service._record_replica({"action": "drain",
+                                        "replica_id": "ghost"})
+
+
+def test_replica_grace_pruning_and_heartbeat_on_poll(svc, monkeypatch):
+    service, key, clock = svc
+    monkeypatch.setenv(C.REPLICA_GRACE_ENV, "10")
+    hb = _client(service, key, replica_id="rep-hb")
+    silent = _client(service, key)
+    assert hb.register_replica("rep-hb", "127.0.0.1:9001", rank=901)
+    assert silent.register_replica("rep-silent", "127.0.0.1:9002", rank=902)
+    clock.t = 6.0
+    # rep-hb's ordinary world poll carries replica=rep-hb -> heartbeat;
+    # rep-silent never polls again.
+    assert hb.get_world() is not None
+    clock.t = 12.0
+    # rep-silent is 12s silent (> grace); rep-hb heartbeat was 6s ago.
+    view = service.replicas_view()
+    assert [r["id"] for r in view["replicas"]] == ["rep-hb"]
+    # the prune was journaled as a deregister: a crash-restart replays to
+    # the same membership the live list served
+    st = journal_mod.replay(service._journal.path)
+    assert set(st["replicas"]) == {"rep-hb"}
+    # a pruned replica's stale poll must NOT resurrect it
+    assert silent.get_world() is not None
+    clock.t = 13.0
+    assert [r["id"] for r in service.replicas_view()["replicas"]] \
+        == ["rep-hb"]
+
+
+def test_touch_unknown_replica_ignored(svc):
+    service, _key, _clock = svc
+    service._touch_replica_locked("never-registered")
+    assert service.replicas_snapshot() == {}
+
+
+# ------------------------------------------------------------- arbiter
+
+
+def _arm_signals(service, queue_depth, staleness=0.0, step_wall=0.05):
+    service._record_metrics({"rank": 901, "g": {
+        "hvd_serving_queue_depth": float(queue_depth),
+        "hvd_serving_staleness_seconds": float(staleness)}})
+    service._record_metrics({"rank": 0, "g": {
+        'hvd_step_wall_seconds{what="train"}': float(step_wall)}})
+
+
+def test_serving_signals_split_by_rank_band(svc):
+    service, _key, _clock = svc
+    _arm_signals(service, queue_depth=7.0, staleness=2.5, step_wall=0.125)
+    sig = service.serving_signals()
+    assert sig["queue_depth"] == 7.0
+    assert sig["staleness_s"] == 2.5
+    assert sig["step_wall_s"] == 0.125      # labeled gauge still matched
+
+
+def test_arbiter_hysteresis_sustain_and_bounds(svc):
+    service, _key, _clock = svc
+    pol = ArbiterPolicy(queue_high=8.0, queue_low=1.0, sustain=2,
+                        cooldown_s=30.0, min_training_np=2,
+                        min_replicas=1, max_replicas=3)
+    clock = _Clock()
+    arb = FleetArbiter(service, total_hosts=8, policy=pol, clock=clock)
+    assert arb.shape == {"serving_target": 1, "training_np": 7}
+    _arm_signals(service, queue_depth=12.0)
+    assert arb.evaluate() is None            # 1 eval < sustain=2
+    clock.t = 1.0
+    dec = arb.evaluate()                     # sustained: scale out
+    assert dec is not None and dec["serving_target"] == 2
+    assert dec["training_np"] == 6 and dec["seq"] == 1
+    assert arb.shape["serving_target"] + arb.shape["training_np"] == 8
+    # cooldown: still overloaded — the streak keeps counting but no
+    # decision lands until the 30s dead time elapses
+    clock.t = 10.0
+    assert arb.evaluate() is None
+    clock.t = 31.5
+    dec = arb.evaluate()   # cooldown over + overload sustained through it
+    assert dec is not None and dec["serving_target"] == 3
+    # at max_replicas: overload can no longer scale out
+    clock.t = 100.0
+    assert arb.evaluate() is None
+    clock.t = 101.0
+    assert arb.evaluate() is None
+    # idle traffic reclaims replicas for training, down to min_replicas
+    _arm_signals(service, queue_depth=0.0)
+    clock.t = 200.0
+    assert arb.evaluate() is None
+    clock.t = 201.0
+    dec = arb.evaluate()
+    assert dec is not None and dec["serving_target"] == 2
+    clock.t = 300.0
+    arb.evaluate()
+    clock.t = 301.0
+    dec = arb.evaluate()
+    assert dec is not None and dec["serving_target"] == 1
+    clock.t = 400.0
+    arb.evaluate()
+    clock.t = 401.0
+    assert arb.evaluate() is None            # min_replicas floor holds
+
+
+def test_arbiter_training_floor_blocks_scale_out(svc):
+    service, _key, _clock = svc
+    pol = ArbiterPolicy(queue_high=8.0, queue_low=1.0, sustain=1,
+                        cooldown_s=0.0, min_training_np=3,
+                        min_replicas=1, max_replicas=4)
+    clock = _Clock()
+    arb = FleetArbiter(service, total_hosts=4, policy=pol, clock=clock)
+    assert arb.shape == {"serving_target": 1, "training_np": 3}
+    _arm_signals(service, queue_depth=100.0)
+    # training is already at its floor: overload cannot take a host
+    assert arb.evaluate() is None
+    assert arb.shape == {"serving_target": 1, "training_np": 3}
+
+
+def test_arbiter_staleness_triggers_scale_out(svc):
+    service, _key, _clock = svc
+    pol = ArbiterPolicy(queue_high=1e9, queue_low=-1.0, sustain=1,
+                        cooldown_s=0.0, staleness_high_s=5.0,
+                        min_training_np=1, min_replicas=1, max_replicas=4)
+    arb = FleetArbiter(service, total_hosts=4, policy=pol, clock=_Clock())
+    _arm_signals(service, queue_depth=0.0, staleness=9.0)
+    dec = arb.evaluate()
+    assert dec is not None and dec["serving_target"] == 2
+
+
+def test_arbiter_crash_restart_reseeds_same_shape(tmp_path, svc):
+    service, key, svc_clock = svc
+    pol = ArbiterPolicy(queue_high=8.0, queue_low=1.0, sustain=1,
+                        cooldown_s=0.0, min_training_np=1,
+                        min_replicas=1, max_replicas=4)
+    arb = FleetArbiter(service, total_hosts=6, policy=pol, clock=_Clock())
+    _arm_signals(service, queue_depth=50.0)
+    arb.evaluate()
+    arb.evaluate()
+    shape_before = dict(arb.shape)
+    seq_before = service.fleet_view()["arbiter_seq"]
+    assert shape_before == {"serving_target": 3, "training_np": 3}
+    jp = service._journal.path
+    service.simulate_crash()
+    rebuilt = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=jp, restore=True,
+                                 clock=svc_clock)
+    try:
+        arb2 = FleetArbiter(rebuilt, total_hosts=6, policy=pol,
+                            clock=_Clock())
+        # the resumed arbiter continues the SAME rebalance, same seq
+        assert arb2.shape == shape_before
+        assert rebuilt.fleet_view()["arbiter_seq"] == seq_before
+        # and its NEXT decision extends the journaled sequence
+        _arm_signals(rebuilt, queue_depth=50.0)
+        dec = rebuilt and arb2.evaluate()
+        assert dec is not None and dec["seq"] == seq_before + 1
+    finally:
+        rebuilt.close()
+
+
+def test_arbiter_rejects_empty_world(svc):
+    service, _key, _clock = svc
+    with pytest.raises(ValueError):
+        FleetArbiter(service, total_hosts=0)
+
+
+# ----------------------------------------------------- failover client
+
+
+class _StubReplica:
+    """A bare HTTP replica answering /predict with a fixed plan: each
+    entry is an int status (non-200 refused with that code) or "ok"."""
+
+    def __init__(self, plan="ok", retry_after="0.25"):
+        self.plan = plan if isinstance(plan, list) else [plan]
+        self.calls = 0
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                step = stub.plan[min(stub.calls, len(stub.plan) - 1)]
+                stub.calls += 1
+                if step == "ok":
+                    body = json.dumps({"ok": True,
+                                       "served_by": stub.addr}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(int(step))
+                    if step == 429:
+                        self.send_header("Retry-After", retry_after)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = "127.0.0.1:%d" % self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _dead_addr():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def test_fleet_client_fails_over_dead_replica():
+    good = _StubReplica("ok")
+    try:
+        fc = FleetClient(replicas=[_dead_addr(), good.addr], timeout_s=5)
+        out = fc.predict({"x": 1.0})
+        assert out["ok"] and out["served_by"] == good.addr
+        assert fc.stats["failovers"] == 1
+    finally:
+        good.close()
+
+
+def test_fleet_client_fails_over_503_and_429(monkeypatch):
+    draining = _StubReplica(503)
+    shedding = _StubReplica(429)
+    good = _StubReplica("ok")
+    try:
+        fc = FleetClient(replicas=[draining.addr, shedding.addr, good.addr],
+                         timeout_s=5)
+        outs = [fc.predict({"x": i}) for i in range(3)]
+        assert all(o["ok"] for o in outs)
+        assert all(o["served_by"] == good.addr for o in outs)
+        assert fc.stats["shed_seen"] >= 1
+    finally:
+        for s in (draining, shedding, good):
+            s.close()
+
+
+def test_fleet_client_all_shed_raises_overloaded():
+    a, b = _StubReplica(429, retry_after="2.5"), _StubReplica(429)
+    try:
+        fc = FleetClient(replicas=[a.addr, b.addr], timeout_s=5)
+        with pytest.raises(FleetOverloadedError) as ei:
+            fc.predict({"x": 1.0})
+        assert ei.value.retry_after_s == 2.5
+        # backpressure surfaced as ONE pass over the set, not max_tries
+        assert a.calls + b.calls == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fleet_client_exhaustion_raises_request_error():
+    fc = FleetClient(replicas=[_dead_addr()], timeout_s=1, max_tries=2)
+    with pytest.raises(FleetRequestError):
+        fc.predict({"x": 1.0})
+
+
+def test_fleet_client_refresh_skips_draining(svc):
+    service, key, _clock = svc
+    c = _client(service, key)
+    assert c.register_replica("rep-a", "127.0.0.1:9001", rank=901)
+    assert c.register_replica("rep-b", "127.0.0.1:9002", rank=902)
+    fc = FleetClient(coord=c)
+    assert sorted(fc.healthy_addrs()) == ["127.0.0.1:9001",
+                                          "127.0.0.1:9002"]
+    assert c.drain_replica("rep-a")
+    fc.refresh(force=True)
+    assert fc.healthy_addrs() == ["127.0.0.1:9002"]
+
+
+def test_fleet_client_needs_a_source():
+    with pytest.raises(ValueError):
+        FleetClient()
+
+
+# -------------------------------------------------------- replica agent
+
+
+def test_replica_agent_registers_and_drain_deregisters(svc, monkeypatch):
+    import numpy as np
+    from horovod_tpu.serving import InferenceServer, ModelRegistry
+
+    service, key, _clock = svc
+    monkeypatch.setenv(C.REPLICA_GRACE_ENV, "9")
+    monkeypatch.setenv("HOROVOD_SERVING_LONG_POLL_SECONDS", "30")
+    reg = ModelRegistry()
+    srv = InferenceServer(reg, lambda payload, inputs, n: [0.0] * n,
+                          buckets=(1, 2), window_s=0.0,
+                          request_timeout_s=5.0)
+    agent = None
+    try:
+        client = CoordinatorClient(f"127.0.0.1:{service.port}", key,
+                                   watch_publish=True,
+                                   sleep=lambda s: None)
+        agent = ReplicaAgent(srv, client, replica_id="rep-agent", rank=901)
+        assert agent.registered
+        assert client.replica_id == "rep-agent"
+        view = service.replicas_view()
+        assert [r["id"] for r in view["replicas"]] == ["rep-agent"]
+        assert view["replicas"][0]["addr"] == srv.addr()
+        # poll pacing stays inside the heartbeat grace window
+        assert agent._wait_bound() == pytest.approx(3.0)
+        # drain: coordinator mark -> server drain -> deregister callback
+        assert agent.drain(timeout_s=5.0)
+        assert service.replicas_view()["replicas"] == []
+    finally:
+        if agent is not None:
+            agent.close(deregister=False)
+        srv.close()

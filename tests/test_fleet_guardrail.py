@@ -1,0 +1,75 @@
+"""Fleet-under-fire guardrails over benchmarks/fleet.py.
+
+Same contract as tests/test_serving_guardrail.py: the COMMITTED history
+record (benchmarks/fleet_history.jsonl) must stay inside the ISSUE 19
+rails — served-QPS floor under the diurnal trace, shed-fraction ceiling,
+zero failed requests (the never-hangs-never-500s contract), p99
+commit-to-served staleness ceiling, training-throughput-retained floor,
+zero steady-state recompiles in either arm, and exact decision/journal
+parity (the arbiter's journal replays to the live fleet shape) — so a
+regression in the arbiter, the replica registry, the FleetClient
+failover path, or the admission bound fails tier-1 without re-running
+the 30 s harness. The harness itself runs in the chaos tier via the
+slow-marked smoke below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "fleet.py")
+HISTORY = os.path.join(REPO, "benchmarks", "fleet_history.jsonl")
+
+
+def _run(args, timeout):
+    env = dict(os.environ, HOROVOD_FLEET_NO_HISTORY="1")
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    return subprocess.run([sys.executable, BENCH, *args],
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+
+
+def test_history_record_is_complete():
+    """The committed record carries everything --check pins."""
+    with open(HISTORY, encoding="utf-8") as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    recs = [r for r in recs if r.get("bench") == "fleet"]
+    assert recs, "no fleet records committed"
+    rec = recs[-1]
+    for k in ("trace", "total_hosts", "requests", "served_qps",
+              "shed_fraction", "p99_staleness_s", "staleness_samples",
+              "publishes", "training", "arbiter", "replicas",
+              "steady_compiles"):
+        assert k in rec, f"history record missing {k}"
+    assert rec["requests"]["failed"] == 0
+    assert 0 <= rec["shed_fraction"] <= 0.25
+    assert rec["arbiter"]["decisions"] >= 2
+    assert rec["arbiter"]["journal_arbiter_seq"] == rec["arbiter"]["final_seq"]
+    assert rec["steady_compiles"] == {"serving": 0, "training": 0}
+    assert rec.get("date") and rec.get("git")
+
+
+def test_recorded_series_inside_rails():
+    """Fast tier-1 guardrail: run the harness's own --check validator
+    against the committed series."""
+    p = _run(["--check"], timeout=60)
+    out = (p.stdout.strip().splitlines() or ["{}"])[-1]
+    verdict = json.loads(out)
+    assert p.returncode == 0 and verdict.get("ok"), (verdict, p.stderr)
+
+
+@pytest.mark.slow
+def test_fleet_smoke_in_budget():
+    """Chaos tier: one shrunk diurnal trace with live replicas, arbiter,
+    publisher, and training arm, inside a fixed budget (the subprocess
+    timeout is the budget); every request must complete."""
+    p = _run(["--smoke"], timeout=180)
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    res = json.loads(p.stdout.strip().splitlines()[-1])
+    assert res["requests"]["failed"] == 0
+    assert res["requests"]["served"] > 0
+    assert res["steady_compiles"] == {"serving": 0, "training": 0}

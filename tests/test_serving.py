@@ -469,3 +469,210 @@ def test_gc_respects_publish_pins(tmp_path):
     store.gc(1)
     assert store.read_manifest(1) is None
     assert store.read_manifest(5) is not None     # newest always kept
+
+
+# ------------------------------------- overload containment (docs/fleet.md)
+
+
+import urllib.error  # noqa: E402
+
+
+def _http(url, body=None):
+    """GET/POST returning (status, parsed-json, headers) — 4xx/5xx too."""
+    req = urllib.request.Request(url, data=body, headers={
+        "Content-Type": "application/json"} if body else {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _published_registry(tmp_path, w=10.0):
+    d = str(tmp_path)
+    trainer = _Trainer(d, w=np.float32(w))
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec = pub.maybe_publish(trainer.commit())
+    reg = ModelRegistry(store=pub.store)
+    assert reg.adopt(rec)
+    return reg, pub
+
+
+def _blocking_forward():
+    entered, release = threading.Event(), threading.Event()
+
+    def forward(payload, inputs, padded_n):
+        entered.set()
+        assert release.wait(20), "test never released the forward"
+        w = float(payload["attrs"]["w"])
+        return [float(q["x"]) * w for q in inputs]
+
+    return forward, entered, release
+
+
+def test_server_sheds_past_queue_max_with_retry_after(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVING_QUEUE_MAX", "1")
+    monkeypatch.setenv("HOROVOD_SERVING_RETRY_AFTER_SECONDS", "1.5")
+    reg, _pub = _published_registry(tmp_path)
+    forward, entered, release = _blocking_forward()
+    srv = InferenceServer(reg, forward, buckets=(1,), window_s=0.0,
+                          request_timeout_s=30.0)
+    results = []
+
+    def post(x):
+        results.append(_http(f"http://{srv.addr()}/predict",
+                             json.dumps({"x": x}).encode()))
+
+    try:
+        shed_before = _telemetry.active().registry.counter_value(
+            "hvd_serving_shed_total")
+        t1 = threading.Thread(target=post, args=(1.0,), daemon=True)
+        t1.start()
+        assert entered.wait(10)          # A is in-flight (off the queue)
+        t2 = threading.Thread(target=post, args=(2.0,), daemon=True)
+        t2.start()
+        deadline = time.monotonic() + 10
+        while srv._queue.qsize() < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv._queue.qsize() == 1   # B parked at the bound
+        # C arrives past the bound: shed at the door, not queued
+        code, body, headers = _http(f"http://{srv.addr()}/predict",
+                                    json.dumps({"x": 3.0}).encode())
+        assert code == 429
+        assert body["error"] == "overloaded"
+        assert body["retry_after_s"] == 1.5
+        assert headers["Retry-After"] == "1.5"
+        assert _telemetry.active().registry.counter_value(
+            "hvd_serving_shed_total") == shed_before + 1
+        release.set()
+        t1.join(timeout=20)
+        t2.join(timeout=20)
+        # the admitted requests were answered normally
+        assert sorted(r[1]["result"] for r in results) == [10.0, 20.0]
+        assert all(r[0] == 200 for r in results)
+    finally:
+        release.set()
+        srv.close()
+
+
+def test_expired_deadline_dropped_before_batching(tmp_path):
+    reg, _pub = _published_registry(tmp_path)
+    calls = []
+    srv = InferenceServer(reg, lambda p, i, n: calls.append(i) or
+                          [0.0] * len(i),
+                          buckets=(1,), window_s=0.0, request_timeout_s=10.0)
+    try:
+        dropped_before = _telemetry.active().registry.counter_value(
+            "hvd_serving_deadline_dropped_total")
+        # deadline_s=0: expired by the time the batcher picks it up — the
+        # JSON field is popped so the forward never sees it
+        code, body, _ = _http(f"http://{srv.addr()}/predict",
+                              json.dumps({"x": 1.0,
+                                          "deadline_s": 0}).encode())
+        assert code == 504
+        assert body["error"] == "deadline exceeded"
+        assert _telemetry.active().registry.counter_value(
+            "hvd_serving_deadline_dropped_total") == dropped_before + 1
+        assert calls == []               # never reached the device path
+        # the header spelling drops identically
+        req = urllib.request.Request(
+            f"http://{srv.addr()}/predict",
+            data=json.dumps({"x": 1.0}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-HVD-Deadline-S": "0"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 504"
+        except urllib.error.HTTPError as e:
+            assert e.code == 504
+        # an un-deadlined request still flows
+        code, body, _ = _http(f"http://{srv.addr()}/predict",
+                              json.dumps({"x": 1.0}).encode())
+        assert code == 200 and body["ok"]
+        assert len(calls) == 1
+    finally:
+        srv.close()
+
+
+def test_drain_stops_admission_finishes_inflight(tmp_path):
+    reg, _pub = _published_registry(tmp_path)
+    forward, entered, release = _blocking_forward()
+    srv = InferenceServer(reg, forward, buckets=(1,), window_s=0.0,
+                          request_timeout_s=30.0)
+    inflight, drained, drain_result = [], [], []
+    srv.add_drained_callback(lambda: drained.append(True))
+    try:
+        t = threading.Thread(target=lambda: inflight.append(
+            _http(f"http://{srv.addr()}/predict",
+                  json.dumps({"x": 4.0}).encode())), daemon=True)
+        t.start()
+        assert entered.wait(10)          # one request in flight
+        dt = threading.Thread(
+            target=lambda: drain_result.append(srv.drain(timeout_s=20)),
+            daemon=True)
+        dt.start()
+        deadline = time.monotonic() + 10
+        while not srv.draining and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.draining
+        # new traffic is refused while draining — crisp 503, not queued
+        code, body, _ = _http(f"http://{srv.addr()}/predict",
+                              json.dumps({"x": 5.0}).encode())
+        assert code == 503 and body["error"] == "draining"
+        # readiness says not-ready; liveness stays up
+        code, health, _ = _http(f"http://{srv.addr()}/healthz")
+        assert code == 503 and health["draining"] is True
+        code, live, _ = _http(f"http://{srv.addr()}/livez")
+        assert code == 200 and live["ok"]
+        assert not drained               # callbacks wait for the backlog
+        release.set()                    # in-flight request finishes
+        dt.join(timeout=20)
+        t.join(timeout=20)
+        assert drain_result == [True]
+        assert drained == [True]         # deregistration hook fired once
+        assert inflight[0][0] == 200 and inflight[0][1]["result"] == 40.0
+    finally:
+        release.set()
+        srv.close()
+
+
+def test_healthz_readiness_gates(tmp_path, monkeypatch):
+    # not ready before any model lands
+    reg = ModelRegistry()
+    srv = InferenceServer(reg, lambda p, i, n: [0.0] * len(i),
+                          buckets=(1,), window_s=0.0, request_timeout_s=5.0)
+    try:
+        code, health, _ = _http(f"http://{srv.addr()}/healthz")
+        assert code == 503 and health["model_seq"] is None
+        code, live, _ = _http(f"http://{srv.addr()}/livez")
+        assert code == 200 and live["ok"]
+    finally:
+        srv.close()
+    # ready once a model is served; not ready once it goes stale past the
+    # ceiling (the replica lost its publish feed — it must leave the
+    # routing set, not serve ancient weights forever)
+    d = str(tmp_path)
+    trainer = _Trainer(d, w=np.float32(1.0))
+    pub = Publisher(d, every=1, clock=lambda: 1000.0,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    rec = pub.maybe_publish(trainer.commit())
+    now = [1001.0]
+    reg = ModelRegistry(store=pub.store, clock=lambda: now[0])
+    assert reg.adopt(rec)
+    srv = InferenceServer(reg, lambda p, i, n: [0.0] * len(i),
+                          buckets=(1,), window_s=0.0, request_timeout_s=5.0)
+    try:
+        monkeypatch.setenv("HOROVOD_SERVING_MAX_STALENESS_SECONDS", "50")
+        code, health, _ = _http(f"http://{srv.addr()}/healthz")
+        assert code == 200 and health["ok"]
+        assert health["staleness_s"] == pytest.approx(1.0)
+        now[0] = 1100.0                  # 100s stale > 50s ceiling
+        code, health, _ = _http(f"http://{srv.addr()}/healthz")
+        assert code == 503 and health["ok"] is False
+        assert health["staleness_s"] == pytest.approx(100.0)
+        monkeypatch.setenv("HOROVOD_SERVING_MAX_STALENESS_SECONDS", "0")
+        code, health, _ = _http(f"http://{srv.addr()}/healthz")
+        assert code == 200                # 0 disables the staleness gate
+    finally:
+        srv.close()
