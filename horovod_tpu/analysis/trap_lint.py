@@ -193,6 +193,22 @@ CLAUDE.md "Environment traps"):
   of piling on (``serving/server.py::InferenceServer._admit``,
   docs/fleet.md "Overload containment").
 
+- ``lint-heavy-signal-handler`` (WARNING): a handler registered with
+  ``signal.signal`` whose body performs blocking work — an RPC
+  (``urlopen``/``requests.*``), a device fetch
+  (``block_until_ready``/``device_get``), or a file write (``open``/
+  ``.write``/``fsync``/``json.dump``).  Signal handlers run at an
+  arbitrary bytecode boundary INSIDE whatever the main thread was doing:
+  re-entering an HTTP client mid-request deadlocks it, a device fetch
+  can re-enter the runtime under its own lock, and buffered I/O is not
+  reentrant (CPython may raise, or interleave corrupted output).  The
+  vetted pattern is ``core/lifecycle.py``: the handler only sets a flag
+  and ``os.write``s one byte to a nonblocking self-pipe (the only
+  async-signal-safe write), and a watcher thread does everything heavy
+  outside signal context.  ``os.write``/``os.kill``/``signal.signal``
+  are exempt (they ARE the safe vocabulary); ``SIG_IGN``/``SIG_DFL``
+  dispositions never trip this.
+
 Suppress any finding by putting ``# hvd-analyze: ok`` on the flagged
 line.
 """
@@ -375,6 +391,33 @@ def _admission_shed_evidence(node) -> bool:
                                            or "cap" in st.lower()):
                         return True
     return False
+
+
+# lint-heavy-signal-handler vocabulary: the blocking calls that must
+# never run in signal context, by class. ``write`` counts only as a
+# METHOD (dotted) and never on the os module — ``os.write`` to a
+# nonblocking self-pipe is the one async-signal-safe write and exactly
+# what the vetted handler (core/lifecycle.py) does.
+HANDLER_RPC_NAMES = frozenset({"urlopen"})
+HANDLER_FETCH_NAMES = frozenset({"block_until_ready", "device_get"})
+HANDLER_WRITE_NAMES = frozenset({"open", "fsync", "dump"})
+HANDLER_DISPOSITIONS = frozenset({"SIG_IGN", "SIG_DFL"})
+
+
+def _heavy_handler_call_kind(name: str) -> Optional[str]:
+    """Classify a dotted call name as handler-unsafe, or None."""
+    parts = name.split(".")
+    last = parts[-1]
+    prefix = ".".join(parts[:-1])
+    if last in HANDLER_RPC_NAMES or parts[0] == "requests":
+        return "RPC"
+    if last in HANDLER_FETCH_NAMES:
+        return "device fetch"
+    if last in HANDLER_WRITE_NAMES:
+        return "file write"
+    if last == "write" and prefix and prefix != "os":
+        return "file write"
+    return None
 
 
 # lint-xplane-umbrella vocabulary: the umbrella prefixes whose presence
@@ -733,6 +776,7 @@ class _Lint(ast.NodeVisitor):
                     self.cadences.append(kw.value.value)
 
         self._check_accum_psum_order(node, name)
+        self._check_heavy_signal_handler(node, name)
 
         if self._loop_depth > 0 and _is_telemetry_record(name):
             fetches = [
@@ -790,6 +834,65 @@ class _Lint(ast.NodeVisitor):
                 self.slope_windows.append((node, windows))
 
         self.generic_visit(node)
+
+    def _resolve_handler_body(self, arg):
+        """Resolve a signal-handler argument to walkable statements: a
+        Lambda inline, a Name or ``self._method`` Attribute via the
+        module prescan (``_funcdefs`` holds methods too — ast.walk).
+        None for SIG_IGN/SIG_DFL dispositions and unresolvable refs."""
+        if isinstance(arg, ast.Lambda):
+            return [arg.body]
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            last = _dotted(arg).split(".")[-1]
+            if last in HANDLER_DISPOSITIONS:
+                return None
+            fn = self._funcdefs.get(last)
+            if fn is not None:
+                return list(fn.body)
+        return None
+
+    def _check_heavy_signal_handler(self, node, name):
+        """lint-heavy-signal-handler: blocking work lexically inside a
+        ``signal.signal``-registered handler body.  One finding per
+        registration, anchored at the registration call (the handler
+        function may be registered from several places with different
+        vetting)."""
+        parts = name.split(".")
+        if parts[-1] != "signal" or len(node.args) < 2:
+            return
+        prefix = ".".join(parts[:-1])
+        if prefix and "signal" not in prefix.lower():
+            return  # some other object's .signal() method
+        body = self._resolve_handler_body(node.args[1])
+        if body is None:
+            return
+        heavy = []
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                kind = _heavy_handler_call_kind(dotted)
+                if kind is not None:
+                    heavy.append((kind, dotted, sub.lineno))
+        if heavy:
+            kinds = sorted({k for k, _, _ in heavy})
+            self._add(
+                "lint-heavy-signal-handler", Severity.WARNING, node,
+                f"signal handler does blocking work "
+                f"({', '.join(kinds)}: "
+                f"{', '.join(sorted({d for _, d, _ in heavy}))}): "
+                "handlers run at an arbitrary bytecode boundary inside "
+                "whatever the main thread was doing — an RPC re-enters "
+                "the HTTP client mid-request, a device fetch can "
+                "re-enter the runtime under its own lock, and buffered "
+                "file I/O is not reentrant. Set a flag and os.write one "
+                "byte to a nonblocking self-pipe, then do the heavy "
+                "work on a watcher thread outside signal context "
+                "(core/lifecycle.py is the vetted pattern), or pragma "
+                "a handler proven to run only on a quiesced process",
+                {"calls": [{"kind": k, "call": d, "line": ln}
+                           for k, d, ln in heavy]})
 
     def _check_blocking_commit(self, node):
         """lint-blocking-commit: in a loop that calls ``.commit()``, a

@@ -26,6 +26,22 @@ class HostsUpdatedInterrupt(RuntimeError):
         self.skip_sync = skip_sync
 
 
+class PreemptionInterrupt(HostsUpdatedInterrupt):
+    """Raised at the step seam when the lifecycle plane observed a
+    preemption notice (SIGTERM/SIGUSR1 — core/lifecycle.py).
+
+    Subclasses :class:`HostsUpdatedInterrupt` so code that only knows the
+    graceful-reset path handles it identically; the elastic ``run_fn``
+    wrapper distinguishes it to drain commits, dump the flight ring,
+    post the journaled coordinator ``preempt`` notice, and exit with
+    ``PREEMPT_EXIT_CODE`` (host-cooldown, not blacklist).
+    """
+
+    def __init__(self, signum: int = 0):
+        super().__init__(skip_sync=True)
+        self.signum = signum
+
+
 class NotInitializedError(RuntimeError):
     """An API needing an initialised context was called before ``init()``."""
 

@@ -1,0 +1,237 @@
+"""Preemption lifecycle plane: signal-safe SIGTERM/SIGUSR1 handoff.
+
+No direct upstream analog (SURVEY.md §2: upstream elastic reacts to
+*discovered* membership change via ``HostsUpdatedRequest``; Determined's
+fork layers announced preemption on top — this module is that layer,
+TPU-process-restart shaped). TPU maintenance events and spot reclaims
+deliver SIGTERM with a grace window; the plane turns that into a
+graceful handoff instead of a crash:
+
+- The handler itself is strictly async-signal-safe: it stores two plain
+  attributes and writes one byte to a self-pipe (``os.write`` on an O_NONBLOCK
+  fd is on the async-signal-safe list). No locks, no allocation beyond
+  the bytes literal, no RPC, no device fetch, no file I/O — the
+  ``lint-heavy-signal-handler`` rule in hvd-analyze enforces this shape
+  repo-wide (this module carries the vetted pattern).
+- Training observes the flag at the step seam: ``State.check_host_updates``
+  consults :func:`preempt_requested` and raises
+  :class:`~.exceptions.PreemptionInterrupt` — the ``state.commit()`` that
+  triggered the check already persisted (``save()`` runs first), so the
+  seam commit IS the out-of-cadence commit the grace window buys.
+- Serving (and anything else that drains rather than steps) registers a
+  callback: a watcher thread parked on the self-pipe runs callbacks
+  OUTSIDE signal context, so ``ReplicaAgent.drain()`` — RPC + joins —
+  stays legal.
+- A second signal escalates: the handler restores ``SIG_DFL`` and
+  re-raises, so an impatient supervisor can still force-kill a worker
+  wedged on its way to the seam.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, List, Optional
+
+from .logging import get_logger
+
+#: re-exported here so core/ does not import elastic/ at module load.
+PREEMPT_SIGNALS_ENV = "HOROVOD_PREEMPT_SIGNALS"
+DEFAULT_PREEMPT_SIGNALS = "SIGTERM,SIGUSR1"
+
+
+class _LifecyclePlane:
+    """One process-wide signal plane (module singleton below)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._installed = False
+        self._requested = False
+        self._signum = 0
+        self._wake_r: Optional[int] = None
+        self._wake_w: Optional[int] = None
+        self._callbacks: List[Callable[[int], None]] = []
+        self._watcher: Optional[threading.Thread] = None
+        self._prev_handlers: dict = {}
+
+    # -- the handler (async-signal-safe: attribute stores + os.write) --------
+
+    def _handler(self, signum, frame):  # pragma: no cover - exercised via kill
+        if self._requested:
+            # Second notice: the supervisor is out of patience. Restore
+            # default disposition and re-deliver so the process dies the
+            # normal way instead of looping through us.
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self._signum = signum
+        self._requested = True
+        w = self._wake_w
+        if w is not None:
+            try:
+                os.write(w, b"p")
+            except OSError:
+                pass
+
+    # -- installation --------------------------------------------------------
+
+    def install(self, signals: Optional[List[int]] = None) -> bool:
+        """Install the preemption handler on the main thread.
+
+        Returns False (and installs nothing) off the main thread
+        (``signal.signal`` raises there — thread-sim ranks must not fight
+        over process-wide dispositions) or when ``HOROVOD_PREEMPT_SIGNALS``
+        is set to the empty string. Idempotent.
+        """
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        with self._lock:
+            if self._installed:
+                return True
+            sigs = signals if signals is not None else self._signals_from_env()
+            if not sigs:
+                return False
+            r, w = os.pipe()
+            os.set_blocking(w, False)
+            self._wake_r, self._wake_w = r, w
+            for signum in sigs:
+                try:
+                    self._prev_handlers[signum] = signal.signal(
+                        signum, self._handler)
+                except (OSError, ValueError) as err:
+                    get_logger().warning(
+                        "lifecycle: cannot install handler for %s: %s",
+                        signum, err)
+            self._watcher = threading.Thread(
+                target=self._watch, name="hvd-lifecycle", daemon=True)
+            self._watcher.start()
+            self._installed = True
+            return True
+
+    @staticmethod
+    def _signals_from_env() -> List[int]:
+        raw = os.environ.get(PREEMPT_SIGNALS_ENV, DEFAULT_PREEMPT_SIGNALS)
+        sigs: List[int] = []
+        for name in raw.split(","):
+            name = name.strip().upper()
+            if not name:
+                continue
+            num = getattr(signal, name, None) if name.startswith("SIG") \
+                else getattr(signal, f"SIG{name}", None)
+            if num is not None:
+                sigs.append(int(num))
+            else:
+                get_logger().warning("lifecycle: unknown signal %r in %s",
+                                     name, PREEMPT_SIGNALS_ENV)
+        return sigs
+
+    # -- observation ---------------------------------------------------------
+
+    def preempt_requested(self) -> bool:
+        return self._requested
+
+    def preempt_signum(self) -> int:
+        return self._signum
+
+    def request_preempt(self, signum: int = 0) -> None:
+        """Set the flag without a real signal (tests, in-process drills)."""
+        self._signum = signum or int(signal.SIGTERM)
+        self._requested = True
+        w = self._wake_w
+        if w is not None:
+            try:
+                os.write(w, b"p")
+            except OSError:
+                pass
+
+    # -- callbacks (run by the watcher thread, never in signal context) ------
+
+    def add_callback(self, fn: Callable[[int], None]) -> None:
+        fire_now = False
+        with self._lock:
+            self._callbacks.append(fn)
+            fire_now = self._requested
+        if fire_now:
+            self._run_callback(fn)
+
+    def _run_callback(self, fn: Callable[[int], None]) -> None:
+        try:
+            fn(self._signum)
+        except Exception as err:  # noqa: BLE001 — one callback must not
+            get_logger().warning(    # kill the teardown of the others
+                "lifecycle: preempt callback %r failed: %s", fn, err)
+
+    def _watch(self) -> None:
+        r = self._wake_r
+        if r is None:
+            return
+        try:
+            os.read(r, 1)
+        except OSError:
+            return
+        with self._lock:
+            callbacks = list(self._callbacks)
+        get_logger().warning(
+            "lifecycle: preemption notice (signal %d) — running %d drain "
+            "callback(s), training exits at the next step seam",
+            self._signum, len(callbacks))
+        for fn in callbacks:
+            self._run_callback(fn)
+
+    # -- teardown (tests) ----------------------------------------------------
+
+    def uninstall(self) -> None:
+        """Restore previous dispositions and reset state (test isolation)."""
+        with self._lock:
+            if threading.current_thread() is threading.main_thread():
+                for signum, prev in self._prev_handlers.items():
+                    try:
+                        signal.signal(signum, prev)
+                    except (OSError, ValueError):
+                        pass
+            self._prev_handlers.clear()
+            for fd in (self._wake_r, self._wake_w):
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+            self._wake_r = self._wake_w = None
+            self._watcher = None
+            self._installed = False
+            self._requested = False
+            self._signum = 0
+            self._callbacks = []
+
+
+_plane = _LifecyclePlane()
+
+
+def install(signals: Optional[List[int]] = None) -> bool:
+    """Install the process-wide preemption handler (main thread only)."""
+    return _plane.install(signals)
+
+
+def uninstall() -> None:
+    _plane.uninstall()
+
+
+def preempt_requested() -> bool:
+    """True once a preemption notice arrived (signal or drill)."""
+    return _plane.preempt_requested()
+
+
+def preempt_signum() -> int:
+    return _plane.preempt_signum()
+
+
+def request_preempt(signum: int = 0) -> None:
+    """Raise the flag without a real signal (tests, in-process drills)."""
+    _plane.request_preempt(signum)
+
+
+def add_preempt_callback(fn: Callable[[int], None]) -> None:
+    """Run ``fn(signum)`` on the watcher thread once preemption is
+    noticed (immediately if it already was)."""
+    _plane.add_callback(fn)

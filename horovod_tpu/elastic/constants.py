@@ -22,8 +22,50 @@ ABORT_EXIT_CODE = 74
 #: from the last blake2b-verified commit.
 EVICT_EXIT_CODE = 75
 
+#: Worker exit code for "I was preempted and handed off gracefully"
+#: (core/lifecycle.py caught SIGTERM/SIGUSR1, the run_fn wrapper committed
+#: out-of-cadence, drained the commit writer, dumped the flight ring, and
+#: posted a journaled ``preempt`` notice). The driver maps this to a
+#: host COOLDOWN (PREEMPT_COOLDOWN_ENV) instead of a blacklist strike —
+#: a reclaimed spot host is healthy, just temporarily gone, and must be
+#: re-admitted when discovery shows it back.
+PREEMPT_EXIT_CODE = 76
+
+#: env: seconds a preempted host sits out of rendezvous before the driver
+#: re-admits it (maintenance events and spot reclaims re-offer the host
+#: quickly; admitting it instantly would thrash the generation). Distinct
+#: from the blacklist: no strikes accrue and the host is never banned.
+PREEMPT_COOLDOWN_ENV = "HOROVOD_PREEMPT_COOLDOWN_SECONDS"
+DEFAULT_PREEMPT_COOLDOWN_S = 30.0
+
+#: env: hard floor on world size. When preemptions shrink the available
+#: slots below it, the driver PAUSES rendezvous (bounded by
+#: MIN_NP_WAIT_ENV) instead of launching a degraded world — preempted
+#: hosts usually come back within their cooldown.
+MIN_NP_ENV = "HOROVOD_MIN_NP"
+
+#: env: how long the driver's rendezvous pause waits for the world to
+#: recover above HOROVOD_MIN_NP before giving up (TimeoutError → abort),
+#: measured from the moment slots first dropped below the floor.
+MIN_NP_WAIT_ENV = "HOROVOD_MIN_NP_WAIT_SECONDS"
+DEFAULT_MIN_NP_WAIT_S = 120.0
+
+#: env: comma-separated signal names the lifecycle plane treats as a
+#: preemption notice (core/lifecycle.py). Empty string disables handler
+#: installation entirely (standalone runs that own their signals).
+PREEMPT_SIGNALS_ENV = "HOROVOD_PREEMPT_SIGNALS"
+DEFAULT_PREEMPT_SIGNALS = "SIGTERM,SIGUSR1"
+
 #: env: address of the driver's coordinator service (host:port).
 COORD_ADDR_ENV = "HOROVOD_ELASTIC_COORD_ADDR"
+
+#: env: operator-owned coordinator state directory. When set, the driver
+#: keeps its journal + address file HERE and does NOT delete the
+#: directory at job end — the journal is then auditable post-run
+#: (``journal.replay(path)`` must reproduce the coordinator's final
+#: view; the chaos-soak harness asserts exactly that). Unset: a private
+#: tempdir, removed with the job (the pre-soak behavior).
+COORD_DIR_ENV = "HOROVOD_COORD_DIR"
 
 #: env: the membership version a worker generation was launched with.
 WORLD_VERSION_ENV = "HOROVOD_ELASTIC_WORLD_VERSION"

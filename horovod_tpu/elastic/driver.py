@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal as _signal
 import tempfile
 import threading
 import time
@@ -100,12 +101,29 @@ class ElasticDriver:
             self._discovery = FixedHostDiscovery(
                 {h.hostname: h.slots for h in settings.hosts})
         self._blacklist = Blacklist(cooldown_s=settings.blacklist_cooldown_s)
+        # Preempted hosts (PREEMPT_EXIT_CODE) sit out a cooldown instead
+        # of accruing blacklist strikes: hostname -> monotonic deadline.
+        # A reclaimed spot host is healthy, just temporarily gone; once
+        # the deadline passes, discovery re-admits it and the membership
+        # watch publishes the gain as a graceful bump.
+        self._preempt_cooldown: Dict[str, float] = {}
         self._key = _secret.make_secret_key()
         # Control-plane durability (docs/failure_model.md): the service
         # journals every mutation so a crashed service is rebuilt with its
         # monotonic counters intact, and the address file lets workers
         # follow it to the rebuilt (fresh-port) instance.
-        self._coord_dir = tempfile.mkdtemp(prefix="hvd_coord_")
+        # Operator-owned coordinator dir (HOROVOD_COORD_DIR) survives the
+        # job so the journal stays auditable — journal.replay(path) must
+        # reproduce the coordinator's final view (the soak harness checks
+        # this invariant after every run). Unset: private tempdir, removed
+        # in run()'s finally.
+        coord_dir = os.environ.get(C.COORD_DIR_ENV)
+        self._coord_dir_owned = not coord_dir
+        if coord_dir:
+            os.makedirs(coord_dir, exist_ok=True)
+            self._coord_dir = coord_dir
+        else:
+            self._coord_dir = tempfile.mkdtemp(prefix="hvd_coord_")
         self._journal_path = os.path.join(self._coord_dir,
                                           "coordinator.journal")
         self._addr_file = os.path.join(self._coord_dir, "coordinator.addr")
@@ -130,8 +148,41 @@ class ElasticDriver:
     # -- membership ----------------------------------------------------------
 
     def effective_hosts(self) -> Dict[str, int]:
-        return self._blacklist.filter(
+        hosts = self._blacklist.filter(
             self._discovery.find_available_hosts_and_slots())
+        return {h: s for h, s in hosts.items()
+                if not self._in_preempt_cooldown(h)}
+
+    # -- preemption cooldown (announced departures; docs/failure_model.md) ---
+
+    @staticmethod
+    def _preempt_cooldown_s() -> float:
+        try:
+            return max(0.0, float(os.environ.get(
+                C.PREEMPT_COOLDOWN_ENV, str(C.DEFAULT_PREEMPT_COOLDOWN_S))))
+        except ValueError:
+            return C.DEFAULT_PREEMPT_COOLDOWN_S
+
+    def _note_preempt(self, host: str) -> None:
+        cool = self._preempt_cooldown_s()
+        if cool <= 0:
+            return
+        self._preempt_cooldown[host] = time.monotonic() + cool
+        get_logger().warning(
+            "host %s preempted (graceful handoff) — cooling down %.0fs "
+            "before re-admission, no blacklist strike", host, cool)
+
+    def _in_preempt_cooldown(self, host: str) -> bool:
+        until = self._preempt_cooldown.get(host)
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            del self._preempt_cooldown[host]
+            get_logger().info(
+                "host %s preempt cooldown expired — eligible for "
+                "re-admission", host)
+            return False
+        return True
 
     def _target_np(self, hosts: Dict[str, int]) -> int:
         total = sum(hosts.values())
@@ -139,22 +190,59 @@ class ElasticDriver:
             total = min(total, self._settings.max_np)
         return total
 
+    def _min_np_floor(self) -> int:
+        """The rendezvous floor: ``--min-np`` raised by the degraded-mode
+        env floor (``HOROVOD_MIN_NP``) operators set independently of the
+        launch flags."""
+        floor = self._settings.min_np or 1
+        env = os.environ.get(C.MIN_NP_ENV)
+        if env:
+            try:
+                floor = max(floor, int(env))
+            except ValueError:
+                pass
+        return floor
+
     def _enough(self, hosts: Dict[str, int]) -> bool:
-        need = self._settings.min_np or 1
-        return sum(hosts.values()) >= need
+        return sum(hosts.values()) >= self._min_np_floor()
 
     def wait_for_available_slots(self, timeout_s: Optional[float] = None
                                  ) -> Dict[str, int]:
         """Block until >= min_np slots are discoverable (reference:
-        driver.wait_for_available_slots)."""
+        driver.wait_for_available_slots).
+
+        Degraded-mode floor: when the shortfall traces to preempted hosts
+        sitting out their cooldown, rendezvous PAUSES (bounded by
+        ``HOROVOD_MIN_NP_WAIT_SECONDS``, measured from the first short
+        discovery) instead of aborting — an announced reclaim usually
+        re-offers the host within its cooldown."""
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        paused_since: Optional[float] = None
+        try:
+            min_np_wait = max(0.0, float(os.environ.get(
+                C.MIN_NP_WAIT_ENV, str(C.DEFAULT_MIN_NP_WAIT_S))))
+        except ValueError:
+            min_np_wait = C.DEFAULT_MIN_NP_WAIT_S
         while True:
             hosts = self.effective_hosts()
             if self._enough(hosts):
                 return hosts
-            if deadline and time.monotonic() > deadline:
+            now = time.monotonic()
+            if self._preempt_cooldown:
+                if paused_since is None:
+                    paused_since = now
+                    get_logger().warning(
+                        "world below the min-np floor (%d) with %d "
+                        "preempted host(s) in cooldown — pausing "
+                        "rendezvous up to %.0fs for their re-admission",
+                        self._min_np_floor(), len(self._preempt_cooldown),
+                        min_np_wait)
+                if now - paused_since <= min_np_wait:
+                    time.sleep(self._settings.discovery_interval_s)
+                    continue
+            if deadline and now > deadline:
                 raise TimeoutError(
-                    f"timed out waiting for {self._settings.min_np or 1} "
+                    f"timed out waiting for {self._min_np_floor()} "
                     f"slots; discovered {hosts}")
             time.sleep(self._settings.discovery_interval_s)
 
@@ -289,6 +377,14 @@ class ElasticDriver:
                                    f"generation.{version}")
         codes: Dict[str, int] = {}
         lock = threading.Lock()
+        # Generation-sticky graceful-retirement signal (see run_one). An
+        # Event, not a re-read of the service's preempt list: once the
+        # victim's exit-76 is classified, _note_preempt starts the
+        # cooldown, effective_hosts() drops the host, and the membership
+        # watcher's next ~1 s tick calls update_world — which CLEARS the
+        # service's preempt list. A collateral SIGABRT reaped after that
+        # tick (reaping lags under load) must still see the signal.
+        graceful_retiring = threading.Event()
 
         if self._settings.start_timeout_s:
             def _registration_watch():
@@ -323,10 +419,49 @@ class ElasticDriver:
                 # report hanging off the failure_seq advance — whenever a
                 # rescued survivor's RESTART exit won the race with the
                 # victim's own exit-code delivery.
+                # A SIGABRT death while the generation is already RETIRING
+                # GRACEFULLY is the runtime's own fate-sharing collateral
+                # (jax's coordination service aborts peers of a departed
+                # task within milliseconds — often before the departed
+                # worker's exit code reaches run_one and sets the stop
+                # event), not an organic failure: a failure record here
+                # would burn the peer-grace window and a blacklist strike
+                # on a host that did nothing wrong. Graceful retirement is
+                # detected by TWO signals, because either alone races:
+                #  - a PREEMPT exit or a preempt notice still visible on
+                #    the service — sticky via the Event (the membership
+                #    watcher's update_world clears the preempt list ~1 s
+                #    after the cooldown starts);
+                #  - the service version moved past this generation's
+                #    launch version: every graceful shrink/grow (preempt
+                #    notice, hosts-gained reset at a commit seam) bumps
+                #    VERSION before any collateral abort can occur, while
+                #    crashes bump only failure_seq.
+                # Deliberately NOT a trigger: a peer's RESTART exit (a
+                # rescued survivor's 73 racing ahead of the crash victim's
+                # own code delivery must not excuse the victim), and any
+                # non-SIGABRT signal (a SIGKILLed victim stays a failure
+                # even if a graceful reset is concurrently in flight).
+                if (code == C.PREEMPT_EXIT_CODE
+                        and not note.get("swept")) \
+                        or self._service.preempts_view():
+                    graceful_retiring.set()
+                graceful_collateral = (
+                    code == -_signal.SIGABRT
+                    and (graceful_retiring.is_set()
+                         or self._service.version > version))
                 if code == C.EVICT_EXIT_CODE or (
-                        code != C.RESTART_EXIT_CODE
-                        and not note.get("swept")):
+                        code not in (C.RESTART_EXIT_CODE,
+                                     C.PREEMPT_EXIT_CODE)
+                        and not note.get("swept")
+                        and not graceful_collateral):
                     self._service.mark_failure(a.hostname, code)
+                # An organic PREEMPT exit (the worker itself caught the
+                # reclaim signal — not our sweep's collateral SIGTERM)
+                # starts the host's cooldown; the victim already posted
+                # the graceful /preempt notice before exiting.
+                if code == C.PREEMPT_EXIT_CODE and not note.get("swept"):
+                    self._note_preempt(a.hostname)
                 stop.set()
 
         threads = [threading.Thread(target=run_one, args=(a,), daemon=True)
@@ -342,7 +477,17 @@ class ElasticDriver:
     def run(self) -> int:
         """The elastic job loop; returns the job's final exit code."""
         s = self._settings
-        commit_dir = tempfile.mkdtemp(prefix="hvd_elastic_")
+        # An operator-set commit dir (HOROVOD_ELASTIC_COMMIT_DIR) is
+        # reused and kept: the last published commit is then resumable
+        # AFTER the job ends (a fresh ObjectState.load_latest() must see
+        # the final step — another soak invariant). Unset: private
+        # tempdir, removed below.
+        commit_dir = os.environ.get(C.COMMIT_DIR_ENV)
+        commit_dir_owned = not commit_dir
+        if commit_dir:
+            os.makedirs(commit_dir, exist_ok=True)
+        else:
+            commit_dir = tempfile.mkdtemp(prefix="hvd_elastic_")
         self._commit_dir = commit_dir
         try:
             while True:
@@ -385,8 +530,10 @@ class ElasticDriver:
             # tmp cleaning reaps them — same lifecycle as the reference's
             # per-worker scratch.)
             import shutil
-            shutil.rmtree(commit_dir, ignore_errors=True)
-            shutil.rmtree(self._coord_dir, ignore_errors=True)
+            if commit_dir_owned:
+                shutil.rmtree(commit_dir, ignore_errors=True)
+            if self._coord_dir_owned:
+                shutil.rmtree(self._coord_dir, ignore_errors=True)
 
     # -- post-mortem assembly ------------------------------------------------
 
@@ -484,7 +631,11 @@ class ElasticDriver:
                 # default 2-strike policy, and a value-corrupt replica
                 # must not get a second chance to poison the collectives.
                 self._blacklist.ban(host, "sentinel evict")
-            elif c not in (0, C.RESTART_EXIT_CODE) and c > 0:
+            elif c not in (0, C.RESTART_EXIT_CODE,
+                           C.PREEMPT_EXIT_CODE) and c > 0:
+                # PREEMPT is excluded on purpose: an announced reclaim is
+                # neither a strike nor a ban — run_one already started the
+                # host's cooldown.
                 self._blacklist.record_failure(host)
         return "reset"
 

@@ -56,6 +56,7 @@ def empty_state() -> Dict[str, Any]:
         "metrics": {},
         "publish": None, "publish_seq": 0,
         "replicas": {}, "arbiter_seq": 0, "fleet": None,
+        "preempts": [],
     }
 
 
@@ -70,10 +71,24 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         state["hosts"] = dict(rec["hosts"])
         state["np"] = int(rec["np"])
         state["failures"] = []   # per-generation, cleared by update
+        state["preempts"] = []   # ditto — a new generation starts clean
     elif op == "failure":
         state["failure_seq"] = int(rec["seq"])
         state["failures"].append(
             {"host": rec["host"], "code": int(rec["code"])})
+    elif op == "preempt":
+        # Announced graceful departure (core/lifecycle.py): a membership
+        # shrink like "world", carried on the same version counter so
+        # survivors take the GRACEFUL reset path — failure_seq is
+        # deliberately untouched, so the peer-failure grace deadline
+        # (core/watchdog.py) never arms for a preemption.
+        state["version"] = int(rec["version"])
+        state["hosts"] = dict(rec["hosts"])
+        state["np"] = int(rec["np"])
+        state["failures"] = []
+        # setdefault: the delta-protocol client replays onto a state dict
+        # holding only the WORLD_KEYS payload.
+        state.setdefault("preempts", []).append({"host": rec["host"]})
     elif op == "register":
         state["registrations"][str(rec["process_id"])] = float(rec["ts"])
     elif op == "register_batch":
@@ -153,6 +168,7 @@ def apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> bool:
         state["arbiter_seq"] = int(snap.get("arbiter_seq", 0))
         fleet = snap.get("fleet")
         state["fleet"] = dict(fleet) if fleet is not None else None
+        state["preempts"] = [dict(p) for p in snap.get("preempts", [])]
     else:
         return False
     return True

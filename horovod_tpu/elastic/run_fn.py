@@ -31,8 +31,10 @@ import os
 import sys
 from typing import Callable
 
+from ..core import lifecycle as _lifecycle
 from ..core import telemetry as _telemetry
-from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..core.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                               PreemptionInterrupt)
 from ..core.logging import get_logger
 from . import constants as C
 from .state import State
@@ -84,6 +86,47 @@ def _reinitialize() -> None:
     monitor().reset_for_recovery()
 
 
+def _graceful_handoff(state: State, signum: int) -> None:
+    """The preemption exit sequence (core/lifecycle.py caught the reclaim
+    signal; ``check_host_updates`` raised at the seam AFTER ``save()`` ran
+    — the out-of-cadence commit is already in flight): drain the commit
+    writer so it is durable, dump the flight ring (graceful teardown must
+    not lose the victim's trace — incident assembly reads these), post
+    the journaled coordinator ``preempt`` notice so peers reset
+    gracefully, and exit with the code the driver maps to host-cooldown."""
+    _telemetry.inc("hvd_preempt_handoffs_total")
+    _telemetry.record_event("preempt", signum=int(signum))
+    _drain_commits(state)
+    dump = _telemetry.dump_flight("preempt")
+    if dump:
+        # Logged (not just written): later generations reuse the rank's
+        # flight file name, so the victim's dump path in the job log is
+        # the durable pointer post-mortems grep for.
+        get_logger().info("preempt flight ring dumped to %s", dump)
+    from .state import notification_manager
+    client = getattr(notification_manager, "_client", None)
+    host = os.environ.get("HOROVOD_HOSTNAME")
+    if client is not None and host:
+        try:
+            client.notify_preempt(host)
+        except Exception as err:    # noqa: BLE001 — best-effort; the exit
+            get_logger().warning(    # code alone still skips the blacklist
+                "preempt notice to the coordinator failed: %s", err)
+    get_logger().warning(
+        "preemption handoff complete (signal %d) — exiting with "
+        "PREEMPT_EXIT_CODE for host-cooldown relaunch", signum)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # HARD exit (no atexit): sys.exit would run the distributed runtime's
+    # shutdown barrier, which blocks until every peer also shuts down —
+    # but the peers are NOT exiting with us, they are parked in the next
+    # collective waiting for the graceful /world push. A victim wedged in
+    # that barrier never delivers its exit code, so the driver never
+    # starts the cooldown, and the runtime eventually F-aborts the whole
+    # generation as if the departure were a crash.
+    os._exit(C.PREEMPT_EXIT_CODE)
+
+
 def run(func: Callable) -> Callable:
     """Decorate ``func(state, *args, **kwargs)`` with the elastic loop."""
 
@@ -95,6 +138,13 @@ def run(func: Callable) -> Callable:
         from .state import notification_manager
         notification_manager.init_from_env()
         notification_manager.register()
+        # Preemption plane: catch SIGTERM/SIGUSR1 and hand off gracefully
+        # at the next step seam. Only meaningful under a driver that maps
+        # PREEMPT_EXIT_CODE to a cooldown relaunch (restart mode); install
+        # is a no-op off the main thread (thread-sim ranks) and under
+        # HOROVOD_PREEMPT_SIGNALS="".
+        if _mode() == "restart":
+            _lifecycle.install()
         # Process-restart resume: adopt the newest persisted commit (no-op
         # when there is none or no commit dir is configured).
         if hasattr(state, "load_latest") and state.load_latest():
@@ -152,6 +202,14 @@ def run(func: Callable) -> Callable:
                 # states can differ — re-sync from rank 0 (the reference's
                 # run_fn also syncs on the retry path).
                 state.sync()
+            except PreemptionInterrupt as e:
+                # MUST precede HostsUpdatedInterrupt (its parent class).
+                # The seam commit already saved; hand off and exit with
+                # the cooldown code — never the blacklist-feeding one.
+                get_logger().warning(
+                    "preemption observed at the step seam (signal %d): "
+                    "graceful handoff", e.signum)
+                _graceful_handoff(state, e.signum)
             except HostsUpdatedInterrupt as e:
                 get_logger().info("hosts updated: resetting")
                 _telemetry.inc("hvd_generation_changes_total")

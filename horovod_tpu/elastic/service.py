@@ -218,6 +218,12 @@ class CoordinatorService:
         # survivor does not re-arm on its predecessor's death.
         self._failures: list = []
         self._failure_seq = 0
+        # Announced graceful departures (core/lifecycle.py → POST
+        # /preempt). Generation-scoped like ``_failures`` (cleared by
+        # update_world) but carried on the VERSION counter: survivors take
+        # the graceful HostsUpdatedInterrupt path and the peer-failure
+        # grace deadline never arms for an announced exit.
+        self._preempts: list = []
         # Delta window: (eid, record) pairs in journal-record format; eid
         # is version+failure_seq AFTER the record applied (consecutive —
         # each mutation bumps exactly one counter by 1). Registrations do
@@ -270,6 +276,8 @@ class CoordinatorService:
                 self._np = state["np"]
                 self._failures = state["failures"]
                 self._failure_seq = state["failure_seq"]
+                self._preempts = [dict(p) for p
+                                  in state.get("preempts", [])]
                 self._started = {int(k): v for k, v
                                  in state["registrations"].items()}
                 self._metrics = state.get("metrics", {})
@@ -434,6 +442,18 @@ class CoordinatorService:
                     # register / drain / deregister, journaled.
                     ok = svc._record_replica(msg)
                     self._reply({"ok": ok})
+                elif self.path == "/preempt":
+                    # Graceful-departure notice (core/lifecycle.py via
+                    # run_fn): journaled world shrink on the VERSION
+                    # counter — survivors reset gracefully, no
+                    # peer-failure grace window burns.
+                    try:
+                        host = str(msg["host"])
+                    except (KeyError, TypeError):
+                        self._reply({"error": "bad preempt"}, 400)
+                        return
+                    svc.mark_preempt(host)
+                    self._reply({"ok": True})
                 else:
                     get_logger().debug(
                         "coordinator: unknown POST path %s from %s",
@@ -519,6 +539,7 @@ class CoordinatorService:
             state["arbiter_seq"] = self._arbiter_seq
             state["fleet"] = dict(self._fleet) \
                 if self._fleet is not None else None
+            state["preempts"] = [dict(p) for p in self._preempts]
             self._journal.compact(state)
 
     def _record_register(self, process_id: int, ts: float) -> None:
@@ -785,6 +806,7 @@ class CoordinatorService:
             self._hosts = dict(hosts)
             self._np = np_
             self._failures = []   # failures are per-generation; seq stays
+            self._preempts = []   # ditto — the new generation starts clean
             self._events.append(
                 (self._version + self._failure_seq,
                  {"op": "world", "version": self._version,
@@ -796,6 +818,44 @@ class CoordinatorService:
                 self._maybe_compact_locked()
             self._cond.notify_all()
             return self._version
+
+    def mark_preempt(self, host: str) -> int:
+        """Record an ANNOUNCED graceful departure (the preempted worker's
+        run_fn posts this after its out-of-cadence commit): drop the host
+        from the membership view and publish the shrink on the VERSION
+        counter — the same wake path as :meth:`update_world`, so
+        survivors take the graceful ``HostsUpdatedInterrupt`` reset.
+        ``failure_seq`` is deliberately untouched: the peer-failure grace
+        deadline (core/watchdog.py) must never arm for a preemption.
+        Returns the new version. Idempotent per (host, generation)."""
+        with self._lock:
+            if any(p["host"] == host for p in self._preempts):
+                return self._version     # duplicate notice (e.g. retry)
+            self._version += 1
+            self._hosts.pop(host, None)
+            self._np = sum(self._hosts.values())
+            self._failures = []          # same world-op clear semantics
+            self._preempts.append({"host": host})
+            rec = {"op": "preempt", "version": self._version,
+                   "hosts": dict(self._hosts), "np": self._np,
+                   "host": host}
+            self._events.append(
+                (self._version + self._failure_seq, dict(rec)))
+            if self._journal:
+                self._journal.append(rec)
+                self._maybe_compact_locked()
+            self._cond.notify_all()
+            version, np_ = self._version, self._np
+        _telemetry.inc("hvd_elastic_preempts_total")
+        get_logger().warning(
+            "coordinator: host %s preempted (graceful) — world v%d np=%d",
+            host, version, np_)
+        return version
+
+    def preempts_view(self) -> list:
+        """This generation's announced departures (driver/tests)."""
+        with self._lock:
+            return [dict(p) for p in self._preempts]
 
     def mark_failure(self, host: str, code: int) -> int:
         """Record a worker-process death for the peer-liveness push
@@ -1224,6 +1284,16 @@ class CoordinatorClient:
         if reply is None:
             return None
         return self._ingest_world(reply)
+
+    def notify_preempt(self, host: str) -> bool:
+        """Post this host's graceful-departure notice (the run_fn
+        wrapper's last coordinator call before exiting with
+        ``PREEMPT_EXIT_CODE``). Best-effort: a dropped notice degrades to
+        the ordinary exit-code path — the driver still skips the
+        blacklist because of the exit code."""
+        body = json.dumps({"host": str(host)}).encode()
+        reply = self._call("/preempt", data=body)
+        return bool(reply and reply.get("ok"))
 
     def register(self, process_id: int) -> bool:
         """Announce this worker; retried under the same policy. Returns

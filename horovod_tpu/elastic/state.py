@@ -241,6 +241,14 @@ class State:
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
+        # Preemption check first, and before the rate-limited poll: the
+        # lifecycle flag is a local attribute read (no RPC), and commit()
+        # already ran save() — so the commit that carried us to this seam
+        # IS the out-of-cadence commit the preemption grace window buys.
+        from ..core import lifecycle as _lifecycle
+        if _lifecycle.preempt_requested():
+            from ..core.exceptions import PreemptionInterrupt
+            raise PreemptionInterrupt(_lifecycle.preempt_signum())
         notification_manager.init_from_env()
         notification_manager.check()
 

@@ -102,7 +102,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "attempt counter, e.g. 'rpc_refuse:rank=0,call=2'; "
                         "resume-path kinds resume_kill/resume_corrupt/"
                         "resume_delay schedule on the blob peer service's "
-                        "serve counter, e.g. 'resume_kill:rank=1,fetch=0')")
+                        "serve counter, e.g. 'resume_kill:rank=1,fetch=0'; "
+                        "'preempt:rank=1,step=3' delivers the preemption "
+                        "signal but lets the worker run to its next commit "
+                        "seam — the graceful-handoff drill)")
     p.add_argument("--coordinator-lost-timeout-seconds", type=float,
                    dest="coordinator_lost_timeout_seconds",
                    help="seconds of continuous coordinator-RPC failure "

@@ -137,6 +137,28 @@ class ReplicaAgent:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def enable_preempt_drain(self, timeout_s: float = 30.0) -> bool:
+        """Join the preemption lifecycle plane (core/lifecycle.py): on
+        SIGTERM/SIGUSR1 this replica runs its normal :meth:`drain` —
+        routing stops at the coordinator, in-flight requests finish,
+        deregistration fires on drained — so ``FleetClient`` callers see
+        failover, never a reset. Opt-in (the host process owns its signal
+        dispositions; auto-installing would hijack pytest/bench SIGTERM);
+        returns False when the handler cannot install (non-main thread,
+        ``HOROVOD_PREEMPT_SIGNALS=""``)."""
+        from ..core import lifecycle as _lifecycle
+        if not _lifecycle.install():
+            return False
+
+        def _on_preempt(signum: int) -> None:
+            get_logger().warning(
+                "replica %s: preemption notice (signal %d) — draining",
+                self.replica_id, signum)
+            self.drain(timeout_s=timeout_s)
+
+        _lifecycle.add_preempt_callback(_on_preempt)
+        return True
+
     def drain(self, timeout_s: float = 30.0) -> bool:
         """The arbiter's reclaim sequence: stop routing (coordinator
         drain mark), stop admitting + finish in-flight (server drain —

@@ -31,6 +31,15 @@ Spec grammar (``HOROVOD_FAULT_SPEC``)::
                                                  (silent replica divergence —
                                                  the SDC class the sentinel's
                                                  fingerprint lane detects)
+               preempt [signal=SIGTERM|SIGUSR1]  deliver the preemption
+                                                 signal to OWN process and
+                                                 keep running — unlike kill,
+                                                 the worker proceeds to the
+                                                 step seam, honoring the
+                                                 grace window, so the
+                                                 lifecycle plane's graceful
+                                                 handoff (core/lifecycle.py)
+                                                 is what gets exercised
     rpc kinds (control plane; schedule on call=<int>, the coordinator
     client's HTTP-attempt counter — elastic/service.py applies them):
                rpc_drop    call=<int>            attempt times out (OSError)
@@ -77,6 +86,9 @@ Examples::
     kill:rank=1,step=3                      # SIGKILL rank 1 at step 3
     hang:rank=1,step=3                      # rank 1 stops participating
     kill:rank=1,step=3,signal=SIGTERM;nan:rank=0,step=5
+    preempt:rank=1,step=3                   # graceful handoff drill: rank 1
+                                            # gets SIGTERM but runs on to
+                                            # its next commit seam
     delay:rank=0,round=4,seconds=2.5        # slow one engine round
     corrupt:rank=0,step=4,path=/tmp/commits # truncate newest commit
     rpc_refuse:rank=0,call=2                # 3rd coordinator RPC refused
@@ -142,7 +154,8 @@ _RESUME_KINDS = ("resume_kill", "resume_corrupt", "resume_delay")
 _REPLICA_KINDS = ("replica_kill", "replica_hang", "traffic_spike")
 
 _KINDS = ("kill", "hang", "delay", "drop", "corrupt", "nan",
-          "desync", "torn") + _RPC_KINDS + _RESUME_KINDS + _REPLICA_KINDS
+          "desync", "torn", "preempt") \
+    + _RPC_KINDS + _RESUME_KINDS + _REPLICA_KINDS
 
 
 @dataclass
@@ -361,6 +374,18 @@ class FaultHarness:
                 # stop participating so peers' rescue path still runs.
                 time.sleep(60)
                 os._exit(1)
+            elif f.kind == "preempt":
+                self._mark_fired(f)
+                signame = f.params.get("signal", "SIGTERM").upper()
+                signum = getattr(_signal, signame)
+                get_logger().warning(
+                    "fault: preempting self with %s (rank=%s step=%d) — "
+                    "process keeps running to the step seam", signame,
+                    rank, step)
+                os.kill(os.getpid(), signum)
+                # Unlike `kill`, return immediately: the point is to
+                # exercise the lifecycle plane's graceful handoff, which
+                # needs the process to reach its next commit seam alive.
             elif f.kind == "hang":
                 self._mark_fired(f)
                 secs = float(f.params.get("seconds", "0") or 0)

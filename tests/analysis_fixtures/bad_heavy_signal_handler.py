@@ -1,0 +1,43 @@
+"""lint-heavy-signal-handler fixture: a SIGTERM handler that does an RPC
+and a file write in signal context — it runs at an arbitrary bytecode
+boundary inside whatever the main thread was doing, so the HTTP client is
+re-entered mid-request and buffered I/O interleaves. Exactly ONE finding:
+the self-pipe handler below is the vetted pattern and must stay clean, as
+must SIG_IGN dispositions and the pragma-carrying registration.
+"""
+import json
+import os
+import signal
+from urllib.request import urlopen
+
+STATE = {"preempted": False}
+_WAKE_W = None
+
+
+def heavy_handler(signum, frame):
+    # RPC + buffered file write at whatever bytecode boundary the signal
+    # landed on — the deadlock/corruption class the rule exists for.
+    urlopen("http://127.0.0.1:9/preempt")
+    with open("/tmp/flight.json", "w") as f:
+        json.dump({"signum": signum}, f)
+
+
+def safe_handler(signum, frame):
+    # Clean: the vetted shape — a flag store plus one byte down the
+    # nonblocking self-pipe (os.write is the async-signal-safe write);
+    # a watcher thread does everything heavy outside signal context.
+    STATE["preempted"] = True
+    if _WAKE_W is not None:
+        os.write(_WAKE_W, b"p")
+
+
+def install():
+    signal.signal(signal.SIGTERM, heavy_handler)  # <- lint-heavy-signal-handler
+    signal.signal(signal.SIGUSR1, safe_handler)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+
+def install_vetted():
+    # A registration proven to run only on a quiesced process carries
+    # the pragma.
+    signal.signal(signal.SIGTERM, heavy_handler)  # hvd-analyze: ok

@@ -225,6 +225,7 @@ LINT_CASES = [
      "lint-rank-conditional-collective", "error"),
     ("bad_unverified_peer_blob.py", "lint-unverified-peer-blob", "warning"),
     ("bad_unbounded_admission.py", "lint-unbounded-admission", "warning"),
+    ("bad_heavy_signal_handler.py", "lint-heavy-signal-handler", "warning"),
 ]
 
 

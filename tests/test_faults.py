@@ -298,3 +298,46 @@ def test_on_traffic_request_ignores_replica_kinds(tmp_path):
     assert h.on_traffic_request(0) is None
     f = h.on_replica_request(0, rank=901)
     assert f is not None and f.kind == "replica_hang"
+
+
+# -- preempt (the graceful-handoff drill) -----------------------------------
+
+def test_parse_preempt_grammar():
+    spec = FaultSpec.parse("preempt:rank=1,step=3;preempt:step=5,signal=SIGUSR1")
+    assert [f.kind for f in spec.faults] == ["preempt", "preempt"]
+    assert (spec.faults[0].rank, spec.faults[0].step) == (1, 3)
+    assert spec.faults[1].rank is None
+    assert spec.faults[1].params["signal"] == "SIGUSR1"
+
+
+def test_parse_rejects_preempt_without_step():
+    with pytest.raises(ValueError):
+        FaultSpec.parse("preempt:rank=1")
+
+
+def test_preempt_delivers_signal_and_returns(tmp_path):
+    """Unlike kill, preempt must deliver the signal to its OWN process
+    and RETURN — the worker has to stay alive to reach the next commit
+    seam, which is the whole point of the grace window."""
+    import signal as _sig
+    seen = []
+    prev = _sig.signal(_sig.SIGUSR1, lambda s, f: seen.append(s))
+    try:
+        h = _harness("preempt:rank=0,step=2,signal=SIGUSR1", tmp_path)
+        assert h.will_fire("preempt", 0, 2)
+        assert not h.will_fire("preempt", 1, 2)
+        h.on_step(2, rank=0)
+        # delivery is at the next bytecode boundary of this (main) thread
+        deadline = time.monotonic() + 2.0
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert seen == [_sig.SIGUSR1]
+        # one-shot: a relaunched worker replaying step 2 must not be
+        # re-preempted (else the drill never converges)
+        assert not h.will_fire("preempt", 0, 2)
+        h.on_step(2, rank=0)
+        assert seen == [_sig.SIGUSR1]
+        h2 = _harness("preempt:rank=0,step=2,signal=SIGUSR1", tmp_path)
+        assert not h2.will_fire("preempt", 0, 2)
+    finally:
+        _sig.signal(_sig.SIGUSR1, prev)

@@ -435,3 +435,148 @@ def test_replica_agent_registers_and_drain_deregisters(svc, monkeypatch):
         if agent is not None:
             agent.close(deregister=False)
         srv.close()
+
+
+# ------------------------------------- replica agent: preemption drain
+
+
+def _live_registry(tmp_path, name="commits", w=7.0):
+    """A ModelRegistry holding one published generation (the serving
+    floor a /predict needs)."""
+    import os
+
+    import numpy as np
+
+    from horovod_tpu.checkpoint.store import BlobStore
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.serving import ModelRegistry, Publisher
+
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    state = ObjectState(commit_dir=d, commit_async=False, w=np.float32(w))
+    state.commit()
+    pub = Publisher(d, every=1,
+                    counters=lambda: {"steps_skipped": 0, "rollbacks": 0})
+    assert pub.maybe_publish(state._commit_seq) is not None
+    store = BlobStore(os.path.join(d, "cas"))
+    reg = ModelRegistry(store=store)
+    assert reg.poll_store(store)
+    return reg
+
+
+def test_replica_agent_preempt_drain_completes_inflight(svc, monkeypatch,
+                                                        tmp_path):
+    """SIGTERM on a serving host (ISSUE 20): the agent joins the
+    lifecycle plane, the in-flight request FINISHES, and deregistration
+    fires only after the server drained — the reuse of the training
+    workers' graceful-handoff plane on the serving side."""
+    import os
+    import signal
+    import time
+
+    from horovod_tpu.core import lifecycle
+    from horovod_tpu.serving import InferenceServer
+
+    service, key, _clock = svc
+    monkeypatch.setenv(C.REPLICA_GRACE_ENV, "9")
+    entered = threading.Event()
+
+    def slow_forward(payload, inputs, n):
+        entered.set()
+        time.sleep(0.4)
+        return [1.0] * n
+
+    reg = _live_registry(tmp_path)
+    srv = InferenceServer(reg, slow_forward, buckets=(1, 2), window_s=0.0,
+                          request_timeout_s=10.0)
+    agent = None
+    lifecycle.uninstall()
+    try:
+        client = _client(service, key, watch_publish=True)
+        agent = ReplicaAgent(srv, client, replica_id="rep-pre", rank=901)
+        assert agent.registered
+        assert agent.enable_preempt_drain(timeout_s=10.0)
+        out = {}
+
+        def inflight():
+            req = urllib.request.Request(
+                f"http://{srv.addr()}/predict",
+                data=json.dumps({"x": 1.0}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                out["status"] = r.status
+                out["body"] = json.loads(r.read().decode())
+
+        th = threading.Thread(target=inflight)
+        th.start()
+        assert entered.wait(5.0)             # request is on the floor
+        os.kill(os.getpid(), signal.SIGTERM)  # the real reclaim notice
+        th.join(timeout=10.0)
+        assert not th.is_alive()
+        # the in-flight request completed — a reset here is the bug
+        assert out["status"] == 200 and out["body"]["ok"]
+        # drain-on-preempt deregistered the replica at the coordinator
+        deadline = time.monotonic() + 10.0
+        while (service.replicas_view()["replicas"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert service.replicas_view()["replicas"] == []
+    finally:
+        lifecycle.uninstall()
+        if agent is not None:
+            agent.close(deregister=False)
+        srv.close()
+
+
+def test_fleet_client_sees_failover_not_resets_across_preempt(svc, tmp_path):
+    """Traffic across a preemption drill: 100/100 requests complete;
+    the drained replica's load moves to the survivor with zero errors
+    surfaced to the FleetClient caller."""
+    import time
+
+    from horovod_tpu.core import lifecycle
+    from horovod_tpu.serving import InferenceServer
+
+    service, key, _clock = svc
+    srvs, agents = [], []
+    lifecycle.uninstall()
+    try:
+        for i, rid in enumerate(("rep-a", "rep-b")):
+            reg = _live_registry(tmp_path, name=f"commits-{rid}")
+            srv = InferenceServer(
+                reg, lambda payload, inputs, n, rid=rid: [float(i)] * n,
+                buckets=(1, 2), window_s=0.0, request_timeout_s=10.0)
+            client = _client(service, key, watch_publish=True)
+            agent = ReplicaAgent(srv, client, replica_id=rid, rank=901 + i)
+            assert agent.registered
+            srvs.append(srv)
+            agents.append(agent)
+        # only the victim joins the plane: the drill below must drain
+        # rep-a and leave rep-b serving
+        assert agents[0].enable_preempt_drain(timeout_s=10.0)
+        fc = FleetClient(coord=_client(service, key), timeout_s=10.0,
+                         refresh_s=0.05, max_tries=8)
+        done = 0
+        for i in range(100):
+            if i == 20:
+                lifecycle.request_preempt()   # deterministic drill
+            out = fc.predict({"x": float(i)})
+            assert out.get("ok"), out
+            done += 1
+        assert done == 100                    # zero lost, zero resets
+        assert fc.stats["requests"] == 100
+        # the drain really happened: rep-a is gone from the registry
+        deadline = time.monotonic() + 10.0
+        while (len(service.replicas_view()["replicas"]) > 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        ids = [r["id"] for r in service.replicas_view()["replicas"]]
+        assert ids == ["rep-b"]
+        # and the survivor answers alone
+        assert fc.predict({"x": 0.0}).get("ok")
+    finally:
+        lifecycle.uninstall()
+        for a in agents:
+            a.close(deregister=False)
+        for s in srvs:
+            s.close()

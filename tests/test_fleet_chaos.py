@@ -242,9 +242,13 @@ agent = ReplicaAgent(srv, client, replica_id=os.environ["REPLICA_ID"],
                      rank=int(os.environ["REPLICA_RANK"]))
 assert agent.registered
 agent.start()
+if os.environ.get("ENABLE_PREEMPT_DRAIN") == "1":
+    # Join the graceful-handoff plane: SIGTERM -> drain -> exit 0.
+    assert agent.enable_preempt_drain(timeout_s=30.0)
 print("ready", flush=True)
-while True:
+while not agent._closing:
     time.sleep(0.2)
+print("drained", flush=True)
 """
 
 
@@ -260,7 +264,7 @@ def _published_commit_dir(tmp_path, w=7.0):
 
 
 def _spawn_fleet(tmp_path, service, key, commit_dir, n=3,
-                 victim_idx=1, victim_fault=None):
+                 victim_idx=1, victim_fault=None, victim_env=None):
     script = tmp_path / "replica_worker.py"
     script.write_text(REPLICA_WORKER)
     procs = []
@@ -272,6 +276,8 @@ def _spawn_fleet(tmp_path, service, key, commit_dir, n=3,
         env[C.REPLICA_GRACE_ENV] = "60"
         if i == victim_idx and victim_fault:
             env["HOROVOD_FAULT_SPEC"] = victim_fault
+        if i == victim_idx and victim_env:
+            env.update(victim_env)
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True, env=env))
@@ -366,5 +372,52 @@ def test_replica_hang_mid_traffic_times_out_and_fails_over(tmp_path,
         # wedged, not dead: the victim process is still running — the
         # failure mode only client-side timeouts catch
         assert procs[1].poll() is None
+    finally:
+        _teardown(procs, service)
+
+
+def test_replica_sigterm_drains_gracefully_under_traffic(tmp_path,
+                                                         monkeypatch):
+    """The ISSUE 20 serving acceptance (np=2, real processes, real
+    SIGTERM): the victim replica catches the reclaim signal through the
+    lifecycle plane, drains — routing stops at the coordinator, in-flight
+    requests finish — and exits 0. All 100 accepted requests complete;
+    the FleetClient never sees a reset, only (at most) failover."""
+    monkeypatch.setenv(C.REPLICA_GRACE_ENV, "60")
+    key = _secret.make_secret_key()
+    commit_dir = _published_commit_dir(tmp_path)
+    service = CoordinatorService(key, bind_host="127.0.0.1",
+                                 journal_path=str(tmp_path / "wal.jsonl"))
+    procs = _spawn_fleet(tmp_path, service, key, commit_dir, n=2,
+                         victim_idx=1,
+                         victim_env={"ENABLE_PREEMPT_DRAIN": "1"})
+    try:
+        client = CoordinatorClient(f"127.0.0.1:{service.port}", key)
+        _wait_for(lambda: _registered_count(client) == 2,
+                  timeout=90, what="2 registered replicas")
+        fc = FleetClient(coord=client, timeout_s=15.0, refresh_s=0.2,
+                         max_tries=12)
+        victim = procs[1]
+        done = 0
+        for i in range(100):
+            if i == 20:
+                victim.send_signal(signal.SIGTERM)   # the reclaim notice
+            out = fc.predict({"x": float(i)})
+            assert out.get("ok"), out
+            assert out["result"] == pytest.approx(7.0 + i)
+            done += 1
+        assert done == 100                           # 100/100, zero lost
+        assert fc.stats["requests"] == 100
+        # graceful exit, not a kill: drain completed and the worker left
+        # its loop with status 0
+        _wait_for(lambda: victim.poll() is not None, timeout=30,
+                  what="victim graceful exit")
+        assert victim.returncode == 0
+        # the victim deregistered itself (drain -> deregister-on-drained):
+        # the registry converges to the lone survivor with no pruning
+        _wait_for(lambda: _registered_count(client) == 1, timeout=30,
+                  what="survivor-only registry")
+        assert procs[0].poll() is None               # survivor serving
+        assert fc.predict({"x": 1.0}).get("ok")
     finally:
         _teardown(procs, service)

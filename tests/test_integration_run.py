@@ -1643,3 +1643,147 @@ def test_sentinel_desync_evicts_minority_and_world_resumes(tmp_path):
     lat = [float(m) for m in
            re.findall(r"resume latency ([0-9.]+)s", combined)]
     assert lat and max(lat) < 1.0, (lat, combined[-2000:])
+
+
+PREEMPT_CHAOS_WORKER = """
+import json
+import os
+import time
+from horovod_tpu.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.optimizer import allgather_object
+from horovod_tpu.testing import faults
+
+hvd.init()
+N = int(os.environ["PREEMPT_STEPS"])
+SLEEP = float(os.environ["PREEMPT_STEP_SLEEP"])
+TRACE = os.environ["PREEMPT_TRACE_FILE"]
+state = elastic.ObjectState(step=0, total=0.0)
+
+@elastic.run
+def train(state):
+    while state.step < N:
+        step = state.step
+        vals = allgather_object(float(step))
+        faults.on_step(step, rank=hvd.rank())   # preempt: SIGTERMs self,
+        time.sleep(SLEEP)                       # then RUNS ON to the seam
+        state.total += float(sum(vals))
+        state.step = step + 1
+        if hvd.rank() == 0:
+            # committed-step ledger: "<step> <np>" per completed step —
+            # the zero-lost-steps proof reads this back
+            with open(TRACE, "a") as f:
+                f.write("%d %d\\n" % (step, hvd.size()))
+        state.commit()
+    return state.step
+
+train(state)
+from horovod_tpu.elastic.state import notification_manager
+_w = {}
+if notification_manager._client is not None:
+    _w = notification_manager._client.get_world() or {}
+print(json.dumps({"final_step": state.step, "size": hvd.size(),
+                  "failure_seq": _w.get("failure_seq"),
+                  "preempts": _w.get("preempts")}), flush=True)
+"""
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+def test_elastic_preempt_graceful_handoff_np3(tmp_path):
+    """The ISSUE 20 acceptance chaos proof, end to end at np=3: the fault
+    harness SIGTERMs rank 1 mid-generation. The victim runs on to its next
+    commit seam (out-of-cadence commit), dumps its flight ring, posts the
+    coordinator ``preempt`` notice (a VERSION bump, never a failure
+    record), and exits with PREEMPT_EXIT_CODE. Survivors reset via the
+    graceful membership push; the relaunched np=2 generation resumes from
+    the victim's final commit (the per-step ledger proves zero lost
+    steps); and once the cooldown expires the host is re-admitted —
+    discovery re-offers it, the driver bumps the world, and the job
+    FINISHES at np=3."""
+    import time
+    disco = tmp_path / "discover.sh"
+    disco.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.2:1\n"
+                     "echo 127.0.0.3:1\n")
+    disco.chmod(0o755)
+    script = tmp_path / "preempt_worker.py"
+    script.write_text(PREEMPT_CHAOS_WORKER)
+    trace = tmp_path / "step_trace"
+    flight = tmp_path / "flight"
+    n_steps = 60
+    t0 = time.monotonic()
+    r = _run_hvdrun(["-np", "3", "--min-np", "1", "--max-np", "3",
+                     "--host-discovery-script", str(disco),
+                     "--fault-spec", "preempt:rank=1,step=3",
+                     sys.executable, str(script)], timeout=420,
+                    env_extra={
+                        "PREEMPT_STEPS": str(n_steps),
+                        "PREEMPT_STEP_SLEEP": "0.35",
+                        "PREEMPT_TRACE_FILE": str(trace),
+                        "HOROVOD_FAULT_MARKER_DIR":
+                            str(tmp_path / "fault_markers"),
+                        "HOROVOD_FLIGHT_DIR": str(flight),
+                        # cooldown must outlast the np=2 relaunch (so the
+                        # shrunk generation EXISTS) yet expire while it
+                        # still has steps left (so re-admission happens
+                        # mid-run, not at rendezvous)
+                        "HOROVOD_PREEMPT_COOLDOWN_SECONDS": "18",
+                        "HOROVOD_PEER_FAILURE_GRACE_SECONDS": "2",
+                        "HOROVOD_LOG_LEVEL": "INFO"})
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 0, f"{r.stdout[-4000:]}\n{r.stderr[-4000:]}"
+    combined = r.stdout + r.stderr
+
+    # -- the victim's graceful exit (not a death) ----------------------------
+    assert "fault: preempting self with SIGTERM" in combined, combined
+    assert "preemption observed at the step seam (signal 15)" in combined
+    assert "preempt flight ring dumped to" in combined
+    assert "preemption handoff complete (signal 15)" in combined
+    # coordinator recorded a preempt notice, on the VERSION counter
+    assert "preempted (graceful)" in combined
+    # driver mapped exit 76 to cooldown, explicitly NOT a blacklist strike
+    assert "cooling down 18s before re-admission, no blacklist strike" \
+        in combined, combined
+
+    # -- never a failure record ----------------------------------------------
+    # mark_failure was never called for the whole run: the final world's
+    # monotonic failure_seq (printed by every surviving rank) is 0, and no
+    # incident report was assembled.
+    lines = [json.loads(l) for l in r.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3, (lines, r.stdout)   # final generation is np=3
+    for out in lines:
+        assert out["final_step"] == n_steps and out["size"] == 3, lines
+        assert out["failure_seq"] == 0, lines
+    assert not list(flight.glob("incident_*.json")), \
+        list(flight.iterdir())
+
+    # -- zero lost steps across all three generations ------------------------
+    ledger = [tuple(map(int, ln.split()))
+              for ln in trace.read_text().splitlines()]
+    steps = [s for s, _ in ledger]
+    assert sorted(set(steps)) == list(range(n_steps)), sorted(set(steps))
+    # generation 0 committed through the preempt step at np=3...
+    by_step = {}
+    for s, np_ in ledger:
+        by_step.setdefault(s, []).append(np_)
+    assert by_step[0] == [3], ledger[:6]
+    # ...the shrunk generation resumed EXACTLY at the victim's final
+    # commit (seam step 4 = preempt step 3 + 1): the first np=2 ledger
+    # entry is step 4 — nothing replayed, nothing skipped
+    np2_steps = [s for s, np_ in ledger if np_ == 2]
+    assert np2_steps and min(np2_steps) == 4, ledger[:12]
+    # ...and the tail ran at np=3 again after re-admission
+    assert by_step[n_steps - 1] == [3], ledger[-6:]
+
+    # -- re-admission after cooldown -----------------------------------------
+    assert "preempt cooldown expired — eligible for re-admission" \
+        in combined
+    assert "hosts gained" in combined
+    gens = [int(ln.split("(np=")[1].split(")")[0])
+            for ln in combined.splitlines()
+            if "launching generation" in ln]
+    assert gens == [3, 2, 3], (gens, combined[-2000:])
+    assert elapsed < 360, f"not bounded: {elapsed:.0f}s"

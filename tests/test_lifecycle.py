@@ -1,0 +1,287 @@
+"""Preemption-aware graceful handoff: the signal plane
+(core/lifecycle.py), the coordinator's ``preempt`` notice (distinct from
+``mark_failure`` — no peer-grace window burn, no blacklist strike), the
+journal's ``preempt`` op, and the driver's host-cooldown / min-np pause.
+
+Reference parity: Determined's preemption API + the reference driver's
+``HostsUpdatedRequest`` push (SURVEY.md §3.4) — an ANNOUNCED departure is
+a world update, not a failure.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import elastic
+from horovod_tpu.core import lifecycle
+from horovod_tpu.core.exceptions import (HostsUpdatedInterrupt,
+                                         PreemptionInterrupt)
+from horovod_tpu.elastic import constants as C
+from horovod_tpu.elastic import journal as J
+from horovod_tpu.elastic.service import CoordinatorClient, CoordinatorService
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.runner.settings import Settings
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with the module singleton torn down —
+    a leaked handler would redirect pytest's own SIGTERM."""
+    lifecycle.uninstall()
+    yield
+    lifecycle.uninstall()
+
+
+# --- the signal plane -------------------------------------------------------
+
+def test_lifecycle_install_and_drill_roundtrip():
+    assert lifecycle.install()
+    assert lifecycle.install()                   # idempotent
+    assert not lifecycle.preempt_requested()
+    fired = threading.Event()
+    seen = []
+
+    def cb(signum):
+        seen.append(signum)
+        fired.set()
+
+    lifecycle.add_preempt_callback(cb)
+    lifecycle.request_preempt()                  # the test drill
+    assert lifecycle.preempt_requested()
+    assert lifecycle.preempt_signum() == signal.SIGTERM
+    # callbacks run on the watcher thread, outside signal context
+    assert fired.wait(2.0)
+    assert seen == [signal.SIGTERM]
+    lifecycle.uninstall()
+    assert not lifecycle.preempt_requested()
+
+
+def test_lifecycle_real_signal_delivery():
+    assert lifecycle.install(signals=[signal.SIGUSR1])
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 2.0
+    while not lifecycle.preempt_requested() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lifecycle.preempt_requested()
+    assert lifecycle.preempt_signum() == signal.SIGUSR1
+
+
+def test_lifecycle_callback_after_request_fires_immediately():
+    assert lifecycle.install()
+    lifecycle.request_preempt()
+    fired = threading.Event()
+    lifecycle.add_preempt_callback(lambda s: fired.set())
+    assert fired.wait(2.0)
+
+
+def test_lifecycle_empty_signals_env_disables(monkeypatch):
+    monkeypatch.setenv(lifecycle.PREEMPT_SIGNALS_ENV, "")
+    assert not lifecycle.install()
+    assert not lifecycle.preempt_requested()
+
+
+def test_lifecycle_install_refused_off_main_thread():
+    out = {}
+
+    def t():
+        out["ok"] = lifecycle.install()
+
+    th = threading.Thread(target=t)
+    th.start()
+    th.join()
+    assert out["ok"] is False
+
+
+def test_check_host_updates_raises_preemption_at_seam(monkeypatch):
+    """``State.commit()`` runs ``save()`` then ``check_host_updates()`` —
+    the preempt flag must surface there, BEFORE the rate-limited
+    coordinator poll, so the seam commit is the out-of-cadence commit."""
+    from horovod_tpu.elastic.state import ObjectState
+    assert lifecycle.install()
+    st = ObjectState(val=1)
+    st.commit()                                  # no preempt: clean
+    lifecycle.request_preempt()
+    with pytest.raises(PreemptionInterrupt) as ei:
+        st.commit()
+    assert ei.value.signum == signal.SIGTERM
+    assert ei.value.skip_sync                    # state already durable
+    assert isinstance(ei.value, HostsUpdatedInterrupt)   # except-order trap
+
+
+# --- coordinator preempt notice ---------------------------------------------
+
+def test_mark_preempt_is_world_update_not_failure():
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"a": 2, "b": 1}, 3)
+        client = CoordinatorClient(f"127.0.0.1:{svc.port}", key)
+        assert client.notify_preempt("b")
+        world = client.get_world()
+        # departure published on the VERSION counter: survivors get the
+        # graceful HostsUpdatedInterrupt reset path...
+        assert world["version"] == 2
+        assert world["hosts"] == {"a": 2} and world["np"] == 2
+        # ...and the watchdog's peer-failure grace window never arms.
+        assert world["failures"] == [] and world["failure_seq"] == 0
+        assert svc.preempts_view() == [{"host": "b"}]
+        # duplicate notice (client retry) is absorbed
+        assert svc.mark_preempt("b") == 2
+        assert svc.preempts_view() == [{"host": "b"}]
+        # a new generation starts clean
+        svc.update_world({"a": 2, "b": 1}, 3)
+        assert svc.preempts_view() == []
+    finally:
+        svc.close()
+
+
+def test_preempt_notice_wakes_long_poll():
+    key = _secret.make_secret_key()
+    svc = CoordinatorService(key, bind_host="127.0.0.1")
+    try:
+        svc.update_world({"a": 1, "b": 1}, 2)
+        client = CoordinatorClient(f"127.0.0.1:{svc.port}", key)
+        # prime the cursor: wait= parks on it (first contact returns now)
+        assert client.get_world()["version"] == 1
+        out = {}
+
+        def park():
+            out["world"] = client.get_world(wait=5.0)
+
+        th = threading.Thread(target=park, daemon=True)
+        th.start()
+        time.sleep(0.2)
+        svc.mark_preempt("b")
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        assert out["world"]["version"] == 2 and out["world"]["np"] == 1
+    finally:
+        svc.close()
+
+
+def test_journal_preempt_op_roundtrip(tmp_path):
+    path = str(tmp_path / "coord.journal")
+    jr = J.CoordinatorJournal(path)
+    jr.append({"op": "world", "version": 1, "hosts": {"a": 1, "b": 1},
+               "np": 2})
+    jr.append({"op": "preempt", "version": 2, "hosts": {"a": 1}, "np": 1,
+               "host": "b"})
+    state = J.replay(path)
+    assert state["version"] == 2
+    assert state["hosts"] == {"a": 1} and state["np"] == 1
+    assert state["failures"] == [] and state["failure_seq"] == 0
+    assert state["preempts"] == [{"host": "b"}]
+    # a later generation clears the preempt list
+    jr.append({"op": "world", "version": 3, "hosts": {"a": 1, "b": 1},
+               "np": 2})
+    assert J.replay(path)["preempts"] == []
+
+
+def test_journal_preempt_applies_onto_world_keys_only_state():
+    """The delta-protocol client replays onto a dict holding only the
+    WORLD_KEYS payload — the preempt op must not KeyError there."""
+    state = {"version": 1, "hosts": {"a": 1, "b": 1}, "np": 2,
+             "failures": [], "failure_seq": 0}
+    assert J.apply_record(state, {"op": "preempt", "version": 2,
+                                  "hosts": {"a": 1}, "np": 1, "host": "b"})
+    assert state["np"] == 1 and state["preempts"] == [{"host": "b"}]
+
+
+def test_service_restores_preempts_from_journal(tmp_path):
+    key = _secret.make_secret_key()
+    path = str(tmp_path / "coord.journal")
+    svc = CoordinatorService(key, bind_host="127.0.0.1", journal_path=path)
+    try:
+        svc.update_world({"a": 1, "b": 1}, 2)
+        svc.mark_preempt("b")
+    finally:
+        svc.close()
+    svc2 = CoordinatorService(key, bind_host="127.0.0.1", journal_path=path,
+                              restore=True)
+    try:
+        assert svc2.version == 2
+        assert svc2.preempts_view() == [{"host": "b"}]
+    finally:
+        svc2.close()
+
+
+# --- driver: cooldown, classification, min-np pause -------------------------
+
+def _driver(**kw):
+    s = Settings(elastic=True, min_np=1, host_discovery_script="true", **kw)
+    return elastic.ElasticDriver(s, ["true"])
+
+
+def test_preempt_exit_code_never_strikes_blacklist(monkeypatch):
+    d = _driver()
+    try:
+        for _ in range(3):
+            assert d._classify({"a": C.PREEMPT_EXIT_CODE}) == "reset"
+        assert not d._blacklist.is_banned("a")
+    finally:
+        d._service.close()
+
+
+def test_preempt_cooldown_filters_then_readmits(monkeypatch):
+    monkeypatch.setenv(C.PREEMPT_COOLDOWN_ENV, "0.2")
+    d = _driver()
+    try:
+        d._discovery = elastic.FixedHostDiscovery({"a": 1, "b": 1})
+        d._note_preempt("b")
+        assert d.effective_hosts() == {"a": 1}
+        time.sleep(0.25)
+        assert d.effective_hosts() == {"a": 1, "b": 1}   # re-admission
+        assert d._preempt_cooldown == {}
+    finally:
+        d._service.close()
+
+
+def test_min_np_pause_waits_out_preempt_cooldown(monkeypatch):
+    """Below the floor with a preempted host in cooldown, rendezvous
+    pauses (bounded) instead of aborting — and succeeds once the host's
+    cooldown expires and discovery re-offers it."""
+    monkeypatch.setenv(C.PREEMPT_COOLDOWN_ENV, "0.3")
+    monkeypatch.setenv(C.MIN_NP_ENV, "2")
+    monkeypatch.setenv(C.MIN_NP_WAIT_ENV, "5")
+    d = _driver(discovery_interval_s=0.05)
+    try:
+        d._discovery = elastic.FixedHostDiscovery({"a": 1, "b": 1})
+        d._note_preempt("b")
+        assert not d._enough(d.effective_hosts())
+        t0 = time.monotonic()
+        hosts = d.wait_for_available_slots(timeout_s=0.1)
+        # the 0.1s deadline alone would have raised: the pause carried us
+        # past the cooldown to the recovered world
+        assert hosts == {"a": 1, "b": 1}
+        assert time.monotonic() - t0 >= 0.25
+    finally:
+        d._service.close()
+
+
+def test_min_np_pause_is_bounded(monkeypatch):
+    monkeypatch.setenv(C.PREEMPT_COOLDOWN_ENV, "60")
+    monkeypatch.setenv(C.MIN_NP_ENV, "2")
+    monkeypatch.setenv(C.MIN_NP_WAIT_ENV, "0.2")
+    d = _driver(discovery_interval_s=0.05)
+    try:
+        d._discovery = elastic.FixedHostDiscovery({"a": 1, "b": 1})
+        d._note_preempt("b")
+        with pytest.raises(TimeoutError):
+            d.wait_for_available_slots(timeout_s=0.1)
+    finally:
+        d._service.close()
+
+
+def test_min_np_floor_env_raises_settings_floor(monkeypatch):
+    d = _driver()
+    try:
+        assert d._min_np_floor() == 1
+        monkeypatch.setenv(C.MIN_NP_ENV, "3")
+        assert d._min_np_floor() == 3
+        assert not d._enough({"a": 2})
+        assert d._enough({"a": 2, "b": 1})
+    finally:
+        d._service.close()

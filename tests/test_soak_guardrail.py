@@ -1,0 +1,70 @@
+"""Chaos-soak guardrails over benchmarks/soak.py.
+
+Same contract as tests/test_fleet_guardrail.py: the COMMITTED history
+record (benchmarks/soak_history.jsonl) must stay inside the ISSUE 20
+rails — every global invariant green, >= 20 distinct chaos events
+actually fired (with the preemption path hit at least twice and broad
+fault-kind diversity), zero accepted-request loss, real world churn
+(multiple generations), and a live publish plane — so a regression in
+the graceful-handoff path, the fault harness, the journal replay, or
+the serving failover fails tier-1 without re-running the minutes-long
+soak. The soak itself runs in the chaos tier via the slow-marked smoke
+below (and in full via HOROVOD_RUN_SOAK=1 in tests/test_soak.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "soak.py")
+HISTORY = os.path.join(REPO, "benchmarks", "soak_history.jsonl")
+
+
+def _run(args, timeout):
+    env = dict(os.environ, HOROVOD_SOAK_NO_HISTORY="1")
+    env.pop("HOROVOD_FAULT_SPEC", None)
+    return subprocess.run([sys.executable, BENCH, *args],
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+
+
+def test_history_record_is_complete():
+    """The committed record carries everything --check pins."""
+    with open(HISTORY, encoding="utf-8") as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    recs = [r for r in recs if r.get("bench") == "soak"]
+    assert recs, "no soak records committed"
+    rec = recs[-1]
+    for k in ("seed", "profile", "steps", "events_planned", "events_fired",
+              "fired_by_kind", "generations", "failure_seq", "publishes",
+              "requests", "invariants", "problems", "ok"):
+        assert k in rec, f"history record missing {k}"
+    assert rec["ok"] is True and rec["problems"] == []
+    assert all(rec["invariants"].values()), rec["invariants"]
+    assert rec["requests"]["failed"] == 0
+    assert rec["fired_by_kind"].get("preempt", 0) >= 2
+    assert rec.get("date") and rec.get("git")
+
+
+def test_recorded_series_inside_rails():
+    """Fast tier-1 guardrail: run the harness's own --check validator
+    against the committed series."""
+    p = _run(["--check"], timeout=60)
+    out = (p.stdout.strip().splitlines() or ["{}"])[-1]
+    verdict = json.loads(out)
+    assert p.returncode == 0 and verdict.get("ok"), (verdict, p.stderr)
+
+
+@pytest.mark.slow
+def test_soak_smoke_in_budget():
+    """Chaos tier: the CLI smoke profile end to end (subprocess timeout
+    is the budget); the record itself must be green."""
+    p = _run(["--smoke", "--seed", "11"], timeout=180)
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    res = json.loads(p.stdout.strip().splitlines()[-1])
+    assert res["ok"] is True, res["problems"]
+    assert res["requests"]["failed"] == 0
